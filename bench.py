@@ -1,0 +1,116 @@
+"""Benchmark: text-SFT training throughput on the available chip(s).
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Metric: training tokens/sec/chip on a Qwen3-0.6B-class dense model (largest
+of the family that fits a single v5e chip with full AdamW state); MFU is
+reported alongside. vs_baseline is measured MFU / 40.0 (BASELINE.json north
+star: >= 40% MFU for text SFT on TPU; no published TPU numbers exist).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from veomni_tpu.models import TransformerConfig, build_foundation_model
+    from veomni_tpu.optim import build_lr_scheduler, build_optimizer
+    from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+    from veomni_tpu.train import build_train_state, build_train_step
+    from veomni_tpu.train.train_step import resolve_state_shardings
+    from veomni_tpu.utils.count_flops import FlopsCounter
+    from veomni_tpu.utils.device import get_device_peak_flops
+
+    n_chips = jax.device_count()
+    ps = init_parallel_state()
+
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))
+    micro_bs = int(os.environ.get("BENCH_MICRO_BS", 4))
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    with use_parallel_state(ps):
+        cfg = TransformerConfig(
+            model_type="qwen3",
+            vocab_size=151936,
+            hidden_size=1024,
+            intermediate_size=3072,
+            num_hidden_layers=28,
+            num_attention_heads=16,
+            num_key_value_heads=8,
+            head_dim=128,
+            qk_norm=True,
+            tie_word_embeddings=True,
+            max_position_embeddings=32768,
+            rope_theta=1e6,
+            dtype=jnp.bfloat16,
+        )
+        model = build_foundation_model(config=cfg)
+        plan = model.get_parallel_plan()
+        opt = build_optimizer(model.abstract(), lr=build_lr_scheduler(lr=1e-4, train_steps=1000))
+
+        def make_state(rng):
+            return build_train_state(model.family.init_params(rng, cfg), opt)
+
+        abs_state = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+        shardings = resolve_state_shardings(abs_state, plan, ps)
+        state = jax.jit(make_state, out_shardings=shardings)(jax.random.PRNGKey(0))
+
+        keys = ("input_ids", "labels", "position_ids", "segment_ids")
+        batch_shardings = {
+            k: NamedSharding(ps.mesh, P(None, ps.dp_axes, ps.sp_axes)) for k in keys
+        }
+        step = build_train_step(
+            model.loss_fn, opt, ps,
+            state_shardings=shardings, batch_shardings=batch_shardings,
+        )
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (1, micro_bs, seq_len))
+        batch = {
+            "input_ids": jnp.asarray(ids, jnp.int32),
+            "labels": jnp.asarray(ids, jnp.int32),
+            "position_ids": jnp.asarray(
+                np.broadcast_to(np.arange(seq_len), ids.shape).copy(), jnp.int32
+            ),
+            "segment_ids": jnp.ones(ids.shape, jnp.int32),
+        }
+        batch = {k: jax.device_put(v, batch_shardings[k]) for k, v in batch.items()}
+
+        # warmup (compile); NOTE: on the axon-tunneled TPU platform
+        # block_until_ready does not wait for remote execution — a host
+        # fetch (float()) is the only true synchronization point.
+        state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+        tokens = micro_bs * seq_len * steps
+        tok_per_sec_chip = tokens / dt / n_chips
+        flops = FlopsCounter.from_config(cfg).batch_flops(
+            micro_bs * seq_len, seq_len
+        ) * steps
+        mfu = 100.0 * flops / dt / (get_device_peak_flops() * n_chips)
+
+        print(json.dumps({
+            "metric": "train_tokens_per_sec_per_chip",
+            "value": round(tok_per_sec_chip, 1),
+            "unit": f"tokens/s/chip (qwen3-0.6B bf16, seq{seq_len}, mfu={mfu:.1f}%)",
+            "vs_baseline": round(mfu / 40.0, 4),
+        }))
+
+
+if __name__ == "__main__":
+    main()

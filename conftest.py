@@ -1,0 +1,6 @@
+"""Root conftest: make the in-tree ``veomni_tpu`` package importable."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

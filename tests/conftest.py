@@ -1,0 +1,27 @@
+"""Test harness: all tests run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's mp.spawn+gloo fallback strategy (SURVEY.md §4): the
+collective/sharding logic runs on CPU with 8 virtual devices; numerics match
+TPU because XLA semantics are backend-uniform. NOTE: the axon TPU plugin
+force-registers itself via jax.config, so we must override *config*, not
+just env vars, before first backend use.
+"""
+
+import os
+
+os.environ.setdefault("VEOMNI_LOG_LEVEL", "WARNING")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    yield
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+
+    destroy_parallel_state()

@@ -1,0 +1,24 @@
+"""veomni_tpu — a TPU-native (JAX/XLA/Pallas/pjit) training framework.
+
+Capabilities modeled on ByteDance-Seed/VeOmni (see SURVEY.md): single- and
+multi-modal pre/post-training scaled through model-centric parallel plans
+(FSDP-style param sharding, Ulysses sequence parallelism, expert parallelism)
+composed over one ``jax.sharding.Mesh``, with a per-op kernel registry
+(XLA-eager vs Pallas), packed varlen data pipeline with dynamic batching,
+async sharded checkpointing with exact resume, and MFU observability.
+
+Layer map (mirrors reference ``veomni/`` — SURVEY.md §1):
+  utils/      device, logging, registry, env flags, FLOPs counter, meter
+  ops/        kernel registry + XLA/Pallas kernels (attention, CE, MoE GEMM)
+  parallel/   ParallelState/mesh, parallel plans, sequence parallel, MoE/EP
+  models/     native model zoo + HF checkpoint converters
+  data/       datasets, collators (packing + SP slice), dynamic batching
+  checkpoint/ sharded train-state checkpoints + HF safetensors export
+  optim/      optimizer/schedule builders (AdamW, Muon)
+  trainer/    BaseTrainer/TextTrainer + callbacks
+  arguments/  dataclass config tree + YAML/CLI parser
+"""
+
+__version__ = "0.1.0"
+
+from veomni_tpu.utils import logging as _logging  # noqa: F401  (configure early)

@@ -1,0 +1,16 @@
+from veomni_tpu.arguments.arguments_types import (
+    DataArguments,
+    ModelArguments,
+    TrainingArguments,
+    VeOmniArguments,
+)
+from veomni_tpu.arguments.parser import parse_args, save_args
+
+__all__ = [
+    "DataArguments",
+    "ModelArguments",
+    "TrainingArguments",
+    "VeOmniArguments",
+    "parse_args",
+    "save_args",
+]

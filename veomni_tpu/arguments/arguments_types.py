@@ -1,0 +1,121 @@
+"""Config dataclass tree: VeOmniArguments{model, data, train}.
+
+Reference: ``veomni/arguments/arguments_types.py:1440`` — the YAML/CLI
+surface is the north star for drop-in familiarity (SURVEY.md §7.2 step 1),
+so field names follow the reference where the concept exists on TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ModelArguments:
+    config_path: str = ""            # dir with config.json (HF format)
+    model_path: str = ""             # dir with safetensors weights ("" = random init)
+    tokenizer_path: str = ""         # defaults to config_path
+    model_type: str = ""             # override/bypass config.json model_type
+    attn_implementation: str = "auto"    # auto|xla|pallas_flash
+    moe_implementation: str = "auto"     # auto|xla_ragged|pallas
+    ops_implementation: Dict[str, str] = field(default_factory=dict)  # op -> impl pin
+    # tiny-model construction without config.json (tests/toy configs)
+    config_overrides: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.tokenizer_path:
+            self.tokenizer_path = self.config_path
+
+
+@dataclass
+class DataArguments:
+    train_path: str = ""
+    eval_path: str = ""
+    data_type: str = "plaintext"      # plaintext|conversation|pretokenized
+    dataset_type: str = "mapping"     # mapping|iterable|interleave|weighted
+    dataloader_type: str = "native"
+    max_seq_len: int = 2048
+    text_keys: str = "text"
+    chat_template: str = "default"
+    num_workers: int = 0              # data assembly is in-process (numpy)
+    drop_last: bool = True
+    dyn_bsz: bool = False             # token-budget dynamic batching
+    dyn_bsz_buffer_size: int = 200
+    samples_per_micro_batch: int = 8  # packing fill pool per micro-batch
+
+
+@dataclass
+class TrainingArguments:
+    output_dir: str = "output"
+    # batch geometry
+    micro_batch_size: int = 1
+    global_batch_size: int = 0        # 0 -> micro * dp_size (no grad accum)
+    # parallel sizes (reference AcceleratorConfig, arguments_types.py:465-526)
+    data_parallel_mode: str = "fsdp"  # fsdp|ddp  (ddp = dp_replicate only)
+    data_parallel_replicate_size: int = 1
+    data_parallel_shard_size: int = -1
+    ulysses_parallel_size: int = 1
+    context_parallel_size: int = 1
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    # optimization
+    optimizer: str = "adamw"
+    lr: float = 1e-5
+    lr_decay_style: str = "cosine"
+    lr_warmup_ratio: float = 0.0
+    lr_min: float = 0.0
+    weight_decay: float = 0.0
+    betas: List[float] = field(default_factory=lambda: [0.9, 0.999])
+    max_grad_norm: float = 1.0
+    # schedule/steps
+    train_steps: int = 0              # 0 -> derive from epochs * len(dataloader)
+    num_train_epochs: int = 1
+    # numerics
+    bf16: bool = True
+    enable_gradient_checkpointing: bool = True
+    enable_full_determinism: bool = False
+    seed: int = 42
+    # checkpoint
+    ckpt_manager: str = "orbax"
+    save_steps: int = 0               # 0 = only at end
+    save_hf_weights: bool = True
+    load_checkpoint_path: str = ""    # resume dir ("" = output_dir/checkpoints)
+    auto_resume: bool = True
+    max_ckpt_to_keep: int = 0
+    async_save: bool = True
+    # observability
+    log_steps: int = 1
+    enable_profiling: bool = False
+    profile_start_step: int = 3
+    profile_end_step: int = 5
+    use_wandb: bool = False
+    wandb_project: str = "veomni_tpu"
+    wandb_name: str = ""
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.bf16 else jnp.float32
+
+
+@dataclass
+class VeOmniArguments:
+    model: ModelArguments = field(default_factory=ModelArguments)
+    data: DataArguments = field(default_factory=DataArguments)
+    train: TrainingArguments = field(default_factory=TrainingArguments)
+
+    def compute_grad_accum(self, dp_size: int) -> int:
+        """global_batch_size = micro_batch_size * dp_size * grad_accum
+        (reference compute_train_steps, parser.py:64-211)."""
+        if not self.train.global_batch_size:
+            return 1
+        g = self.train.global_batch_size
+        per_step = self.train.micro_batch_size * dp_size
+        if g % per_step:
+            raise ValueError(
+                f"global_batch_size {g} not divisible by micro*dp {per_step}"
+            )
+        return g // per_step

@@ -1,0 +1,3 @@
+from veomni_tpu.checkpoint.checkpointer import Checkpointer, build_checkpointer
+
+__all__ = ["Checkpointer", "build_checkpointer"]

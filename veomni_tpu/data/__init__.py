@@ -1,0 +1,12 @@
+from veomni_tpu.data.data_collator import TextPackingCollator, DataCollateInfo
+from veomni_tpu.data.dataset import DATASET_REGISTRY, build_dataset
+from veomni_tpu.data.data_loader import DATALOADER_REGISTRY, build_dataloader
+
+__all__ = [
+    "DATASET_REGISTRY",
+    "DATALOADER_REGISTRY",
+    "DataCollateInfo",
+    "TextPackingCollator",
+    "build_dataset",
+    "build_dataloader",
+]

@@ -1,0 +1,137 @@
+"""Collators: sample packing -> fixed-shape micro-batches (+ SP slicing).
+
+Reference: ``veomni/data/data_collator.py:50-558`` — MainCollator composes
+packing (concat samples, cu_seqlens from position_ids), SequenceParallel
+slicing, label shift, and micro-batch grouping. TPU-first differences:
+
+* XLA needs **static shapes**: every micro-batch is exactly
+  ``[micro_batch_size, seq_len]``; greedy first-fit packing fills rows and
+  pads the tail (padding tokens carry segment_id 0 and label -100; real
+  segments are numbered from 1 per row).
+* cu_seqlens becomes **segment_ids** (the TPU flash-attention masking
+  contract) and position_ids restart per segment — same information content.
+* SP: each rank must hold a ``seq_len / sp_size`` slice; the collator pads
+  seq_len to a multiple of ``sp_size * 2`` and slices per rank
+  (``SequenceParallelCollator`` reference :317-428). Slicing happens in the
+  sharded jit input pipeline here (GSPMD shards the S axis), so the collator
+  only guarantees divisibility.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+@dataclass
+class DataCollateInfo:
+    """Per-key collation metadata (reference DataCollateInfo: pack_dim,
+    sp_slice, pad values) — consumed by multimodal collators."""
+
+    pack_dim: int = 0
+    sp_slice: bool = True
+    pad_value: int = 0
+
+
+@dataclass
+class PackedBatch:
+    input_ids: np.ndarray     # [B, S] int32
+    labels: np.ndarray        # [B, S] int32 (pre-shifted, -100 ignore)
+    position_ids: np.ndarray  # [B, S] int32
+    segment_ids: np.ndarray   # [B, S] int32 (0 = padding)
+
+    def as_dict(self) -> Dict[str, np.ndarray]:
+        return {
+            "input_ids": self.input_ids,
+            "labels": self.labels,
+            "position_ids": self.position_ids,
+            "segment_ids": self.segment_ids,
+        }
+
+
+class TextPackingCollator:
+    """Greedy first-fit packing of tokenized samples into [B, S] buffers."""
+
+    def __init__(
+        self,
+        seq_len: int,
+        micro_batch_size: int = 1,
+        *,
+        sp_size: int = 1,
+        drop_oversized: bool = True,
+    ):
+        if seq_len % max(sp_size, 1):
+            raise ValueError(f"seq_len {seq_len} must be divisible by sp_size {sp_size}")
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+        self.drop_oversized = drop_oversized
+        # samples that didn't fit this call carry over to the next micro-batch
+        # (nothing is silently dropped except oversized samples, which are
+        # counted). Checkpointable via state_dict.
+        self._pending: List[Dict[str, Any]] = []
+        self.dropped_oversized = 0
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "pending": [
+                {"input_ids": list(map(int, s["input_ids"])),
+                 "labels": list(map(int, s.get("labels", s["input_ids"])))}
+                for s in self._pending
+            ],
+            "dropped_oversized": self.dropped_oversized,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._pending = list(state.get("pending", []))
+        self.dropped_oversized = int(state.get("dropped_oversized", 0))
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        """samples: dicts with 'input_ids' (list[int]) and optional 'labels'
+        (same length; -100 where loss is masked, e.g. prompt tokens)."""
+        b, s = self.micro_batch_size, self.seq_len
+        input_ids = np.zeros((b, s), np.int32)
+        labels = np.full((b, s), IGNORE_INDEX, np.int32)
+        position_ids = np.zeros((b, s), np.int32)
+        segment_ids = np.zeros((b, s), np.int32)
+        fill = [0] * b
+        nseg = [0] * b
+
+        queue = self._pending + list(samples)
+        self._pending = []
+        for sample in queue:
+            ids = np.asarray(sample["input_ids"], np.int32)
+            lab = np.asarray(sample.get("labels", sample["input_ids"]), np.int32)
+            # next-token shift at the sample level: predict ids[t+1] at t
+            shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
+            n = len(ids)
+            if n > s:
+                if self.drop_oversized:
+                    self.dropped_oversized += 1
+                    continue
+                ids, shifted = ids[:s], shifted[:s]
+                n = s
+            row = next((i for i in range(b) if fill[i] + n <= s), None)
+            if row is None:
+                self._pending.append(sample)  # re-offered next micro-batch
+                continue
+            lo, hi = fill[row], fill[row] + n
+            input_ids[row, lo:hi] = ids
+            labels[row, lo:hi] = shifted
+            labels[row, hi - 1] = IGNORE_INDEX  # never predict across boundary
+            position_ids[row, lo:hi] = np.arange(n)
+            nseg[row] += 1
+            segment_ids[row, lo:hi] = nseg[row]
+            fill[row] = hi
+
+        return PackedBatch(input_ids, labels, position_ids, segment_ids).as_dict()
+
+
+def stack_micro_batches(micro_batches: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """Group A micro-batches into the [A, B, S] grad-accum layout
+    (reference MakeMicroBatchCollator)."""
+    keys = micro_batches[0].keys()
+    return {k: np.stack([mb[k] for mb in micro_batches]) for k in keys}

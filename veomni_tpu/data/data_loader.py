@@ -1,0 +1,119 @@
+"""Stateful distributed dataloader.
+
+Reference: ``veomni/data/data_loader.py:42-258`` (DistributedDataloader on
+torchdata StatefulDataLoader + StatefulDistributedSampler over the dp group).
+TPU translation: a single-controller JAX program consumes the **global**
+batch (GSPMD shards it over dp/sp axes at jit boundary); in multi-process
+mode each process loads only its dp shard (``dp_rank``/``dp_size`` args).
+Exact resume = (epoch, sample cursor, shuffle seed) in ``state_dict`` —
+no torchdata needed (SURVEY.md §7.3 hard part 4).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from veomni_tpu.data.data_collator import stack_micro_batches
+from veomni_tpu.utils.logging import get_logger
+from veomni_tpu.utils.registry import Registry
+
+logger = get_logger(__name__)
+
+DATALOADER_REGISTRY = Registry("dataloaders")
+
+
+@DATALOADER_REGISTRY.register("native")
+class DistributedDataloader:
+    """Yields [A, B, S] grad-accum batches assembled from packed micro-batches.
+
+    samples_per_micro_batch controls how many raw samples are offered to the
+    packing collator per micro-batch (the token-budget dynamic batcher
+    replaces this with a knapsack fill — ``dynamic_batching.py``).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        collate_fn: Callable,
+        *,
+        micro_batch_size: int = 1,
+        grad_accum_steps: int = 1,
+        samples_per_micro_batch: int = 8,
+        shuffle: bool = True,
+        seed: int = 0,
+        dp_rank: int = 0,
+        dp_size: int = 1,
+        drop_last: bool = True,
+        infinite: bool = False,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn
+        self.micro_batch_size = micro_batch_size
+        self.grad_accum_steps = grad_accum_steps
+        self.samples_per_micro_batch = samples_per_micro_batch
+        self.shuffle = shuffle
+        self.seed = seed
+        self.dp_rank = dp_rank
+        self.dp_size = dp_size
+        self.drop_last = drop_last
+        self.infinite = infinite
+        self._epoch = 0
+        self._cursor = 0  # samples consumed within this epoch (this rank)
+
+    # ------------------------------------------------------------------ iter
+    def _epoch_indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + self._epoch).permutation(n)
+        # shard across dp ranks (StatefulDistributedSampler semantics)
+        per = n // self.dp_size if self.drop_last else -(-n // self.dp_size)
+        return order[self.dp_rank * per: (self.dp_rank + 1) * per]
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            idxs = self._epoch_indices()
+            group = self.samples_per_micro_batch
+            need = group * self.grad_accum_steps
+            while self._cursor + need <= len(idxs):
+                micro_batches = []
+                for a in range(self.grad_accum_steps):
+                    take = idxs[self._cursor: self._cursor + group]
+                    self._cursor += group
+                    samples = [self.dataset[int(i)] for i in take]
+                    micro_batches.append(self.collate_fn(samples))
+                yield stack_micro_batches(micro_batches)
+            self._epoch += 1
+            self._cursor = 0
+            if not self.infinite:
+                break
+
+    def __len__(self) -> int:
+        per_epoch = len(self._epoch_indices())
+        return per_epoch // (self.samples_per_micro_batch * self.grad_accum_steps)
+
+    # ----------------------------------------------------------------- state
+    def state_dict(self) -> Dict[str, Any]:
+        state = {"epoch": self._epoch, "cursor": self._cursor, "seed": self.seed}
+        if hasattr(self.dataset, "state_dict"):
+            state["dataset"] = self.dataset.state_dict()
+        if hasattr(self.collate_fn, "state_dict"):
+            state["collator"] = self.collate_fn.state_dict()
+        return state
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        self.seed = int(state.get("seed", self.seed))
+        if "dataset" in state and hasattr(self.dataset, "load_state_dict"):
+            self.dataset.load_state_dict(state["dataset"])
+        if "collator" in state and hasattr(self.collate_fn, "load_state_dict"):
+            self.collate_fn.load_state_dict(state["collator"])
+
+
+def build_dataloader(dataloader_type: str = "native", **kwargs):
+    """Reference ``build_dataloader`` (data/data_loader.py:42)."""
+    return DATALOADER_REGISTRY.get(dataloader_type)(**kwargs)

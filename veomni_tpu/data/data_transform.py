@@ -1,0 +1,67 @@
+"""Data transforms: raw rows -> tokenized samples {input_ids, labels}.
+
+Reference: ``veomni/data/data_transform.py:33-399`` (DATA_TRANSFORM_REGISTRY:
+plaintext/conversation/dpo/classification + per-VLM transforms).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from veomni_tpu.utils.registry import Registry
+
+DATA_TRANSFORM_REGISTRY = Registry("data_transforms")
+
+IGNORE_INDEX = -100
+
+
+@DATA_TRANSFORM_REGISTRY.register("pretokenized")
+def build_pretokenized_transform(tokenizer=None, **_) -> Callable:
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        ids = list(row["input_ids"])
+        return {"input_ids": ids, "labels": list(row.get("labels", ids))}
+
+    return transform
+
+
+@DATA_TRANSFORM_REGISTRY.register("plaintext")
+def build_plaintext_transform(tokenizer, text_keys: str = "text", max_seq_len: int = 0, **_):
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        text = row[text_keys] if isinstance(text_keys, str) else "".join(row[k] for k in text_keys)
+        ids = tokenizer(text, add_special_tokens=True)["input_ids"]
+        if max_seq_len:
+            ids = ids[:max_seq_len]
+        return {"input_ids": ids, "labels": list(ids)}
+
+    return transform
+
+
+@DATA_TRANSFORM_REGISTRY.register("conversation")
+def build_conversation_transform(tokenizer, max_seq_len: int = 0, messages_key: str = "messages", **_):
+    """SFT chat transform: loss only on assistant turns (prompt masked)."""
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        messages = row[messages_key]
+        input_ids: List[int] = []
+        labels: List[int] = []
+        for i, msg in enumerate(messages):
+            rendered = tokenizer.apply_chat_template(
+                messages[: i + 1], tokenize=True,
+                add_generation_prompt=False,
+            )
+            new = rendered[len(input_ids):]
+            if msg.get("role") == "assistant":
+                labels.extend(new)
+            else:
+                labels.extend([IGNORE_INDEX] * len(new))
+            input_ids.extend(new)
+        if max_seq_len:
+            input_ids = input_ids[:max_seq_len]
+            labels = labels[:max_seq_len]
+        return {"input_ids": input_ids, "labels": labels}
+
+    return transform
+
+
+def build_data_transform(data_type: str, tokenizer=None, **kwargs) -> Callable:
+    return DATA_TRANSFORM_REGISTRY.get(data_type)(tokenizer=tokenizer, **kwargs)

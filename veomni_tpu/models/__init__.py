@@ -1,0 +1,17 @@
+from veomni_tpu.models.auto import (
+    MODEL_REGISTRY,
+    FoundationModel,
+    ModelFamily,
+    build_foundation_model,
+    build_tokenizer,
+)
+from veomni_tpu.models.config import TransformerConfig
+
+__all__ = [
+    "MODEL_REGISTRY",
+    "FoundationModel",
+    "ModelFamily",
+    "TransformerConfig",
+    "build_foundation_model",
+    "build_tokenizer",
+]

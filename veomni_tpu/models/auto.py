@@ -1,0 +1,127 @@
+"""Model registry + build entry points.
+
+Reference: ``veomni/models/auto.py:41-280`` (build_foundation_model /
+build_tokenizer) and ``models/loader.py:49-291`` (registries keyed by
+model_type). A *family* bundles the functional pieces the trainer needs:
+config class, init/apply/loss, the declarative ParallelPlan, and HF
+checkpoint converters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from veomni_tpu.models import hf_io, transformer
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.parallel.parallel_plan import ParallelPlan
+from veomni_tpu.utils.logging import get_logger
+from veomni_tpu.utils.registry import Registry
+
+logger = get_logger(__name__)
+
+MODEL_REGISTRY = Registry("models")
+
+
+@dataclass
+class ModelFamily:
+    """The per-model_type recipe (cf. reference MODELING_REGISTRY entries)."""
+
+    model_type: str
+    config_cls: type = TransformerConfig
+    init_params: Callable = transformer.init_params
+    abstract_params: Callable = transformer.abstract_params
+    loss_fn: Callable = transformer.loss_fn
+    forward_logits: Callable = transformer.forward_logits
+    hf_to_params: Callable = hf_io.hf_to_params
+    save_hf_checkpoint: Callable = hf_io.save_hf_checkpoint
+    parallel_plan_fn: Optional[Callable] = None
+
+    def get_parallel_plan(self, cfg) -> ParallelPlan:
+        """Model-declared sharding (reference get_parallel_plan,
+        e.g. ``models/transformers/qwen3_moe/parallel_plan.py:6-16``)."""
+        if self.parallel_plan_fn is not None:
+            return self.parallel_plan_fn(cfg)
+        rules: Dict[str, tuple] = {}
+        if getattr(cfg, "is_moe", False):
+            # experts [L, E, in, out]: expert dim over ep, features over fsdp
+            rules[r"layers\.experts\..*"] = ("ep", "ep_fsdp", None)
+            rules[r"layers\.router$"] = ()
+        return ParallelPlan(rules=rules)
+
+
+for _mt in ("llama", "qwen2", "qwen3", "qwen3_moe"):
+    MODEL_REGISTRY.register(_mt, ModelFamily(model_type=_mt))
+
+
+@dataclass
+class FoundationModel:
+    """What build_foundation_model returns: config + family + (lazy) params."""
+
+    config: TransformerConfig
+    family: ModelFamily
+    params: Optional[Any] = None
+
+    def init(self, rng: jax.Array):
+        self.params = self.family.init_params(rng, self.config)
+        return self.params
+
+    def abstract(self):
+        return self.family.abstract_params(self.config)
+
+    def loss_fn(self, params, batch):
+        return self.family.loss_fn(params, self.config, batch)
+
+    def get_parallel_plan(self) -> ParallelPlan:
+        return self.family.get_parallel_plan(self.config)
+
+    def load_hf(self, model_dir: str, target_shardings=None):
+        self.params = self.family.hf_to_params(model_dir, self.config, target_shardings)
+        return self.params
+
+    def save_hf(self, out_dir: str, params=None):
+        self.family.save_hf_checkpoint(
+            params if params is not None else self.params, self.config, out_dir
+        )
+
+
+def build_foundation_model(
+    config_path: Optional[str] = None,
+    *,
+    config: Optional[TransformerConfig] = None,
+    weights_path: Optional[str] = None,
+    ops_implementation: Optional[Dict[str, str]] = None,
+    **config_overrides,
+) -> FoundationModel:
+    """Reference ``build_foundation_model`` (models/auto.py:110): resolve
+    config -> bind ops -> construct (weights load deferred to the
+    parallelized build so tensors land shard-aligned)."""
+    from veomni_tpu.ops.kernel_registry import apply_ops_config
+
+    if config is None:
+        if config_path is None:
+            raise ValueError("need config_path or config")
+        config = TransformerConfig.from_pretrained(config_path, **config_overrides)
+    if config.model_type not in MODEL_REGISTRY:
+        logger.warning_rank0(
+            "model_type %r not registered; using llama-family core", config.model_type
+        )
+    family = (
+        MODEL_REGISTRY.get(config.model_type)
+        if config.model_type in MODEL_REGISTRY
+        else ModelFamily(model_type=config.model_type)
+    )
+    apply_ops_config(ops_implementation)
+    model = FoundationModel(config=config, family=family)
+    if weights_path:
+        model.load_hf(weights_path)
+    return model
+
+
+def build_tokenizer(path: str):
+    """HF tokenizer passthrough (reference models/auto.py:41)."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(path, trust_remote_code=True)

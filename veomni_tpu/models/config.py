@@ -1,0 +1,125 @@
+"""Model configuration: one dataclass covering the llama-family dialects.
+
+Reference: per-model HF configs under ``veomni/models/transformers/<name>/``.
+We keep HF *checkpoint/config* compatibility (``from_hf_config`` consumes an
+HF config.json dict) while owning the modeling code (SURVEY.md §7.1: no
+patchgen — native model zoo).
+
+Dialect switches:
+  llama:      defaults
+  qwen2:      attention_bias=True (qkv bias)
+  qwen3:      qk_norm=True, head_dim explicit
+  qwen3_moe:  qk_norm=True + MoE fields (num_experts, top_k, norm_topk_prob)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class TransformerConfig:
+    model_type: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    head_dim: int = 0  # 0 -> hidden // heads
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[Dict[str, Any]] = None  # HF rope_scaling dict
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    qk_norm: bool = False
+    sliding_window: Optional[int] = None
+    # MoE (num_experts == 0 -> dense MLP)
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+    router_aux_loss_coef: float = 0.001
+    # numerics
+    dtype: Any = jnp.bfloat16       # activation/compute dtype
+    param_dtype: Any = jnp.float32  # master param dtype
+    remat: bool = True              # jax.checkpoint each decoder layer
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        if not self.head_dim:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if isinstance(self.dtype, str):
+            self.dtype = getattr(jnp, self.dtype)
+        if isinstance(self.param_dtype, str):
+            self.param_dtype = getattr(jnp, self.param_dtype)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_attention_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_key_value_heads * self.head_dim
+
+    # ------------------------------------------------------------------ HF io
+    _HF_FIELDS = (
+        "vocab_size hidden_size intermediate_size num_hidden_layers "
+        "num_attention_heads num_key_value_heads rms_norm_eps rope_theta "
+        "max_position_embeddings tie_word_embeddings sliding_window "
+        "num_experts_per_tok moe_intermediate_size norm_topk_prob "
+        "router_aux_loss_coef initializer_range"
+    ).split()
+
+    @classmethod
+    def from_hf_config(cls, hf: Dict[str, Any], **overrides) -> "TransformerConfig":
+        mt = hf.get("model_type", "llama")
+        kw: Dict[str, Any] = {"model_type": mt}
+        for name in cls._HF_FIELDS:
+            if name in hf and hf[name] is not None:
+                kw[name] = hf[name]
+        if hf.get("head_dim"):
+            kw["head_dim"] = hf["head_dim"]
+        if hf.get("rope_scaling"):
+            kw["rope_scaling"] = dict(hf["rope_scaling"])
+        if mt in ("qwen2",):
+            kw["attention_bias"] = True
+        if mt in ("qwen3", "qwen3_moe"):
+            kw["qk_norm"] = True
+        if "attention_bias" in hf:
+            kw["attention_bias"] = hf["attention_bias"]
+        if mt == "qwen3_moe":
+            kw["num_experts"] = hf.get("num_experts", 0)
+        elif "num_local_experts" in hf:
+            kw["num_experts"] = hf["num_local_experts"]
+        if not hf.get("use_sliding_window", mt == "gemma3"):
+            kw["sliding_window"] = None
+        kw.update(overrides)
+        return cls(**kw)
+
+    @classmethod
+    def from_pretrained(cls, path: str, **overrides) -> "TransformerConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            return cls.from_hf_config(json.load(f), **overrides)
+
+    def to_hf_config(self) -> Dict[str, Any]:
+        hf = {"model_type": self.model_type, "head_dim": self.head_dim,
+              "attention_bias": self.attention_bias}
+        if self.rope_scaling:
+            hf["rope_scaling"] = self.rope_scaling
+        for name in self._HF_FIELDS:
+            hf[name] = getattr(self, name)
+        if self.is_moe:
+            hf["num_experts"] = self.num_experts
+        return hf

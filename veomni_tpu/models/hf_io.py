@@ -1,0 +1,189 @@
+"""HF safetensors checkpoint import/export for the native model zoo.
+
+Reference: ``veomni/models/module_utils.py:348-1576`` (weight streaming,
+sharded save) + ``checkpoint_tensor_loading.py`` (key conversion, per-expert
+-> fused stacked weights). TPU simplifications: single-controller load means
+no rank0-broadcast machinery — each tensor is read once and ``device_put``
+directly to its target NamedSharding shard-by-shard.
+
+Layout conversions (HF torch [out,in] linear vs our [in,out] kernels, and
+per-layer tensors stacked on a leading L dim) are declared in one table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# (our path under layers.*, hf suffix, transpose?)  {i} is the layer index.
+_LAYER_MAP: List[Tuple[str, str, bool]] = [
+    ("input_layernorm", "input_layernorm.weight", False),
+    ("q_proj", "self_attn.q_proj.weight", True),
+    ("k_proj", "self_attn.k_proj.weight", True),
+    ("v_proj", "self_attn.v_proj.weight", True),
+    ("o_proj", "self_attn.o_proj.weight", True),
+    ("q_bias", "self_attn.q_proj.bias", False),
+    ("k_bias", "self_attn.k_proj.bias", False),
+    ("v_bias", "self_attn.v_proj.bias", False),
+    ("q_norm", "self_attn.q_norm.weight", False),
+    ("k_norm", "self_attn.k_norm.weight", False),
+    ("post_attention_layernorm", "post_attention_layernorm.weight", False),
+    ("gate_proj", "mlp.gate_proj.weight", True),
+    ("up_proj", "mlp.up_proj.weight", True),
+    ("down_proj", "mlp.down_proj.weight", True),
+    ("router", "mlp.gate.weight", True),
+]
+_EXPERT_MAP: List[Tuple[str, str]] = [
+    ("experts.gate_proj", "mlp.experts.{e}.gate_proj.weight"),
+    ("experts.up_proj", "mlp.experts.{e}.up_proj.weight"),
+    ("experts.down_proj", "mlp.experts.{e}.down_proj.weight"),
+]
+
+
+def _read_all_tensors(model_dir: str) -> Dict[str, np.ndarray]:
+    """Read every tensor from all safetensors shards (numpy, bf16-safe)."""
+    import safetensors
+
+    out: Dict[str, np.ndarray] = {}
+    files = sorted(f for f in os.listdir(model_dir) if f.endswith(".safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {model_dir}")
+    for fname in files:
+        with safetensors.safe_open(os.path.join(model_dir, fname), framework="flax") as f:
+            for key in f.keys():
+                out[key] = f.get_tensor(key)
+    return out
+
+
+def hf_to_params(
+    model_dir: str, cfg: TransformerConfig, target_shardings=None
+) -> Dict[str, Any]:
+    """Load an HF checkpoint dir into our stacked-param pytree.
+
+    target_shardings: optional pytree of NamedSharding matching
+    ``abstract_params(cfg)`` — tensors are placed shard-aligned at load.
+    """
+    raw = {re.sub(r"^model\.", "", k): v for k, v in _read_all_tensors(model_dir).items()}
+    pd = cfg.param_dtype
+    L = cfg.num_hidden_layers
+
+    def grab(name: str) -> np.ndarray:
+        if name not in raw:
+            raise KeyError(f"missing tensor {name!r} in {model_dir}")
+        return np.asarray(raw.pop(name))
+
+    def maybe_t(x, transpose):
+        return x.T if transpose else x
+
+    layers: Dict[str, Any] = {}
+    for ours, hf_suffix, transpose in _LAYER_MAP:
+        if f"layers.0.{hf_suffix}" not in raw:
+            continue
+        stacked = np.stack(
+            [maybe_t(grab(f"layers.{i}.{hf_suffix}"), transpose) for i in range(L)]
+        )
+        layers[ours] = jnp.asarray(stacked, pd)
+    if cfg.is_moe:
+        for ours, hf_tmpl in _EXPERT_MAP:
+            per_layer = []
+            for i in range(L):
+                per_expert = [
+                    np.asarray(grab(f"layers.{i}.{hf_tmpl.format(e=e)}")).T
+                    for e in range(cfg.num_experts)
+                ]
+                per_layer.append(np.stack(per_expert))
+            a, b = ours.split(".")
+            layers.setdefault(a, {})[b] = jnp.asarray(np.stack(per_layer), pd)
+
+    params: Dict[str, Any] = {
+        "embed_tokens": jnp.asarray(grab("embed_tokens.weight"), pd),
+        "layers": layers,
+        "norm": jnp.asarray(grab("norm.weight"), pd),
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in raw:
+            params["lm_head"] = jnp.asarray(np.asarray(raw.pop("lm_head.weight")).T, pd)
+        else:
+            params["lm_head"] = jnp.asarray(np.asarray(params["embed_tokens"]).T, pd)
+    if raw:
+        logger.warning_rank0("unconsumed HF tensors: %s", sorted(raw)[:8])
+    if target_shardings is not None:
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s), params, target_shardings
+        )
+    return params
+
+
+def params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, np.ndarray]:
+    """Inverse mapping, for HF-format export (gathers to host)."""
+    out: Dict[str, np.ndarray] = {}
+    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+    out["model.embed_tokens.weight"] = host["embed_tokens"]
+    out["model.norm.weight"] = host["norm"]
+    if "lm_head" in host:
+        out["lm_head.weight"] = host["lm_head"].T
+    L = cfg.num_hidden_layers
+    layers = host["layers"]
+    for ours, hf_suffix, transpose in _LAYER_MAP:
+        if ours not in layers:
+            continue
+        for i in range(L):
+            x = layers[ours][i]
+            out[f"model.layers.{i}.{hf_suffix}"] = x.T if transpose else x
+    if cfg.is_moe:
+        for ours, hf_tmpl in _EXPERT_MAP:
+            a, b = ours.split(".")
+            for i in range(L):
+                for e in range(cfg.num_experts):
+                    out[f"model.layers.{i}.{hf_tmpl.format(e=e)}"] = layers[a][b][i, e].T
+    return out
+
+
+def save_hf_checkpoint(
+    params: Dict[str, Any], cfg: TransformerConfig, out_dir: str,
+    max_shard_bytes: int = 4 * 1024**3,
+) -> None:
+    """HF-format sharded safetensors export (reference save_model_weights,
+    ``module_utils.py:1445``)."""
+    from safetensors.flax import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    tensors = params_to_hf(params, cfg)
+    shards: List[Dict[str, np.ndarray]] = [{}]
+    sizes = [0]
+    for k in sorted(tensors):
+        t = tensors[k]
+        nbytes = t.size * t.dtype.itemsize
+        if sizes[-1] + nbytes > max_shard_bytes and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][k] = t
+        sizes[-1] += nbytes
+    n = len(shards)
+    index = {"metadata": {"total_size": sum(sizes)}, "weight_map": {}}
+    for i, shard in enumerate(shards):
+        fname = (
+            "model.safetensors" if n == 1
+            else f"model-{i + 1:05d}-of-{n:05d}.safetensors"
+        )
+        save_file({k: jnp.asarray(v) for k, v in shard.items()},
+                  os.path.join(out_dir, fname))
+        for k in shard:
+            index["weight_map"][k] = fname
+    if n > 1:
+        with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+            json.dump(index, f, indent=2)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(cfg.to_hf_config(), f, indent=2)
+    logger.info_rank0("saved HF checkpoint to %s (%d shards)", out_dir, n)

@@ -1,0 +1,249 @@
+"""Functional decoder-only transformer core (llama / qwen2 / qwen3 / qwen3_moe).
+
+Reference behavior: the generated modeling files under
+``veomni/models/transformers/<family>/generated/`` (e.g.
+``patched_modeling_qwen3_gpu.py``) — embedding -> N decoder layers
+(rmsnorm, GQA attention w/ rotary, SwiGLU MLP or MoE) -> final norm ->
+fused-linear CE loss. TPU-first design decisions:
+
+* **Params are a plain pytree** with per-layer tensors *stacked on a leading
+  layer dim* and the forward is a ``lax.scan`` over that dim: one compiled
+  layer body regardless of depth (fast compiles, weight-stationary layout),
+  with ``jax.checkpoint`` on the body for rematerialized activations.
+* Mixed precision: master params in ``param_dtype`` (f32), cast once to
+  ``dtype`` (bf16) at step start — this is what FSDP2's mp_policy does via
+  per-layer casts in the reference (``torch_parallelize.py:401-405``).
+* Packing: segment_ids mask cross-document attention (the cu_seqlens varlen
+  contract of the reference collator, ``data/data_collator.py:50-106``).
+* MoE layers compute via token-sort + grouped GEMM (``ops.group_gemm``); the
+  EP-distributed dispatch wraps this under ``shard_map`` in
+  ``parallel/moe.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu import ops
+from veomni_tpu.models.config import TransformerConfig
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# Init
+# --------------------------------------------------------------------------
+def _dense_init(key, shape, dtype, scale):
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    """Random init with HF-compatible structure (stacked layer dim first)."""
+    h, qd, kvd = cfg.hidden_size, cfg.q_dim, cfg.kv_dim
+    inter = cfg.intermediate_size
+    pd = cfg.param_dtype
+    s = cfg.initializer_range
+    keys = iter(jax.random.split(rng, 64))
+    L = cfg.num_hidden_layers
+
+    layers: Params = {
+        "input_layernorm": jnp.ones((L, h), pd),
+        "q_proj": _dense_init(next(keys), (L, h, qd), pd, s),
+        "k_proj": _dense_init(next(keys), (L, h, kvd), pd, s),
+        "v_proj": _dense_init(next(keys), (L, h, kvd), pd, s),
+        "o_proj": _dense_init(next(keys), (L, qd, h), pd, s),
+        "post_attention_layernorm": jnp.ones((L, h), pd),
+    }
+    if cfg.attention_bias:
+        layers["q_bias"] = jnp.zeros((L, qd), pd)
+        layers["k_bias"] = jnp.zeros((L, kvd), pd)
+        layers["v_bias"] = jnp.zeros((L, kvd), pd)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, cfg.head_dim), pd)
+        layers["k_norm"] = jnp.ones((L, cfg.head_dim), pd)
+    if cfg.is_moe:
+        im = cfg.moe_intermediate_size or inter
+        layers["router"] = _dense_init(next(keys), (L, h, cfg.num_experts), pd, s)
+        layers["experts"] = {
+            "gate_proj": _dense_init(next(keys), (L, cfg.num_experts, h, im), pd, s),
+            "up_proj": _dense_init(next(keys), (L, cfg.num_experts, h, im), pd, s),
+            "down_proj": _dense_init(next(keys), (L, cfg.num_experts, im, h), pd, s),
+        }
+    else:
+        layers["gate_proj"] = _dense_init(next(keys), (L, h, inter), pd, s)
+        layers["up_proj"] = _dense_init(next(keys), (L, h, inter), pd, s)
+        layers["down_proj"] = _dense_init(next(keys), (L, inter, h), pd, s)
+
+    params: Params = {
+        "embed_tokens": _dense_init(next(keys), (cfg.vocab_size, h), pd, s),
+        "layers": layers,
+        "norm": jnp.ones((h,), pd),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _dense_init(next(keys), (h, cfg.vocab_size), pd, s)
+    return params
+
+
+def abstract_params(cfg: TransformerConfig) -> Params:
+    """Shape/dtype tree without allocation (for sharding resolution/loading)."""
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+# --------------------------------------------------------------------------
+# Forward
+# --------------------------------------------------------------------------
+def _moe_mlp(x, lp, cfg: TransformerConfig):
+    """Single-device MoE: route -> sort by expert -> grouped GEMM -> unsort.
+
+    Matches the reference eager MoE semantics (softmax-then-topk with
+    optional topk renorm, qwen3_moe dialect). x: [T, H].
+    """
+    t, h = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = jnp.dot(x, lp["router"], preferred_element_type=jnp.float32)  # [T,E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [T,K]
+    if cfg.norm_topk_prob:
+        topk_probs = topk_probs / jnp.clip(topk_probs.sum(-1, keepdims=True), 1e-9)
+    topk_probs = topk_probs.astype(x.dtype)
+
+    flat_expert = topk_idx.reshape(-1)  # [T*K]
+    sort_idx = jnp.argsort(flat_expert)  # stable
+    token_idx = sort_idx // k
+    xs = x[token_idx]  # [T*K, H] sorted by expert
+    group_sizes = jnp.bincount(flat_expert, length=e)
+
+    gate = ops.group_gemm(xs, lp["experts"]["gate_proj"], group_sizes)
+    up = ops.group_gemm(xs, lp["experts"]["up_proj"], group_sizes)
+    act = ops.swiglu(gate, up)
+    out = ops.group_gemm(act, lp["experts"]["down_proj"], group_sizes)  # [T*K, H]
+
+    weight = topk_probs.reshape(-1)[sort_idx][:, None]
+    combined = jnp.zeros((t, h), out.dtype).at[token_idx].add(out * weight)
+    aux = ops.load_balancing_loss(probs, topk_idx, e)
+    return combined, aux
+
+
+def _activation_constraint():
+    """Pin [B,S,H] activations to (dp, sp, None) so GSPMD keeps FSDP
+    semantics (gather weights, never reshard activations onto fsdp axes).
+    No-op when no ParallelState is active (pure single-device use)."""
+    from veomni_tpu.parallel.parallel_state import get_parallel_state
+
+    try:
+        ps = get_parallel_state()
+    except RuntimeError:
+        return lambda x: x
+    sharding = ps.sharding(ps.dp_axes, ps.sp_axes, None)
+    return lambda x: jax.lax.with_sharding_constraint(x, sharding)
+
+
+def _decoder_layer(hidden, lp, *, cfg: TransformerConfig, cos, sin, segment_ids):
+    b, s, h = hidden.shape
+    constrain = _activation_constraint()
+    hidden = constrain(hidden)
+    x = ops.rms_norm(hidden, lp["input_layernorm"], cfg.rms_norm_eps)
+    q = jnp.dot(x, lp["q_proj"])
+    kk = jnp.dot(x, lp["k_proj"])
+    v = jnp.dot(x, lp["v_proj"])
+    if cfg.attention_bias:
+        q = q + lp["q_bias"]
+        kk = kk + lp["k_bias"]
+        v = v + lp["v_bias"]
+    q = q.reshape(b, s, cfg.num_attention_heads, cfg.head_dim)
+    kk = kk.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = ops.rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        kk = ops.rms_norm(kk, lp["k_norm"], cfg.rms_norm_eps)
+    q, kk = ops.apply_rotary(q, kk, cos, sin)
+    attn = ops.attention(
+        q, kk, v, segment_ids=segment_ids, causal=True,
+        sliding_window=cfg.sliding_window,
+    )
+    attn = attn.reshape(b, s, cfg.q_dim)
+    hidden = hidden + jnp.dot(attn, lp["o_proj"])
+
+    hidden = constrain(hidden)
+    x = ops.rms_norm(hidden, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    if cfg.is_moe:
+        out, aux = _moe_mlp(x.reshape(b * s, h), lp, cfg)
+        out = out.reshape(b, s, h)
+    else:
+        out = jnp.dot(ops.swiglu(jnp.dot(x, lp["gate_proj"]), jnp.dot(x, lp["up_proj"])),
+                      lp["down_proj"])
+        aux = jnp.float32(0.0)
+    return constrain(hidden + out), aux
+
+
+def forward_hidden(
+    params: Params,
+    cfg: TransformerConfig,
+    input_ids: jax.Array,          # [B,S] int32
+    position_ids: jax.Array,       # [B,S] int32
+    segment_ids: Optional[jax.Array] = None,  # [B,S] int32
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (final_hidden [B,S,H] in cfg.dtype, moe_aux_loss scalar)."""
+    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    hidden = compute["embed_tokens"][input_ids]
+    cos, sin = ops.rotary_tables(
+        position_ids, cfg.head_dim, cfg.rope_theta, rope_scaling=cfg.rope_scaling
+    )
+    cos = cos.astype(cfg.dtype)
+    sin = sin.astype(cfg.dtype)
+
+    body = partial(_decoder_layer, cfg=cfg, cos=cos, sin=sin, segment_ids=segment_ids)
+    if cfg.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_fn(carry, lp):
+        new_hidden, aux = body(carry, lp)
+        return new_hidden, aux
+
+    hidden, auxes = jax.lax.scan(scan_fn, hidden, compute["layers"])
+    hidden = ops.rms_norm(hidden, compute["norm"], cfg.rms_norm_eps)
+    return hidden, auxes.sum()
+
+
+def lm_head_kernel(params: Params, cfg: TransformerConfig):
+    if cfg.tie_word_embeddings:
+        return params["embed_tokens"].T
+    return params["lm_head"]
+
+
+def forward_logits(params, cfg, input_ids, position_ids, segment_ids=None):
+    hidden, _ = forward_hidden(params, cfg, input_ids, position_ids, segment_ids)
+    kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
+    return jnp.dot(hidden, kernel, preferred_element_type=jnp.float32)
+
+
+def loss_fn(
+    params: Params,
+    cfg: TransformerConfig,
+    batch: Dict[str, jax.Array],
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Sum-NLL + valid-token count (caller normalizes, possibly across dp/sp).
+
+    batch: input_ids/position_ids/segment_ids [B,S], labels [B,S] pre-shifted
+    with -100 padding (collator contract, reference data_collator.py:371-428).
+    """
+    hidden, moe_aux = forward_hidden(
+        params, cfg, batch["input_ids"], batch["position_ids"], batch.get("segment_ids")
+    )
+    b, s, h = hidden.shape
+    kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
+    loss_sum, ntokens = ops.fused_linear_cross_entropy(
+        hidden.reshape(b * s, h), kernel, batch["labels"].reshape(b * s)
+    )
+    metrics = {"loss_sum": loss_sum, "ntokens": ntokens, "moe_aux_loss": moe_aux}
+    total = loss_sum
+    if cfg.is_moe and cfg.router_aux_loss_coef:
+        # aux loss is per-token-mean-like already; scale by token count to stay
+        # in sum space so dp/sp reduction normalizes both terms identically.
+        total = total + cfg.router_aux_loss_coef * moe_aux * ntokens
+    return total, metrics

@@ -1,0 +1,39 @@
+"""Ops layer: kernel registry + dispatch + XLA/Pallas implementations.
+
+Reference: ``veomni/ops/`` — KERNEL_REGISTRY + OpSlot dispatch with per-op
+implementation selection (eager vs Triton vs external CUDA). Here the impl
+axes are {"xla", "pallas"}; XLA already fuses most elementwise chains, so
+Pallas is reserved for the genuinely hot ops (flash attention, grouped GEMM).
+"""
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, KernelSpec, resolve_op
+from veomni_tpu.ops import rms_norm as _rms_norm  # noqa: F401 register
+from veomni_tpu.ops import rotary as _rotary  # noqa: F401
+from veomni_tpu.ops import swiglu as _swiglu  # noqa: F401
+from veomni_tpu.ops import attention as _attention  # noqa: F401
+from veomni_tpu.ops import cross_entropy as _cross_entropy  # noqa: F401
+from veomni_tpu.ops import load_balancing as _load_balancing  # noqa: F401
+from veomni_tpu.ops import group_gemm as _group_gemm  # noqa: F401
+
+rms_norm = _rms_norm.rms_norm
+apply_rotary = _rotary.apply_rotary
+rotary_tables = _rotary.rotary_tables
+swiglu = _swiglu.swiglu
+attention = _attention.attention
+fused_linear_cross_entropy = _cross_entropy.fused_linear_cross_entropy
+load_balancing_loss = _load_balancing.load_balancing_loss
+group_gemm = _group_gemm.group_gemm
+
+__all__ = [
+    "KERNEL_REGISTRY",
+    "KernelSpec",
+    "resolve_op",
+    "rms_norm",
+    "apply_rotary",
+    "rotary_tables",
+    "swiglu",
+    "attention",
+    "fused_linear_cross_entropy",
+    "load_balancing_loss",
+    "group_gemm",
+]

@@ -1,0 +1,80 @@
+"""Attention facade with packing (segment-id) support.
+
+Reference: ``veomni/ops/kernels/attention/`` — flash-attn adapter with varlen
+cu_seqlens + Ulysses wrapping. TPU translation: packed sequences are masked
+via *segment ids* (the TPU-native equivalent of cu_seqlens: tokens attend
+only within their own segment), which both the XLA impl and the Pallas flash
+kernel consume. Ulysses wrapping lives in ``parallel/sequence_parallel.py``
+and calls this op on gathered-sequence/scattered-head tensors.
+
+Layouts: q [B, S, Hq, D]; k/v [B, S, Hkv, D]; segment_ids [B, S] int32
+(0 is a valid segment; padding should use a dedicated segment value and be
+masked out by the loss). Returns [B, S, Hq, D].
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+
+def _repeat_kv(k, n_rep: int):
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+@KERNEL_REGISTRY.register("attention", "xla")
+def _attention_xla(
+    q,
+    k,
+    v,
+    segment_ids: Optional[jax.Array] = None,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+):
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    n_rep = hq // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    mask = None
+    if causal:
+        qi = jnp.arange(sq)[:, None]
+        ki = jnp.arange(sk)[None, :]
+        mask = qi >= ki
+        if sliding_window is not None:
+            mask = mask & (qi - ki < sliding_window)
+        mask = mask[None, None]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        seg = jnp.swapaxes(seg, -1, -2)  # [B,1,q,k]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(
+    q,
+    k,
+    v,
+    segment_ids: Optional[jax.Array] = None,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+):
+    return resolve_op("attention")(
+        q, k, v, segment_ids=segment_ids, causal=causal,
+        softmax_scale=softmax_scale, sliding_window=sliding_window,
+    )

@@ -1,0 +1,66 @@
+"""Fused linear + cross-entropy, chunked over tokens.
+
+Reference: ``veomni/ops/kernels/cross_entropy/chunk_loss.py`` — hardware-
+agnostic chunked F.linear+CE that never materializes the full [T, V] logits.
+TPU translation: ``lax.map`` over token chunks with ``jax.checkpoint`` on the
+chunk body — backward recomputes each chunk's logits, so peak memory is
+O(chunk * V) instead of O(T * V). No custom kernel needed (memory-bound).
+
+Returns (loss_sum, valid_token_count): callers divide (possibly after a psum
+over dp/sp axes — see ``parallel/sequence_parallel.py`` loss reduction).
+Labels use -100 as ignore index (HF convention, shared with the collators).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+IGNORE_INDEX = -100
+
+
+def _chunk_ce(h, lab, kernel, logit_softcap):
+    logits = jnp.dot(h, kernel, preferred_element_type=jnp.float32)  # [C, V]
+    if logit_softcap:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+    valid = lab != IGNORE_INDEX
+    lab_safe = jnp.where(valid, lab, 0)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, lab_safe[:, None], axis=-1)[:, 0]
+    nll = jnp.where(valid, logz - gold, 0.0)
+    return nll.sum(), valid.sum()
+
+
+@KERNEL_REGISTRY.register("fused_linear_cross_entropy", "xla_chunked", priority=1)
+def _flce_chunked(
+    hidden, kernel, labels, *, chunk_size: int = 4096, logit_softcap: Optional[float] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """hidden [T,H] (any leading dims flattened by caller), kernel [H,V], labels [T]."""
+    t, _ = hidden.shape
+    chunk = min(chunk_size, t)
+    pad = (-t) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=IGNORE_INDEX)
+    n = (t + pad) // chunk
+    hs = hidden.reshape(n, chunk, hidden.shape[-1])
+    ls = labels.reshape(n, chunk)
+    body = jax.checkpoint(partial(_chunk_ce, kernel=kernel, logit_softcap=logit_softcap))
+    sums, counts = jax.lax.map(lambda args: body(*args), (hs, ls))
+    return sums.sum(), counts.sum()
+
+
+@KERNEL_REGISTRY.register("fused_linear_cross_entropy", "xla")
+def _flce_eager(
+    hidden, kernel, labels, *, chunk_size: int = 0, logit_softcap: Optional[float] = None
+) -> Tuple[jax.Array, jax.Array]:
+    return _chunk_ce(hidden, labels, kernel, logit_softcap)
+
+
+def fused_linear_cross_entropy(hidden, kernel, labels, **kwargs):
+    return resolve_op("fused_linear_cross_entropy")(hidden, kernel, labels, **kwargs)

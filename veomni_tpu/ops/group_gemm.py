@@ -1,0 +1,30 @@
+"""Grouped GEMM for fused MoE experts.
+
+Reference: ``veomni/ops/kernels/moe/_kernels/kernel/group_gemm.py:65-397`` —
+Triton variable-M grouped GEMM over the per-expert token cumsum. TPU
+translation: ``jax.lax.ragged_dot`` (XLA's native ragged matmul, which tiles
+onto the MXU) as the default, with a Pallas grouped-matmul kernel as the
+high-priority TPU impl (added in ops/pallas/). Layout contract matches the
+reference wrappers: tokens pre-sorted by expert, ``group_sizes[e]`` tokens
+per expert.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+
+@KERNEL_REGISTRY.register("group_gemm", "xla_ragged")
+def _group_gemm_ragged(tokens, weights, group_sizes):
+    """tokens [M,K] sorted by expert; weights [E,K,N]; group_sizes [E] -> [M,N]."""
+    return jax.lax.ragged_dot(
+        tokens, weights, group_sizes.astype(jnp.int32),
+        preferred_element_type=jnp.float32,
+    ).astype(tokens.dtype)
+
+
+def group_gemm(tokens, weights, group_sizes):
+    return resolve_op("group_gemm")(tokens, weights, group_sizes)

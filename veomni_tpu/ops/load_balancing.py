@@ -1,0 +1,39 @@
+"""MoE router auxiliary load-balancing loss (switch-transformer style).
+
+Reference: ``veomni/ops/kernels/load_balancing_loss/`` (fused Triton + eager).
+Pure JAX: XLA fuses the two reductions; no kernel warranted.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+
+@KERNEL_REGISTRY.register("load_balancing_loss", "xla")
+def _lbl_xla(router_probs, expert_index, num_experts: int, valid_mask=None):
+    """router_probs [T,E] softmax probs; expert_index [T,K] chosen experts.
+
+    loss = E * sum_e( frac_tokens_e * mean_prob_e ) over valid tokens.
+    """
+    t = router_probs.shape[0]
+    one_hot = jax.nn.one_hot(expert_index, num_experts, dtype=jnp.float32)  # [T,K,E]
+    dispatch = one_hot.sum(axis=1)  # [T,E]
+    if valid_mask is not None:
+        m = valid_mask[:, None].astype(jnp.float32)
+        dispatch = dispatch * m
+        router_probs = router_probs * m
+        denom = jnp.maximum(valid_mask.sum(), 1).astype(jnp.float32)
+    else:
+        denom = jnp.float32(t)
+    frac = dispatch.sum(axis=0) / (denom * expert_index.shape[-1])
+    prob = router_probs.sum(axis=0) / denom
+    return num_experts * jnp.sum(frac * prob)
+
+
+def load_balancing_loss(router_probs, expert_index, num_experts: int, valid_mask=None):
+    return resolve_op("load_balancing_loss")(router_probs, expert_index, num_experts, valid_mask)

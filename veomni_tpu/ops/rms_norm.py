@@ -1,0 +1,25 @@
+"""RMSNorm. Reference: ``veomni/ops/kernels/rms_norm/`` (Liger/Triton impls).
+
+On TPU, XLA fuses the reduction+rsqrt+scale chain into neighboring ops; a
+Pallas kernel buys nothing here, so "xla" is the only impl (the reference's
+batch-invariant Triton variant is moot — XLA is batch-invariant by design).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+
+@KERNEL_REGISTRY.register("rms_norm", "xla")
+def _rms_norm_xla(x, weight, eps: float = 1e-6):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    return resolve_op("rms_norm")(x, weight, eps)

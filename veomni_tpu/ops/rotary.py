@@ -1,0 +1,65 @@
+"""Rotary position embeddings (llama-style half-rotation, position-id driven).
+
+Reference: ``veomni/ops/kernels/rotary/`` — Liger / deterministic-Triton
+impls. Plain XLA here (fuses into the attention projections).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+
+def _scale_inv_freq(inv_freq, rope_scaling):
+    """Apply HF-style rope_scaling (llama3 / linear) to base frequencies."""
+    if not rope_scaling:
+        return inv_freq
+    rtype = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    factor = float(rope_scaling.get("factor", 1.0))
+    if rtype in ("linear",):
+        return inv_freq / factor
+    if rtype == "llama3":
+        low = float(rope_scaling.get("low_freq_factor", 1.0))
+        high = float(rope_scaling.get("high_freq_factor", 4.0))
+        orig = float(rope_scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2 * jnp.pi / inv_freq
+        # low-freq (long wavelength) fully scaled; high-freq untouched; smooth ramp between
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        return (1 - smooth) * scaled + smooth * inv_freq
+    if rtype in ("default", "dynamic", "yarn"):
+        return inv_freq  # dynamic/yarn: training-time tables use base freqs
+    raise ValueError(f"unsupported rope_scaling type {rtype!r}")
+
+
+def rotary_tables(positions, head_dim: int, theta: float = 10000.0, rope_scaling=None):
+    """positions [B,S] int -> (cos, sin) each [B,S,head_dim]."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    inv_freq = _scale_inv_freq(inv_freq, rope_scaling)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,S,D/2]
+    ang = jnp.concatenate([ang, ang], axis=-1)  # [B,S,D]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+@KERNEL_REGISTRY.register("rotary", "xla")
+def _apply_rotary_xla(q, k, cos, sin):
+    """q [B,S,Hq,D], k [B,S,Hk,D], cos/sin [B,S,D]."""
+    dtype = q.dtype
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    q_out = qf * cos + _rotate_half(qf) * sin
+    k_out = kf * cos + _rotate_half(kf) * sin
+    return q_out.astype(dtype), k_out.astype(dtype)
+
+
+def apply_rotary(q, k, cos, sin):
+    return resolve_op("rotary")(q, k, cos, sin)

@@ -1,0 +1,20 @@
+"""SwiGLU activation (silu(gate) * up). Reference: ``veomni/ops/kernels/swiglu/``
+(Liger fused CUDA). XLA fuses this elementwise chain into the surrounding
+matmuls on TPU, so the eager form *is* the fused form.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+
+@KERNEL_REGISTRY.register("swiglu", "xla")
+def _swiglu_xla(gate, up):
+    return jax.nn.silu(gate) * up
+
+
+def swiglu(gate, up):
+    return resolve_op("swiglu")(gate, up)

@@ -1,0 +1,4 @@
+from veomni_tpu.optim.optimizer import build_optimizer
+from veomni_tpu.optim.lr_scheduler import build_lr_scheduler
+
+__all__ = ["build_optimizer", "build_lr_scheduler"]

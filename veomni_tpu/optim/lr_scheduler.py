@@ -1,0 +1,36 @@
+"""LR schedules: constant / linear / cosine with warmup + min-lr floor.
+
+Reference: ``veomni/optim/lr_scheduler.py:58-190``. optax schedules are
+closed-form functions of the step — no .step() bookkeeping object.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def build_lr_scheduler(
+    lr_decay_style: str = "cosine",
+    *,
+    lr: float,
+    train_steps: int,
+    lr_warmup_ratio: float = 0.0,
+    lr_warmup_steps: int = 0,
+    lr_min: float = 0.0,
+    lr_start: float = 0.0,
+) -> optax.Schedule:
+    warmup = lr_warmup_steps or int(train_steps * lr_warmup_ratio)
+    decay_steps = max(train_steps - warmup, 1)
+    if lr_decay_style == "constant":
+        main = optax.constant_schedule(lr)
+    elif lr_decay_style == "linear":
+        main = optax.linear_schedule(lr, lr_min, decay_steps)
+    elif lr_decay_style == "cosine":
+        main = optax.cosine_decay_schedule(lr, decay_steps, alpha=lr_min / lr if lr else 0.0)
+    else:
+        raise ValueError(f"unknown lr_decay_style {lr_decay_style!r}")
+    if warmup:
+        return optax.join_schedules(
+            [optax.linear_schedule(lr_start, lr, warmup), main], [warmup]
+        )
+    return main

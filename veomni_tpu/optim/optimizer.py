@@ -1,0 +1,56 @@
+"""Optimizer builders (AdamW, adafactor; Muon in optim/muon.py).
+
+Reference: ``veomni/optim/optimizer.py:400`` (build_optimizer) — AdamW fused,
+AnyPrecisionAdamW, DistributedMuon, EP-aware param groups. On TPU the
+"fused" and "any-precision" variants are XLA-native (optax states can be cast
+via ``optax.adamw(mu_dtype=...)``); EP-aware grouping is unnecessary since
+sharding lives in PartitionSpecs, not param groups.
+
+Weight-decay masking follows the reference convention: no decay on 1-D
+params (norms, biases).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+
+
+def _decay_mask(params) -> Any:
+    return jax.tree.map(lambda p: p.ndim > 1, params)
+
+
+def build_optimizer(
+    params_or_abstract,
+    *,
+    optimizer: str = "adamw",
+    lr: float | Callable = 1e-5,
+    betas=(0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mu_dtype: Optional[str] = None,
+    fused: bool = True,  # accepted for config parity; XLA fuses regardless
+) -> optax.GradientTransformation:
+    if optimizer in ("adamw", "anyprecision_adamw"):
+        import jax.numpy as jnp
+
+        return optax.adamw(
+            learning_rate=lr,
+            b1=betas[0],
+            b2=betas[1],
+            eps=eps,
+            weight_decay=weight_decay,
+            mask=_decay_mask(params_or_abstract) if weight_decay else None,
+            mu_dtype=getattr(jnp, mu_dtype) if isinstance(mu_dtype, str) else mu_dtype,
+        )
+    if optimizer == "adafactor":
+        return optax.adafactor(learning_rate=lr)
+    if optimizer == "sgd":
+        return optax.sgd(learning_rate=lr)
+    if optimizer == "muon":
+        from veomni_tpu.optim.muon import build_muon
+
+        return build_muon(params_or_abstract, lr=lr, weight_decay=weight_decay)
+    raise ValueError(f"unknown optimizer {optimizer!r}")

@@ -1,0 +1,15 @@
+from veomni_tpu.parallel.parallel_state import (
+    ParallelState,
+    get_parallel_state,
+    init_parallel_state,
+    use_parallel_state,
+)
+from veomni_tpu.parallel.parallel_plan import ParallelPlan
+
+__all__ = [
+    "ParallelState",
+    "ParallelPlan",
+    "get_parallel_state",
+    "init_parallel_state",
+    "use_parallel_state",
+]

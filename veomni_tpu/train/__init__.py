@@ -1,0 +1,3 @@
+from veomni_tpu.train.train_step import TrainState, build_train_step, build_train_state
+
+__all__ = ["TrainState", "build_train_step", "build_train_state"]

@@ -1,0 +1,135 @@
+"""The jitted training step: grad accumulation, clipping, optimizer update.
+
+Reference hot loop: ``veomni/trainer/base.py:715-826`` (forward_backward per
+micro-batch with deferred FSDP reshard, then clip + optimizer step). TPU
+design: the *entire* optimizer step — a ``lax.scan`` over micro-batches
+accumulating token-sum gradients, global-norm clip, optax update — is one jit
+program. GSPMD schedules the FSDP all-gathers/reduce-scatters; the deferral
+and prefetch tricks of the reference are compiler-owned here
+(SURVEY.md §7.1 "grad accumulation" row).
+
+Loss/grad normalization follows the reference's ``mean_global_loss``: token
+sums are accumulated across micro-batches (and implicitly across dp/sp via
+GSPMD's replicated reduction of the scalar loss), and divided by the global
+valid-token count once — so packing imbalance never skews gradients.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veomni_tpu.parallel.parallel_plan import ParallelPlan
+from veomni_tpu.parallel.parallel_state import ParallelState
+from veomni_tpu.utils.env import env_bool
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array  # int32 scalar
+
+
+def build_train_state(params, optimizer: optax.GradientTransformation) -> TrainState:
+    return TrainState(params=params, opt_state=optimizer.init(params), step=jnp.int32(0))
+
+
+def resolve_state_shardings(
+    abstract_state: TrainState, plan: ParallelPlan, pstate: ParallelState
+) -> TrainState:
+    """Shard the whole TrainState by the plan: optimizer moments inherit the
+    param sharding via their path suffix (reference: FSDP2 shards optimizer
+    state implicitly because DTensor params flow into optimizer.init)."""
+
+    def _one(path, leaf):
+        from veomni_tpu.parallel.parallel_plan import param_path_str
+
+        spec = plan.spec_for(param_path_str(path), leaf.shape, pstate)
+        return NamedSharding(pstate.mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(_one, abstract_state)
+
+
+def build_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    pstate: ParallelState,
+    *,
+    state_shardings: Optional[TrainState] = None,
+    batch_shardings: Optional[Any] = None,
+    max_grad_norm: float = 1.0,
+) -> Callable:
+    """Returns jitted ``train_step(state, batch) -> (state, metrics)``.
+
+    ``loss_fn(params, micro_batch) -> (token_sum_loss, metrics_dict)`` where
+    metrics include 'ntokens'. ``batch`` leaves have a leading micro-batch
+    (grad-accum) dim A: [A, B, S].
+    """
+
+    def grads_one_micro(params, micro):
+        (loss_sum, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, micro)
+        return grads, loss_sum, metrics["ntokens"]
+
+    def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+        params = state.params
+
+        def accum(carry, micro):
+            g_acc, loss_acc, tok_acc = carry
+            g, l, n = grads_one_micro(params, micro)
+            g_acc = jax.tree.map(jnp.add, g_acc, g)
+            return (g_acc, loss_acc + l, tok_acc + n), None
+
+        zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (grads, loss_sum, ntokens), _ = jax.lax.scan(
+            accum, (zero_grads, jnp.float32(0.0), jnp.int32(0)), batch
+        )
+        denom = jnp.maximum(ntokens, 1).astype(jnp.float32)
+        grads = jax.tree.map(lambda g: g / denom, grads)
+        grad_norm = optax.global_norm(grads)
+        if max_grad_norm:
+            scale = jnp.minimum(1.0, max_grad_norm / (grad_norm + 1e-6))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, new_opt = optimizer.update(grads, state.opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_state = TrainState(params=new_params, opt_state=new_opt, step=state.step + 1)
+        metrics = {
+            "loss": loss_sum / denom,
+            "grad_norm": grad_norm,
+            "ntokens": ntokens,
+        }
+        return new_state, metrics
+
+    donate = (0,) if env_bool("VEOMNI_DONATE_STATE") else ()
+    metrics_shardings = None
+    if state_shardings is not None:
+        repl = NamedSharding(pstate.mesh, P())
+        metrics_shardings = {"loss": repl, "grad_norm": repl, "ntokens": repl}
+        return jax.jit(
+            step_fn,
+            in_shardings=(state_shardings, batch_shardings),
+            out_shardings=(state_shardings, metrics_shardings),
+            donate_argnums=donate,
+        )
+    return jax.jit(step_fn, donate_argnums=donate)
+
+
+def build_eval_step(loss_fn: Callable, state_shardings=None, batch_shardings=None):
+    def eval_fn(params, batch):
+        loss_sum, metrics = loss_fn(params, batch)
+        return {"loss": loss_sum / jnp.maximum(metrics["ntokens"], 1), **metrics}
+
+    if state_shardings is not None:
+        return jax.jit(
+            eval_fn, in_shardings=(state_shardings.params, batch_shardings)
+        )
+    return jax.jit(eval_fn)

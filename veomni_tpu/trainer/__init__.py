@@ -1,0 +1,4 @@
+from veomni_tpu.trainer.base import BaseTrainer
+from veomni_tpu.trainer.text_trainer import TextTrainer
+
+__all__ = ["BaseTrainer", "TextTrainer"]

@@ -1,0 +1,289 @@
+"""BaseTrainer: builds the full training stack and runs the loop.
+
+Reference: ``veomni/trainer/base.py:233-893``. Build sequence mirrors
+``__init__:299-343`` (setup -> model -> data -> parallelize -> optimizer ->
+callbacks); the hot loop (train_step w/ grad accum, clip, optimizer) is one
+jit program (see train/train_step.py). Trainer-free usage stays first-class:
+every ``_build_*`` piece is a plain function call (cf. the reference's linear
+``tasks/omni/train_omni_model.py``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veomni_tpu.arguments import VeOmniArguments
+from veomni_tpu.checkpoint import build_checkpointer
+from veomni_tpu.data.data_collator import TextPackingCollator
+from veomni_tpu.data.data_loader import build_dataloader
+from veomni_tpu.data.data_transform import build_data_transform
+from veomni_tpu.data.dataset import build_dataset
+from veomni_tpu.models import build_foundation_model, build_tokenizer
+from veomni_tpu.optim import build_lr_scheduler, build_optimizer
+from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+from veomni_tpu.train import build_train_state, build_train_step
+from veomni_tpu.train.train_step import resolve_state_shardings
+from veomni_tpu.trainer.callbacks import (
+    Callback,
+    CheckpointCallback,
+    EnvironMeterCallback,
+    HFCheckpointCallback,
+    LoggingCallback,
+    ProfileCallback,
+    TrainerControlState,
+    WandbCallback,
+)
+from veomni_tpu.utils.count_flops import FlopsCounter
+from veomni_tpu.utils.helper import EnvironMeter, set_seed
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+BATCH_KEYS = ("input_ids", "labels", "position_ids", "segment_ids")
+
+
+class BaseTrainer:
+    def __init__(self, args: VeOmniArguments):
+        self.args = args
+        self.current_batch: Optional[Dict[str, np.ndarray]] = None
+        self.meter: Optional[EnvironMeter] = None
+        self._setup()
+        with use_parallel_state(self.parallel_state):
+            self._build_model()
+            self._build_data_transform()
+            self._build_dataset()
+            self._build_dataloader()
+            self._build_parallelized_state()
+            self._init_callbacks()
+
+    # ------------------------------------------------------------------ setup
+    def _setup(self):
+        t = self.args.train
+        if jax.process_count() > 1:
+            pass  # jax.distributed.initialize is the launcher's job (multihost)
+        self.rng = set_seed(t.seed)
+        dp_replicate = t.data_parallel_replicate_size
+        if t.data_parallel_mode == "ddp":
+            dp_replicate = -1  # all non-sp/tp devices replicate
+        self.parallel_state = init_parallel_state(
+            dp_replicate_size=max(dp_replicate, 1),
+            dp_shard_size=t.data_parallel_shard_size,
+            ep_size=t.expert_parallel_size,
+            ulysses_size=t.ulysses_parallel_size,
+            cp_size=t.context_parallel_size,
+            tp_size=t.tensor_parallel_size,
+            pp_size=t.pipeline_parallel_size,
+        )
+        os.makedirs(t.output_dir, exist_ok=True)
+
+    def _build_model(self):
+        m = self.args.model
+        overrides = dict(m.config_overrides)
+        overrides.setdefault("dtype", self.args.train.compute_dtype)
+        overrides["remat"] = self.args.train.enable_gradient_checkpointing
+        if m.model_type:
+            overrides["model_type"] = m.model_type
+        ops_pins = dict(m.ops_implementation)
+        if m.attn_implementation not in ("auto", ""):
+            ops_pins["attention"] = m.attn_implementation
+        if m.moe_implementation not in ("auto", ""):
+            ops_pins["group_gemm"] = m.moe_implementation
+        self.model = build_foundation_model(
+            m.config_path or None,
+            config=None if m.config_path else self._toy_config(overrides),
+            ops_implementation=ops_pins,
+            **(overrides if m.config_path else {}),
+        )
+        # pretokenized data needs no tokenizer; don't fail on weights-only dirs
+        needs_tokenizer = self.args.data.data_type not in ("pretokenized",)
+        self.tokenizer = None
+        if m.tokenizer_path and needs_tokenizer:
+            self.tokenizer = build_tokenizer(m.tokenizer_path)
+
+    def _toy_config(self, overrides):
+        from veomni_tpu.models.config import TransformerConfig
+
+        return TransformerConfig(**overrides)
+
+    def _build_data_transform(self):
+        d = self.args.data
+        self.data_transform = build_data_transform(
+            d.data_type, tokenizer=self.tokenizer,
+            text_keys=d.text_keys, max_seq_len=d.max_seq_len,
+        )
+
+    def _build_dataset(self):
+        d = self.args.data
+        self.dataset = build_dataset(
+            d.dataset_type, path=d.train_path, transform=self.data_transform
+        )
+
+    def _build_dataloader(self):
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        # each process assembles only its slice of the global batch; the jit
+        # boundary stitches slices into the globally-sharded array
+        nproc = jax.process_count()
+        global_mb = t.micro_batch_size * ps.dp_size
+        if global_mb % nproc:
+            raise ValueError(
+                f"global micro batch {global_mb} not divisible by process count {nproc}"
+            )
+        local_mb = global_mb // nproc
+        collator = TextPackingCollator(
+            seq_len=d.max_seq_len,
+            micro_batch_size=local_mb,
+            sp_size=ps.sp_size,
+        )
+        self.dataloader = build_dataloader(
+            d.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=collator,
+            micro_batch_size=local_mb,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=max(1, d.samples_per_micro_batch * local_mb),
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            drop_last=d.drop_last,
+            infinite=True,
+        )
+
+    def _build_parallelized_state(self):
+        """Reference ``build_parallelize_model`` (torch_parallelize.py:546):
+        here = resolve plan -> shard-aligned init or HF load -> optimizer."""
+        t = self.args.train
+        ps = self.parallel_state
+        model = self.model
+        plan = model.get_parallel_plan()
+
+        steps = t.train_steps or max(1, len(self.dataloader) * t.num_train_epochs)
+        self.train_steps = steps
+        self.lr_schedule = build_lr_scheduler(
+            t.lr_decay_style, lr=t.lr, train_steps=steps,
+            lr_warmup_ratio=t.lr_warmup_ratio, lr_min=t.lr_min,
+        )
+        abstract_params = model.abstract()
+        self.optimizer = build_optimizer(
+            abstract_params, optimizer=t.optimizer, lr=self.lr_schedule,
+            betas=tuple(t.betas), weight_decay=t.weight_decay,
+        )
+
+        def make_state(rng):
+            return build_train_state(model.family.init_params(rng, model.config), self.optimizer)
+
+        abs_state = jax.eval_shape(make_state, self.rng)
+        self.state_shardings = resolve_state_shardings(abs_state, plan, ps)
+        self.abstract_state = abs_state
+
+        if self.args.model.model_path:
+            params = model.load_hf(
+                self.args.model.model_path,
+                target_shardings=self.state_shardings.params,
+            )
+            opt_state = jax.jit(
+                self.optimizer.init, out_shardings=self.state_shardings.opt_state
+            )(params)
+            from veomni_tpu.train.train_step import TrainState
+
+            self.train_state = TrainState(params=params, opt_state=opt_state, step=jnp.int32(0))
+        else:
+            self.train_state = jax.jit(make_state, out_shardings=self.state_shardings)(self.rng)
+
+        self.batch_shardings = {
+            k: NamedSharding(ps.mesh, P(None, ps.dp_axes, ps.sp_axes)) for k in BATCH_KEYS
+        }
+        loss_fn = lambda params, batch: model.loss_fn(params, batch)
+        self.train_step = build_train_step(
+            loss_fn, self.optimizer, ps,
+            state_shardings=self.state_shardings,
+            batch_shardings=self.batch_shardings,
+            max_grad_norm=t.max_grad_norm,
+        )
+        self.meter = EnvironMeter(
+            flops_counter=FlopsCounter.from_config(model.config),
+            world_size=ps.world_size,
+        )
+        self.checkpointer = build_checkpointer(
+            t.load_checkpoint_path or os.path.join(t.output_dir, "checkpoints"),
+            ckpt_manager=t.ckpt_manager,
+            async_save=t.async_save,
+            max_to_keep=t.max_ckpt_to_keep,
+        )
+
+    def _init_callbacks(self):
+        t = self.args.train
+        self.callbacks = [
+            EnvironMeterCallback(self.meter),
+            LoggingCallback(t.log_steps),
+            CheckpointCallback(self.checkpointer, t.save_steps),
+        ]
+        if t.enable_profiling:
+            self.callbacks.append(
+                ProfileCallback(t.output_dir, t.profile_start_step, t.profile_end_step)
+            )
+        if t.save_hf_weights:
+            self.callbacks.append(HFCheckpointCallback())
+        if t.use_wandb:
+            import dataclasses
+
+            self.callbacks.append(
+                WandbCallback(t.wandb_project, t.wandb_name,
+                              config=dataclasses.asdict(self.args))
+            )
+
+    # ----------------------------------------------------------------- resume
+    def try_resume(self):
+        restored, extra = self.checkpointer.load(
+            jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                self.abstract_state, self.state_shardings,
+            )
+        )
+        if restored is not None:
+            self.train_state = restored
+            logger.info_rank0("resumed from checkpoint")
+        return restored is not None, extra
+
+    # ------------------------------------------------------------------ train
+    def _fire(self, hook: str, state):
+        for cb in self.callbacks:
+            getattr(cb, hook)(self, state)
+
+    def train(self):
+        ctl = TrainerControlState(train_steps=self.train_steps)
+        with use_parallel_state(self.parallel_state):
+            self._fire("on_train_begin", ctl)
+            data_iter = iter(self.dataloader)
+            while ctl.global_step < self.train_steps and not ctl.should_stop:
+                batch_np = next(data_iter)
+                self.current_batch = batch_np
+                self._fire("on_step_begin", ctl)
+                if jax.process_count() > 1:
+                    # each process holds [A, B_local, S]; stitch into the
+                    # globally-sharded array (single-controller semantics)
+                    batch = {
+                        k: jax.make_array_from_process_local_data(
+                            self.batch_shardings[k], v
+                        )
+                        for k, v in batch_np.items() if k in self.batch_shardings
+                    }
+                else:
+                    batch = {
+                        k: jax.device_put(v, self.batch_shardings[k])
+                        for k, v in batch_np.items() if k in self.batch_shardings
+                    }
+                self.train_state, metrics = self.train_step(self.train_state, batch)
+                ctl.global_step += 1
+                ctl.metrics = {k: float(v) for k, v in metrics.items()}
+                ctl.metrics["lr"] = float(self.lr_schedule(ctl.global_step))
+                self._fire("on_step_end", ctl)
+            self._fire("on_train_end", ctl)
+        return ctl

@@ -1,0 +1,175 @@
+"""Trainer callbacks: hooks + the stock set.
+
+Reference: ``veomni/trainer/callbacks/`` — TrainerState + hook protocol
+(base.py:26-60), EnvironMeterCallback, TqdmCallback, CheckpointerCallback,
+HuggingfaceCkptCallback, ProfileTraceCallback, WandbTraceCallback.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class TrainerControlState:
+    """Mutable loop state shared with callbacks (reference TrainerState)."""
+
+    global_step: int = 0
+    train_steps: int = 0
+    epoch: int = 0
+    metrics: Dict[str, float] = field(default_factory=dict)
+    should_stop: bool = False
+
+
+class Callback:
+    def on_train_begin(self, trainer, state: TrainerControlState):
+        pass
+
+    def on_train_end(self, trainer, state: TrainerControlState):
+        pass
+
+    def on_step_begin(self, trainer, state: TrainerControlState):
+        pass
+
+    def on_step_end(self, trainer, state: TrainerControlState):
+        pass
+
+
+class LoggingCallback(Callback):
+    def __init__(self, log_steps: int = 1):
+        self.log_steps = log_steps
+
+    def on_step_end(self, trainer, state):
+        if state.global_step % self.log_steps == 0:
+            parts = [f"step {state.global_step}/{state.train_steps}"]
+            for k in ("loss", "grad_norm", "lr", "tokens_per_sec_per_chip", "mfu"):
+                if k in state.metrics:
+                    v = state.metrics[k]
+                    parts.append(f"{k}={v:.4g}")
+            logger.info_rank0(" | ".join(parts))
+
+
+class EnvironMeterCallback(Callback):
+    """Feeds the MFU meter (reference EnvironMeterCallback)."""
+
+    def __init__(self, meter):
+        self.meter = meter
+
+    def on_step_begin(self, trainer, state):
+        batch = trainer.current_batch
+        if batch is not None:
+            labels = batch["labels"]
+            ntokens = int((labels != -100).sum())
+            self.meter.add(ntokens, seq_len=labels.shape[-1])
+
+    def on_step_end(self, trainer, state):
+        state.metrics.update(self.meter.step())
+
+
+class CheckpointCallback(Callback):
+    """Periodic sharded train-state save + exact resume
+    (reference CheckpointerCallback, checkpoint_callback.py:35-170)."""
+
+    def __init__(self, checkpointer, save_steps: int = 0):
+        self.checkpointer = checkpointer
+        self.save_steps = save_steps
+
+    def _extra_state(self, trainer, state) -> Dict[str, Any]:
+        return {
+            "global_step": state.global_step,
+            "epoch": state.epoch,
+            "dataloader": trainer.dataloader.state_dict()
+            if hasattr(trainer.dataloader, "state_dict")
+            else None,
+            "meter": trainer.meter.state_dict() if trainer.meter else None,
+        }
+
+    def on_train_begin(self, trainer, state):
+        if not trainer.args.train.auto_resume:
+            return
+        restored, extra = trainer.try_resume()
+        if restored and extra:
+            state.global_step = int(extra.get("global_step", 0))
+            state.epoch = int(extra.get("epoch", 0))
+            if extra.get("dataloader") and hasattr(trainer.dataloader, "load_state_dict"):
+                trainer.dataloader.load_state_dict(extra["dataloader"])
+            if extra.get("meter") and trainer.meter:
+                trainer.meter.load_state_dict(extra["meter"])
+
+    def on_step_end(self, trainer, state):
+        if self.save_steps and state.global_step % self.save_steps == 0:
+            self.checkpointer.save(
+                state.global_step, trainer.train_state, self._extra_state(trainer, state)
+            )
+
+    def on_train_end(self, trainer, state):
+        self.checkpointer.save(
+            state.global_step, trainer.train_state, self._extra_state(trainer, state)
+        )
+        self.checkpointer.wait()
+
+
+class HFCheckpointCallback(Callback):
+    """HF-format safetensors export at end of training
+    (reference HuggingfaceCkptCallback)."""
+
+    def on_train_end(self, trainer, state):
+        if jax.process_index() != 0:
+            return
+        out = os.path.join(trainer.args.train.output_dir, "hf_ckpt")
+        trainer.model.save_hf(out, params=trainer.train_state.params)
+
+
+class ProfileCallback(Callback):
+    """jax.profiler trace over [start_step, end_step)
+    (reference ProfileTraceCallback -> chrome trace; here Perfetto/XPlane)."""
+
+    def __init__(self, output_dir: str, start_step: int = 3, end_step: int = 5):
+        self.dir = os.path.join(output_dir, "profile_trace")
+        self.start = start_step
+        self.end = end_step
+        self._active = False
+
+    def on_step_begin(self, trainer, state):
+        if state.global_step == self.start and not self._active:
+            os.makedirs(self.dir, exist_ok=True)
+            jax.profiler.start_trace(self.dir)
+            self._active = True
+
+    def on_step_end(self, trainer, state):
+        if state.global_step >= self.end and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info_rank0("profile trace written to %s", self.dir)
+
+    def on_train_end(self, trainer, state):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+class WandbCallback(Callback):
+    def __init__(self, project: str, name: str = "", config: Optional[dict] = None):
+        self._run = None
+        try:
+            import wandb
+
+            self._run = wandb.init(project=project, name=name or None, config=config)
+        except Exception as e:  # wandb not installed / no network
+            logger.warning_rank0("wandb disabled: %s", e)
+
+    def on_step_end(self, trainer, state):
+        if self._run is not None:
+            self._run.log(state.metrics, step=state.global_step)
+
+    def on_train_end(self, trainer, state):
+        if self._run is not None:
+            self._run.finish()

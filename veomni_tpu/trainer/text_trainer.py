@@ -1,0 +1,13 @@
+"""TextTrainer: chat-template / plaintext text SFT-pretrain trainer.
+
+Reference: ``veomni/trainer/text_trainer.py:38`` — a thin specialization of
+BaseTrainer wiring the text data path; everything heavy lives in base.
+"""
+
+from __future__ import annotations
+
+from veomni_tpu.trainer.base import BaseTrainer
+
+
+class TextTrainer(BaseTrainer):
+    pass

@@ -1,0 +1,4 @@
+from veomni_tpu.utils.logging import get_logger
+from veomni_tpu.utils.registry import Registry
+
+__all__ = ["get_logger", "Registry"]

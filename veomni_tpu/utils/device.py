@@ -1,0 +1,85 @@
+"""Device abstraction layer (reference: ``veomni/utils/device.py:28-123``).
+
+On the reference this switches CUDA vs Ascend-NPU; here it abstracts over TPU
+generations and the CPU fallback used for tests (virtual multi-device CPU via
+``--xla_force_host_platform_device_count``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def get_device_type() -> str:
+    """"tpu" | "gpu" | "cpu". The experimental "axon" tunnel platform reports
+    TPU devices, so we classify by device kind rather than platform name."""
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    platform = getattr(dev, "platform", "").lower()
+    if "tpu" in kind or platform == "tpu" or platform == "axon":
+        return "tpu"
+    if platform in ("gpu", "cuda", "rocm"):
+        return "gpu"
+    return "cpu"
+
+
+def is_tpu_available() -> bool:
+    return get_device_type() == "tpu"
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def synchronize() -> None:
+    """Block until all dispatched device work is done (cf. torch.cuda.synchronize)."""
+    # A tiny transfer drains the dispatch queue on every local device.
+    for d in jax.local_devices():
+        jax.device_put(0.0, d).block_until_ready()
+
+
+@functools.lru_cache(maxsize=None)
+def get_device_peak_flops(dtype: str = "bf16") -> float:
+    """Peak FLOP/s per chip (cf. reference ``count_flops.py:25`` get_device_flops).
+
+    Values are the published bf16 dense peak numbers per chip.
+    """
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    table = {
+        # TPU generations (bf16 peak per chip)
+        "tpu v2": 45e12,
+        "tpu v3": 123e12,
+        "tpu v4": 275e12,
+        "tpu v5 lite": 197e12,  # v5e
+        "tpu v5e": 197e12,
+        "tpu v5": 459e12,  # v5p
+        "tpu v5p": 459e12,
+        "tpu v6 lite": 918e12,  # trillium
+        "tpu v6e": 918e12,
+        "tpu7x": 4614e12,
+    }
+    for key in sorted(table, key=len, reverse=True):
+        if kind.startswith(key):
+            return table[key]
+    if get_device_type() == "cpu":
+        return 1e12  # nominal, keeps MFU math finite in tests
+    return 197e12
+
+
+def mesh_devices_grid(shape: Tuple[int, ...]):
+    """Devices reshaped to ``shape`` for building a Mesh; validates count."""
+    import numpy as np
+
+    devs = np.array(jax.devices())
+    n = int(np.prod(shape))
+    if n != devs.size:
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {devs.size}")
+    return devs.reshape(shape)

@@ -1,0 +1,108 @@
+"""Training observability: EnvironMeter (MFU, tokens/sec) + misc helpers.
+
+Reference: ``veomni/utils/helper.py:158-308`` (EnvironMeter) — per-step
+achieved-vs-promised FLOPs -> MFU, tokens/sec, consumed tokens, memory stats.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+
+from veomni_tpu.utils.count_flops import FlopsCounter
+from veomni_tpu.utils.device import get_device_peak_flops
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class EnvironMeter:
+    """Accumulates per-step tokens/FLOPs and derives MFU + throughput.
+
+    Unlike the reference (which all-reduces across ranks), a JAX single-
+    controller program sees global batch stats directly; multi-process setups
+    pass ``global_ntokens`` already summed (the data pipeline knows the global
+    batch composition).
+    """
+
+    flops_counter: Optional[FlopsCounter] = None
+    world_size: int = 1
+    empty_cache_steps: int = 0
+    consumed_tokens: int = 0
+    _step_tokens: int = 0
+    _step_seq_len: int = 0
+    _t_start: float = field(default_factory=time.perf_counter)
+
+    def add(self, ntokens: int, seq_len: int) -> None:
+        self._step_tokens += int(ntokens)
+        self._step_seq_len = max(self._step_seq_len, int(seq_len))
+
+    def step(self) -> Dict[str, float]:
+        now = time.perf_counter()
+        dt = max(now - self._t_start, 1e-9)
+        tokens = self._step_tokens
+        self.consumed_tokens += tokens
+        metrics: Dict[str, float] = {
+            "tokens_per_sec": tokens / dt,
+            "tokens_per_sec_per_chip": tokens / dt / max(1, self.world_size),
+            "step_time_s": dt,
+            "consumed_tokens": float(self.consumed_tokens),
+        }
+        if self.flops_counter is not None and tokens:
+            achieved = self.flops_counter.batch_flops(tokens, self._step_seq_len or tokens)
+            peak = get_device_peak_flops() * max(1, self.world_size)
+            metrics["tflops"] = achieved / dt / 1e12
+            metrics["mfu"] = 100.0 * achieved / dt / peak
+        self._step_tokens = 0
+        self._step_seq_len = 0
+        self._t_start = time.perf_counter()
+        return metrics
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"consumed_tokens": self.consumed_tokens}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.consumed_tokens = int(state.get("consumed_tokens", 0))
+
+
+def set_seed(seed: int) -> "jax.Array":
+    """Returns the root PRNG key; also seeds numpy/python for data pipeline."""
+    import random
+
+    import numpy as np
+
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return jax.random.PRNGKey(seed)
+
+
+def enable_full_determinism(seed: int) -> "jax.Array":
+    """XLA:TPU is deterministic given fixed seeds and shapes; this is the thin
+    shim the reference's cublas/cudnn knobs reduce to on TPU
+    (reference ``utils/helper.py:425-463``)."""
+    return set_seed(seed)
+
+
+def pretty_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024:
+            return f"{n:.2f}{unit}"
+        n /= 1024
+    return f"{n:.2f}PB"
+
+
+def live_memory_stats() -> Dict[str, float]:
+    """Per-device live buffer bytes (cf. torch.cuda.memory_allocated)."""
+    stats = {}
+    for i, d in enumerate(jax.local_devices()):
+        try:
+            ms = d.memory_stats()
+            if ms:
+                stats[f"device{i}_bytes_in_use"] = float(ms.get("bytes_in_use", 0))
+        except Exception:
+            pass
+    return stats

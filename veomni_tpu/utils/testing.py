@@ -1,0 +1,15 @@
+"""Test/dry-run helpers."""
+
+from __future__ import annotations
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Run on N virtual CPU devices (call before any JAX backend use).
+
+    The axon TPU plugin overrides JAX_PLATFORMS via jax.config at import, so
+    env vars alone don't stick — we must update the config directly.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
