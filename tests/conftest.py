@@ -1,7 +1,7 @@
-"""Test harness: all tests run on a virtual 8-device CPU mesh.
+"""Test harness: all tests run on a virtual 4-device CPU mesh.
 
 Mirrors the reference's mp.spawn+gloo fallback strategy (SURVEY.md §4): the
-collective/sharding logic runs on CPU with 8 virtual devices; numerics match
+collective/sharding logic runs on CPU with 4 virtual devices; numerics match
 TPU because XLA semantics are backend-uniform. NOTE: the axon TPU plugin
 force-registers itself via jax.config, so we must override *config*, not
 just env vars, before first backend use.
@@ -10,11 +10,28 @@ just env vars, before first backend use.
 import os
 
 os.environ.setdefault("VEOMNI_LOG_LEVEL", "WARNING")
+# This box exposes 1 physical core for the virtual devices: XLA:CPU
+# collective rendezvous can exceed its default 40s termination timeout under
+# load and SIGABRT the process. Give the rendezvous generous timeouts.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=600"
+    + " --xla_cpu_collective_timeout_seconds=600"
+)
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_num_cpu_devices", 4)
+# With several virtual devices on a 1-core box, async dispatch lets several
+# executions be in flight; their collective rendezvous can starve each other
+# of pool threads and deadlock (observed SIGABRT in rendezvous.cc). Run CPU
+# executions synchronously — one program in flight at a time.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+# NOTE: do NOT enable the persistent compilation cache here — reloading
+# cached executables with in-process CPU collectives has been observed to
+# deadlock the rendezvous on this box (cold runs pass, warm runs hang).
 
 import pytest  # noqa: E402
 

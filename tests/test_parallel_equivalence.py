@@ -1,0 +1,114 @@
+"""Parallelism must not change math (reference test_e2e_parallel.py /
+test_fsdp_equivalence.py): identical loss + grad_norm across mesh layouts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _toy_cfg(moe: bool = False):
+    from veomni_tpu.models.config import TransformerConfig
+
+    kw = dict(
+        model_type="qwen3_moe" if moe else "qwen3",
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        qk_norm=True,
+        dtype=jnp.float32,
+    )
+    if moe:
+        kw.update(num_experts=4, num_experts_per_tok=2, moe_intermediate_size=64)
+    return TransformerConfig(**kw)
+
+
+def _batch(bsz=8, seq=64, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (bsz, seq))
+    seg = np.ones((bsz, seq), np.int32)
+    seg[:, seq // 2:] = 2  # two packed segments per row
+    pos = np.concatenate(
+        [np.arange(seq // 2), np.arange(seq - seq // 2)]
+    )
+    return {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(ids, jnp.int32),
+        "position_ids": jnp.asarray(np.broadcast_to(pos, (bsz, seq)).copy(), jnp.int32),
+        "segment_ids": jnp.asarray(seg),
+    }
+
+
+def _loss_and_gnorm(cfg, mesh_kwargs, batch):
+    import optax
+
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+
+    destroy_parallel_state()
+    ps = init_parallel_state(**mesh_kwargs)
+    model = build_foundation_model(config=cfg)
+    with use_parallel_state(ps):
+        params = model.init(jax.random.PRNGKey(0))
+        plan = model.get_parallel_plan()
+        shardings = plan.resolve(params, ps)
+        params = jax.jit(lambda p: p, out_shardings=shardings)(params)
+        batch_sharding = {k: ps.batch_sharding() for k in batch}
+        batch = {k: jax.device_put(v, batch_sharding[k]) for k, v in batch.items()}
+
+        def norm_loss(p, b):
+            loss_sum, metrics = model.loss_fn(p, b)
+            return loss_sum / jnp.maximum(metrics["ntokens"], 1)
+
+        loss, grads = jax.jit(jax.value_and_grad(norm_loss))(params, batch)
+        gnorm = jax.jit(optax.global_norm)(grads)
+        return float(loss), float(gnorm)
+
+
+@pytest.mark.parametrize("moe", [False, True], ids=["dense", "moe"])
+def test_sp_ep_equivalence(moe):
+    """(sp, ep) in {1,2}x{1,2} all produce identical loss/grad_norm."""
+    cfg = _toy_cfg(moe)
+    batch = _batch()
+    base = _loss_and_gnorm(cfg, dict(dp_shard_size=4), batch)
+    layouts = [dict(ulysses_size=2, dp_shard_size=2)]
+    if moe:
+        layouts += [
+            dict(ep_size=2, dp_shard_size=4),
+            dict(ulysses_size=2, ep_size=2, dp_shard_size=2),
+        ]
+    for kw in layouts:
+        got = _loss_and_gnorm(cfg, kw, batch)
+        np.testing.assert_allclose(got[0], base[0], rtol=2e-5, err_msg=f"loss {kw}")
+        np.testing.assert_allclose(got[1], base[1], rtol=2e-4, err_msg=f"gnorm {kw}")
+
+
+def test_ulysses_attention_matches_local():
+    """Ulysses a2a attention == single-device attention on the same inputs."""
+    from veomni_tpu.ops.attention import _attention_xla
+    from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+    from veomni_tpu.parallel.sequence_parallel import ulysses_attention
+
+    rng = jax.random.PRNGKey(0)
+    b, s, hq, hkv, d = 2, 32, 8, 4, 16
+    qk, kk, vk = jax.random.split(rng, 3)
+    q = jax.random.normal(qk, (b, s, hq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(vk, (b, s, hkv, d), jnp.float32)
+    seg = jnp.concatenate(
+        [jnp.ones((b, s // 2), jnp.int32), jnp.full((b, s // 2), 2, jnp.int32)], axis=1
+    )
+    ref = _attention_xla(q, k, v, segment_ids=seg, causal=True)
+
+    ps = init_parallel_state(ulysses_size=4, dp_shard_size=1)
+    with use_parallel_state(ps):
+        got = jax.jit(
+            lambda *a: ulysses_attention(_attention_xla, *a, pstate=ps, causal=True)
+        )(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5)

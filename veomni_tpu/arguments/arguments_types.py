@@ -48,6 +48,10 @@ class DataArguments:
 @dataclass
 class TrainingArguments:
     output_dir: str = "output"
+    # force a JAX platform ("cpu" etc.; "" = default). With num_virtual_devices
+    # this enables multi-device CPU simulation runs of the full CLI.
+    platform: str = ""
+    num_virtual_devices: int = 0
     # batch geometry
     micro_batch_size: int = 1
     global_batch_size: int = 0        # 0 -> micro * dp_size (no grad accum)

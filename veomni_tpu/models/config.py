@@ -47,6 +47,8 @@ class TransformerConfig:
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
     router_aux_loss_coef: float = 0.001
+    # EP dispatch capacity factor; <= 0 means dropless (see parallel/moe.py)
+    moe_capacity_factor: float = 0.0
     # numerics
     dtype: Any = jnp.bfloat16       # activation/compute dtype
     param_dtype: Any = jnp.float32  # master param dtype

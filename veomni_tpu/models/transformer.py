@@ -133,11 +133,10 @@ def _activation_constraint():
     """Pin [B,S,H] activations to (dp, sp, None) so GSPMD keeps FSDP
     semantics (gather weights, never reshard activations onto fsdp axes).
     No-op when no ParallelState is active (pure single-device use)."""
-    from veomni_tpu.parallel.parallel_state import get_parallel_state
+    from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
 
-    try:
-        ps = get_parallel_state()
-    except RuntimeError:
+    ps = get_parallel_state_or_none()
+    if ps is None:
         return lambda x: x
     sharding = ps.sharding(ps.dp_axes, ps.sp_axes, None)
     return lambda x: jax.lax.with_sharding_constraint(x, sharding)
@@ -172,8 +171,16 @@ def _decoder_layer(hidden, lp, *, cfg: TransformerConfig, cos, sin, segment_ids)
     hidden = constrain(hidden)
     x = ops.rms_norm(hidden, lp["post_attention_layernorm"], cfg.rms_norm_eps)
     if cfg.is_moe:
-        out, aux = _moe_mlp(x.reshape(b * s, h), lp, cfg)
-        out = out.reshape(b, s, h)
+        from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
+
+        ps = get_parallel_state_or_none()
+        if ps is not None and ps.ep_enabled:
+            from veomni_tpu.parallel.moe import ep_moe_mlp
+
+            out, aux = ep_moe_mlp(x, lp, cfg, ps)
+        else:
+            out, aux = _moe_mlp(x.reshape(b * s, h), lp, cfg)
+            out = out.reshape(b, s, h)
     else:
         out = jnp.dot(ops.swiglu(jnp.dot(x, lp["gate_proj"]), jnp.dot(x, lp["up_proj"])),
                       lp["down_proj"])

@@ -14,6 +14,7 @@ from veomni_tpu.ops import attention as _attention  # noqa: F401
 from veomni_tpu.ops import cross_entropy as _cross_entropy  # noqa: F401
 from veomni_tpu.ops import load_balancing as _load_balancing  # noqa: F401
 from veomni_tpu.ops import group_gemm as _group_gemm  # noqa: F401
+from veomni_tpu.ops import pallas as _pallas  # noqa: F401  (registers TPU kernels)
 
 rms_norm = _rms_norm.rms_norm
 apply_rotary = _rotary.apply_rotary

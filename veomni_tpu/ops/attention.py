@@ -74,7 +74,16 @@ def attention(
     softmax_scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
 ):
-    return resolve_op("attention")(
-        q, k, v, segment_ids=segment_ids, causal=causal,
-        softmax_scale=softmax_scale, sliding_window=sliding_window,
-    )
+    """SP-aware facade (reference ``ops/kernels/attention/__init__.py:30-86``):
+    under an ambient ParallelState with ulysses > 1, wraps the resolved
+    kernel in the Ulysses a2a shard_map."""
+    inner = resolve_op("attention")
+    kwargs = dict(causal=causal, softmax_scale=softmax_scale, sliding_window=sliding_window)
+    from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
+
+    pstate = get_parallel_state_or_none()
+    if pstate is not None and pstate.ulysses_size > 1:
+        from veomni_tpu.parallel.sequence_parallel import ulysses_attention
+
+        return ulysses_attention(inner, q, k, v, segment_ids, pstate, **kwargs)
+    return inner(q, k, v, segment_ids=segment_ids, **kwargs)

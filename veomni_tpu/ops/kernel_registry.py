@@ -27,8 +27,14 @@ class KernelSpec:
     device_types: Tuple[str, ...] = ("any",)
     priority: int = 0  # higher wins
     name: str = ""
+    requires_pallas: bool = False
 
     def available(self) -> bool:
+        if self.requires_pallas:
+            from veomni_tpu.utils.device import supports_pallas
+
+            if not supports_pallas():
+                return False
         if "any" in self.device_types:
             return True
         return get_device_type() in self.device_types
@@ -46,10 +52,12 @@ class _KernelRegistry:
         *,
         device_types: Tuple[str, ...] = ("any",),
         priority: int = 0,
+        requires_pallas: bool = False,
     ):
         def _do(fn):
             self._ops.setdefault(op_name, {})[impl_name] = KernelSpec(
-                fn=fn, device_types=device_types, priority=priority, name=impl_name
+                fn=fn, device_types=device_types, priority=priority,
+                name=impl_name, requires_pallas=requires_pallas,
             )
             return fn
 

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels (the in-tree native layer).
+
+Reference counterpart: ``veomni/ops/kernels/`` Triton/TileLang kernels.
+Importing this package registers the Pallas impls into KERNEL_REGISTRY with
+priority over the XLA-eager fallbacks on TPU.
+"""
+
+from veomni_tpu.ops.pallas import flash_attention as _flash_attention  # noqa: F401

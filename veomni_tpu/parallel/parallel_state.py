@@ -256,6 +256,15 @@ def parallel_state_initialized(name: str = "base") -> bool:
     return name in _REGISTRY
 
 
+def get_parallel_state_or_none() -> Optional[ParallelState]:
+    """Ambient state, or None when no mesh has been initialized (pure
+    single-device use) — the probe used by ops/model code paths."""
+    try:
+        return get_parallel_state()
+    except RuntimeError:
+        return None
+
+
 @contextlib.contextmanager
 def use_parallel_state(state_or_name):
     """Scope the ambient ParallelState (reference ``use_parallel_state``)."""

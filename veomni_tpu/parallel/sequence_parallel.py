@@ -1,0 +1,101 @@
+"""Ulysses sequence parallelism: all-to-all head-scatter / seq-gather.
+
+Reference: ``veomni/distributed/sequence_parallel/ulysses.py:34-403``
+(_SeqAllToAll custom autograd Functions around flash attention) and the
+SP-aware attention facade ``ops/kernels/attention/ulysses.py:27-91``.
+
+TPU design (SURVEY.md §7.1): one ``shard_map`` region over the mesh in which
+``jax.lax.all_to_all`` swaps the head and sequence dims across the
+``ulysses`` axis — JAX AD transposes the collective automatically, so the
+reference's four hand-written autograd Functions collapse into this single
+wrapper. The GQA head-repeat (when ulysses_size > kv_heads) mirrors
+``attention/ulysses.py:42-48``.
+
+Loss reduction over SP ranks (reference ``sequence_parallel/loss.py``) needs
+no counterpart: the loss is a token *sum* computed on globally-sharded
+arrays inside jit — GSPMD inserts the psum.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from veomni_tpu.parallel.parallel_state import AXIS_ULYSSES, ParallelState
+
+
+def _repeat_heads(x, factor: int):
+    if factor == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, factor, d)).reshape(
+        b, s, h * factor, d
+    )
+
+
+def ulysses_attention(
+    inner_attention: Callable,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: Optional[jax.Array],
+    pstate: ParallelState,
+    **attn_kwargs,
+):
+    """q [B, S, Hq, D] / k,v [B, S, Hkv, D] globally shaped, sequence-sharded
+    over the sp axes. Inside the shard_map each rank trades its sequence
+    slice for a head slice (a2a), runs full-sequence attention on Hq/sp
+    heads, and trades back. Returns [B, S, Hq, D] with the same sharding.
+    """
+    sp = pstate.ulysses_size
+    if sp == 1:
+        return inner_attention(q, k, v, segment_ids=segment_ids, **attn_kwargs)
+
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % sp:
+        raise ValueError(f"num_attention_heads {hq} must be divisible by ulysses {sp}")
+    # GQA: repeat kv heads up to a multiple of sp (reference ulysses.py:42-48)
+    kv_rep = sp // math.gcd(hkv, sp)
+
+    dp, spx = pstate.dp_axes, pstate.sp_axes
+    qkv_spec = P(dp, spx, None, None)
+    seg_spec = P(dp, spx) if segment_ids is not None else None
+
+    def body(q, k, v, seg):
+        # local shapes: [b, s/sp, h, d]
+        k = _repeat_heads(k, kv_rep)
+        v = _repeat_heads(v, kv_rep)
+        # heads -> scattered, seq -> gathered
+        a2a = partial(
+            jax.lax.all_to_all, axis_name=AXIS_ULYSSES, tiled=True
+        )
+        q_g = a2a(q, split_axis=2, concat_axis=1)   # [b, s, hq/sp, d]
+        k_g = a2a(k, split_axis=2, concat_axis=1)
+        v_g = a2a(v, split_axis=2, concat_axis=1)
+        seg_g = None
+        if seg is not None:
+            seg_g = jax.lax.all_gather(seg, AXIS_ULYSSES, axis=1, tiled=True)  # [b, s]
+        out = inner_attention(q_g, k_g, v_g, segment_ids=seg_g, **attn_kwargs)
+        return a2a(out, split_axis=1, concat_axis=2)  # [b, s/sp, hq, d]
+
+    in_specs = (qkv_spec, qkv_spec, qkv_spec, seg_spec)
+    fn = shard_map(
+        body,
+        mesh=pstate.mesh,
+        in_specs=in_specs,
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, segment_ids)
+
+
+def sp_pad_length(seq_len: int, sp_size: int) -> int:
+    """Pad target so the sequence divides evenly across SP ranks (reference
+    ``sp_pad_and_slice``, sequence_parallel/data.py)."""
+    return (-seq_len) % sp_size

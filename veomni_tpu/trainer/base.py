@@ -65,6 +65,29 @@ class BaseTrainer:
     # ------------------------------------------------------------------ setup
     def _setup(self):
         t = self.args.train
+        if t.num_virtual_devices and not t.platform:
+            logger.warning_rank0(
+                "train.num_virtual_devices is ignored without train.platform "
+                "(set platform: cpu for virtual-mesh simulation)"
+            )
+        if t.platform:
+            # must run before first backend use (the axon TPU plugin overrides
+            # JAX_PLATFORMS via jax.config, so env vars alone don't stick)
+            updates = [("jax_platforms", t.platform)]
+            if t.num_virtual_devices:
+                updates.append(("jax_num_cpu_devices", t.num_virtual_devices))
+            if t.platform == "cpu":
+                # many virtual devices on few cores: in-flight executions can
+                # starve the collective rendezvous of pool threads (deadlock)
+                updates.append(("jax_cpu_enable_async_dispatch", False))
+            for key, val in updates:
+                try:
+                    jax.config.update(key, val)
+                except Exception as e:
+                    logger.warning_rank0(
+                        "could not apply %s=%r (backends already initialized?): %s",
+                        key, val, e,
+                    )
         if jax.process_count() > 1:
             pass  # jax.distributed.initialize is the launcher's job (multihost)
         self.rng = set_seed(t.seed)

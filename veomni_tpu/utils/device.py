@@ -31,6 +31,25 @@ def is_tpu_available() -> bool:
     return get_device_type() == "tpu"
 
 
+@functools.lru_cache(maxsize=None)
+def supports_pallas() -> bool:
+    """Whether Pallas kernels can actually execute here.
+
+    The experimental "axon" relay platform accepts pallas_call lowering but
+    hangs at execution (observed: even a trivial VMEM copy kernel never
+    returns), so Pallas is gated off there. CPU supports interpret mode.
+    Override with VEOMNI_AXON_PALLAS=1 to re-test on axon.
+    """
+    import os
+
+    dev = jax.devices()[0]
+    if getattr(dev, "platform", "") == "axon" or "axon" in str(
+        getattr(dev, "client", "")
+    ):
+        return os.environ.get("VEOMNI_AXON_PALLAS") == "1"
+    return True
+
+
 def device_count() -> int:
     return jax.device_count()
 
