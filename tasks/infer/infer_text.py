@@ -1,0 +1,79 @@
+"""Interactive/greedy text generation entry.
+
+Reference: ``tasks/infer/infer_text.py:26-49`` — single-process inference
+(serving at scale is explicitly out of scope for the reference too; RL
+rollout integrates external engines). Greedy decode with a jitted
+fixed-shape step (KV-cache-free re-scoring for simplicity at small lengths).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.arguments import VeOmniArguments, parse_args
+from veomni_tpu.models import build_foundation_model, build_tokenizer
+from veomni_tpu.models.transformer import forward_logits
+
+
+def generate(model, params, input_ids, max_new_tokens: int = 64, eos_id: int = -1):
+    """Greedy generation over a fixed window (re-runs the full prefix each
+    step; fine for interactive use — a KV-cache decode loop is the serving
+    engine's job)."""
+    cfg = model.config
+    ids = list(map(int, input_ids))
+    total = len(ids) + max_new_tokens
+
+    @jax.jit
+    def score(tokens, length):
+        pos = jnp.arange(total)
+        logits = forward_logits(
+            params, cfg, tokens[None], pos[None],
+            jnp.where(jnp.arange(total) < length, 1, 0)[None],
+        )
+        return logits[0, length - 1]
+
+    tokens = jnp.zeros((total,), jnp.int32).at[: len(ids)].set(jnp.asarray(ids))
+    for step in range(max_new_tokens):
+        length = len(ids)
+        nxt = int(jnp.argmax(score(tokens, length)))
+        ids.append(nxt)
+        tokens = tokens.at[length].set(nxt)
+        if nxt == eos_id:
+            break
+    return ids
+
+
+def main():
+    args = parse_args(VeOmniArguments)
+    m, t = args.model, args.train
+    if t.platform:
+        jax.config.update("jax_platforms", t.platform)
+    config = None
+    if not m.config_path:
+        from veomni_tpu.models.auto import build_config
+
+        overrides = dict(m.config_overrides)
+        config = build_config(overrides.pop("model_type", ""), **overrides)
+    model = build_foundation_model(
+        m.config_path or None, config=config, weights_path=m.model_path or None
+    )
+    if model.params is None:
+        model.init(jax.random.PRNGKey(0))
+    tokenizer = build_tokenizer(m.tokenizer_path) if m.tokenizer_path else None
+    print("enter prompt (ctrl-d to exit):")
+    for line in sys.stdin:
+        prompt = line.strip()
+        if not prompt:
+            continue
+        ids = tokenizer(prompt)["input_ids"] if tokenizer else [int(x) for x in prompt.split()]
+        out = generate(model, model.params, ids,
+                       eos_id=tokenizer.eos_token_id if tokenizer else -1)
+        print(tokenizer.decode(out) if tokenizer else out)
+
+
+if __name__ == "__main__":
+    main()

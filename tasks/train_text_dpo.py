@@ -1,0 +1,20 @@
+"""Text DPO training entry point (reference: ``tasks/train_text_dpo.py``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from veomni_tpu.arguments import VeOmniArguments, parse_args, save_args
+from veomni_tpu.trainer.dpo_trainer import TextDPOTrainer
+
+
+def main():
+    args = parse_args(VeOmniArguments)
+    save_args(args, args.train.output_dir)
+    trainer = TextDPOTrainer(args)
+    trainer.train()
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,10 @@ class ModelArguments:
     ops_implementation: Dict[str, str] = field(default_factory=dict)  # op -> impl pin
     # tiny-model construction without config.json (tests/toy configs)
     config_overrides: Dict[str, Any] = field(default_factory=dict)
+    # LoRA: {} disables; {"rank": 8, "alpha": 16, ...} -> LoraConfig fields
+    lora: Dict[str, Any] = field(default_factory=dict)
+    # resume adapter-only checkpoint from this dir ("" = fresh adapters)
+    lora_adapter_path: str = ""
 
     def __post_init__(self):
         if not self.tokenizer_path:
@@ -73,6 +77,7 @@ class TrainingArguments:
     weight_decay: float = 0.0
     betas: List[float] = field(default_factory=lambda: [0.9, 0.999])
     max_grad_norm: float = 1.0
+    dpo_beta: float = 0.1
     # schedule/steps
     train_steps: int = 0              # 0 -> derive from epochs * len(dataloader)
     num_train_epochs: int = 1

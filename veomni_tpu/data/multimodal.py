@@ -1,0 +1,140 @@
+"""Multimodal data pipeline: image transforms + VLM collator.
+
+Reference: ``veomni/data/multimodal/`` (image/video/audio loading,
+multimodal chat template, per-VLM transforms) and the model-provided
+metadata collate hooks (``data/data_collator.py`` DataCollateInfo).
+
+TPU-first contract (static shapes): each micro-batch row is one padded
+sample; images occupy fixed slots ``[B, max_images, grid^2, patch_dim]``
+with a validity mask. The transform expands every image into
+``tokens_per_image`` placeholder tokens inline with the text.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from veomni_tpu.data.data_collator import IGNORE_INDEX
+from veomni_tpu.data.data_transform import DATA_TRANSFORM_REGISTRY
+from veomni_tpu.models.vision import ViTConfig
+
+
+def load_image(source, image_size: int) -> np.ndarray:
+    """Accepts ndarray [H,W,C], nested lists, or a file path; returns
+    float32 [image_size, image_size, 3] in [0, 1]."""
+    if isinstance(source, str):
+        from PIL import Image
+
+        img = Image.open(source).convert("RGB").resize((image_size, image_size))
+        return np.asarray(img, np.float32) / 255.0
+    arr = np.asarray(source, np.float32)
+    if arr.max() > 1.5:
+        arr = arr / 255.0
+    if arr.shape[:2] != (image_size, image_size):
+        # nearest-neighbor resize without PIL dependency
+        ys = (np.linspace(0, arr.shape[0] - 1, image_size)).astype(np.int64)
+        xs = (np.linspace(0, arr.shape[1] - 1, image_size)).astype(np.int64)
+        arr = arr[ys][:, xs]
+    return arr
+
+
+def images_to_patches_np(images: np.ndarray, cfg: ViTConfig) -> np.ndarray:
+    """[N,H,W,C] float -> [N, grid^2, patch_dim] normalized (numpy twin of
+    models/vision.images_to_patches, run in the data pipeline)."""
+    n = images.shape[0]
+    p, g, c = cfg.patch_size, cfg.grid, cfg.num_channels
+    x = (images - 0.5) / 0.5
+    x = x.reshape(n, g, p, g, p, c).transpose(0, 1, 3, 2, 4, 5).reshape(n, g * g, p * p * c)
+    return x.astype(np.float32)
+
+
+@DATA_TRANSFORM_REGISTRY.register("vlm")
+def build_vlm_transform(
+    tokenizer=None,
+    *,
+    vision_config: Optional[ViTConfig] = None,
+    image_token_id: int = 151655,
+    max_seq_len: int = 0,
+    max_images: int = 4,
+    text_keys: str = "text",
+    **_,
+):
+    """Rows: {"text"| "input_ids", "images": [HWC arrays or paths]}.
+    '<image>' markers in text (or leading placement) expand to
+    tokens_per_image placeholder ids; labels mask image positions. Images
+    beyond ``max_images`` (the collator's static slot count) are dropped
+    here so placeholders and slots stay consistent."""
+    vcfg = vision_config or ViTConfig()
+    t_img = vcfg.tokens_per_image
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        images = [
+            load_image(im, vcfg.image_size)
+            for im in row.get("images", [])[:max_images]
+        ]
+        if "input_ids" in row:
+            text_ids: List[int] = list(row["input_ids"])
+        else:
+            text_ids = tokenizer(row[text_keys], add_special_tokens=True)["input_ids"]
+        ids: List[int] = []
+        labels: List[int] = []
+        # images lead the sequence (qwen-vl style when no inline markers)
+        for _ in images:
+            ids.extend([image_token_id] * t_img)
+            labels.extend([IGNORE_INDEX] * t_img)
+        ids.extend(text_ids)
+        labels.extend(list(row.get("labels", text_ids)))
+        if max_seq_len:
+            ids, labels = ids[:max_seq_len], labels[:max_seq_len]
+        patches = (
+            images_to_patches_np(np.stack(images), vcfg)
+            if images
+            else np.zeros((0, vcfg.grid ** 2, vcfg.num_channels * vcfg.patch_size ** 2), np.float32)
+        )
+        return {"input_ids": ids, "labels": labels, "pixel_patches": patches}
+
+    return transform
+
+
+class VLMCollator:
+    """Pads samples to [B, S] (no cross-sample packing: image-position
+    bookkeeping stays trivial) + fixed image slots with mask."""
+
+    def __init__(self, seq_len: int, micro_batch_size: int, vision_config: ViTConfig,
+                 max_images: int = 4, sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError(f"seq_len {seq_len} % sp_size {sp_size} != 0")
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+        self.vcfg = vision_config
+        self.max_images = max_images
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        b, s = self.micro_batch_size, self.seq_len
+        vp = self.vcfg.grid ** 2
+        pd = self.vcfg.num_channels * self.vcfg.patch_size ** 2
+        out = {
+            "input_ids": np.zeros((b, s), np.int32),
+            "labels": np.full((b, s), IGNORE_INDEX, np.int32),
+            "position_ids": np.zeros((b, s), np.int32),
+            "segment_ids": np.zeros((b, s), np.int32),
+            "pixel_patches": np.zeros((b, self.max_images, vp, pd), np.float32),
+            "image_mask": np.zeros((b, self.max_images), bool),
+        }
+        for i, sample in enumerate(samples[:b]):
+            ids = np.asarray(sample["input_ids"], np.int32)[:s]
+            lab = np.asarray(sample["labels"], np.int32)[: len(ids)]
+            shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
+            n = len(ids)
+            out["input_ids"][i, :n] = ids
+            out["labels"][i, :n] = shifted
+            out["position_ids"][i, :n] = np.arange(n)
+            out["segment_ids"][i, :n] = 1
+            patches = sample.get("pixel_patches")
+            if patches is not None and len(patches):
+                k = min(len(patches), self.max_images)
+                out["pixel_patches"][i, :k] = patches[:k]
+                out["image_mask"][i, :k] = True
+        return out

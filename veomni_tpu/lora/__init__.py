@@ -1,0 +1,15 @@
+from veomni_tpu.lora.config import LoraConfig
+from veomni_tpu.lora.lora import (
+    apply_lora_to_loss_fn,
+    init_lora_params,
+    lora_parallel_plan_rules,
+    merge_lora_params,
+)
+
+__all__ = [
+    "LoraConfig",
+    "apply_lora_to_loss_fn",
+    "init_lora_params",
+    "lora_parallel_plan_rules",
+    "merge_lora_params",
+]

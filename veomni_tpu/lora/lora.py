@@ -1,0 +1,164 @@
+"""Functional LoRA: adapters as a parallel pytree merged inside jit.
+
+Reference: ``veomni/lora/`` (PEFT-free native LoRA — LoraLinear injection
+``layers.py:112``, MoE expert LoRA ``moe_layers.py`` wrapping fused expert
+params with EP-sharded adapter tensors, fused kernels in ``lora/ops/``).
+
+TPU-first re-design: because models here are *pure functions over a param
+pytree*, LoRA needs **no module wrapping or model changes at all** — the
+adapters are a parallel pytree ``{path: {lora_a, lora_b}}`` and training runs
+the base model on ``W_eff = W + (alpha/r) * A @ B``, with gradients taken
+only w.r.t. the adapter tree (the base tree is a frozen closure). The rank-r
+matmul fuses into the surrounding ops under XLA, which is exactly what the
+reference's fused LoRA-MoE kernels hand-implement.
+
+MoE expert LoRA falls out for free: expert tensors ``[L, E, in, out]`` get
+batched adapters ``A [L, E, in, r]`` / ``B [L, E, r, out]``, and the same
+ParallelPlan rules shard the adapter's expert dim over ``ep``
+(cf. reference LoraIndependentExperts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.lora.config import LoraConfig
+from veomni_tpu.parallel.parallel_plan import param_path_str
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _match(cfg: LoraConfig, path: str) -> Optional[Tuple[int, float]]:
+    for pattern, ov in cfg.overrides.items():
+        if re.search(pattern, path):
+            return int(ov.get("rank", cfg.rank)), float(ov.get("alpha", cfg.alpha))
+    for pattern in cfg.target_patterns:
+        if re.search(pattern, path):
+            return cfg.rank, cfg.alpha
+    return None
+
+
+def init_lora_params(rng: jax.Array, base_params, cfg: LoraConfig):
+    """Build the adapter pytree: {matched path -> {lora_a, lora_b}} nested
+    like the base tree. A ~ N(0, 0.02), B = 0 (standard LoRA init)."""
+    leaves = []
+
+    def _build(path, leaf):
+        p = param_path_str(path)
+        m = _match(cfg, p)
+        if m is None or leaf.ndim < 2:
+            return None
+        rank, alpha = m
+        *batch, fan_in, fan_out = leaf.shape
+        key = jax.random.fold_in(rng, len(leaves))
+        leaves.append(p)
+        a = jax.random.normal(key, (*batch, fan_in, rank), jnp.float32) * 0.02
+        b = jnp.zeros((*batch, rank, fan_out), jnp.float32)
+        return {"lora_a": a.astype(leaf.dtype), "lora_b": b.astype(leaf.dtype),
+                "scale": jnp.asarray(alpha / rank, jnp.float32)}
+
+    tree = jax.tree_util.tree_map_with_path(_build, base_params)
+    # prune unmatched (None) subtrees
+    tree = _prune_none(tree)
+    logger.info_rank0("LoRA adapters on %d tensors (rank=%d)", len(leaves), cfg.rank)
+    return tree
+
+
+def _prune_none(tree):
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            if isinstance(v, dict) and "lora_a" not in v:
+                sub = _prune_none(v)
+                if sub:
+                    out[k] = sub
+            elif v is not None:
+                out[k] = v
+        return out
+    return tree
+
+
+def merge_lora_params(base_params, lora_params):
+    """W_eff = W + scale * A @ B for adapted leaves (runs inside jit)."""
+
+    def _merge(base_sub, lora_sub):
+        if isinstance(lora_sub, dict) and "lora_a" in lora_sub:
+            a = lora_sub["lora_a"].astype(jnp.float32)
+            b = lora_sub["lora_b"].astype(jnp.float32)
+            delta = jnp.matmul(a, b) * lora_sub["scale"]
+            return (base_sub.astype(jnp.float32) + delta).astype(base_sub.dtype)
+        if isinstance(lora_sub, dict):
+            return {
+                k: _merge(base_sub[k], lora_sub[k]) if k in lora_sub else base_sub[k]
+                for k in base_sub
+            }
+        return base_sub
+
+    if not lora_params:
+        return base_params
+    return _merge(base_params, lora_params)
+
+
+def apply_lora_to_loss_fn(loss_fn: Callable, base_params) -> Callable:
+    """loss_fn(params, batch) -> lora_loss_fn(lora_params, batch).
+
+    The base tree rides along as a closed-over constant (frozen: no gradient,
+    no optimizer state — the trainable surface is the adapter tree only,
+    reference ``trainer/base.py:411-462`` freeze + LoRA setup)."""
+
+    def lora_loss(lora_params, batch):
+        merged = merge_lora_params(base_params, lora_params)
+        return loss_fn(merged, batch)
+
+    return lora_loss
+
+
+def lora_parallel_plan_rules() -> Dict[str, tuple]:
+    """Adapter sharding: expert-batched adapters follow the expert plan."""
+    return {
+        r"layers\.experts\..*\.lora_a$": ("ep", "ep_fsdp", None),
+        r"layers\.experts\..*\.lora_b$": ("ep", None, None),
+        r"\.scale$": (),
+    }
+
+
+# ------------------------------------------------------------------ save/load
+def save_adapter(lora_params, cfg: LoraConfig, out_dir: str) -> None:
+    """Adapter-only checkpoint (reference LoRA trainable_only save)."""
+    from safetensors.flax import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    flat = {}
+
+    def _flatten(path, leaf):
+        flat[param_path_str(path)] = jax.device_get(leaf)
+
+    jax.tree_util.tree_map_with_path(_flatten, lora_params)
+    save_file({k: jnp.asarray(v) for k, v in flat.items()},
+              os.path.join(out_dir, "adapter_model.safetensors"))
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump({"rank": cfg.rank, "alpha": cfg.alpha,
+                   "target_patterns": cfg.target_patterns}, f, indent=2)
+    logger.info_rank0("saved LoRA adapter to %s (%d tensors)", out_dir, len(flat))
+
+
+def load_adapter(adapter_dir: str, abstract_lora):
+    """Restore an adapter tree saved by save_adapter."""
+    import safetensors
+
+    with safetensors.safe_open(
+        os.path.join(adapter_dir, "adapter_model.safetensors"), framework="flax"
+    ) as f:
+        flat = {k: f.get_tensor(k) for k in f.keys()}
+
+    def _restore(path, leaf):
+        return jnp.asarray(flat[param_path_str(path)], leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(_restore, abstract_lora)

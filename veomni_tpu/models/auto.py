@@ -56,6 +56,70 @@ for _mt in ("llama", "qwen2", "qwen3", "qwen3_moe"):
     MODEL_REGISTRY.register(_mt, ModelFamily(model_type=_mt))
 
 
+def _register_vlm_families():
+    from veomni_tpu.models import vlm as vlm_mod
+    from veomni_tpu.models.vlm import VLMConfig
+
+    def _save_native(params, cfg, out_dir):
+        """Native flat-safetensors save for composite models (HF-layout VLM
+        export is a follow-up; the language_model subtree additionally gets a
+        standard HF export)."""
+        import os
+
+        from safetensors.flax import save_file
+
+        from veomni_tpu.parallel.parallel_plan import param_path_str
+
+        os.makedirs(out_dir, exist_ok=True)
+        flat = {}
+        jax.tree_util.tree_map_with_path(
+            lambda p, x: flat.__setitem__(param_path_str(p), jax.device_get(x)), params
+        )
+        save_file(flat, f"{out_dir}/model.safetensors")
+        hf_io.save_hf_checkpoint(params["language_model"], cfg.text, f"{out_dir}/language_model")
+
+    for mt in ("qwen2_vl", "qwen2_5_vl", "qwen3_vl"):
+        MODEL_REGISTRY.register(
+            mt,
+            ModelFamily(
+                model_type=mt,
+                config_cls=VLMConfig,
+                init_params=vlm_mod.init_vlm_params,
+                abstract_params=vlm_mod.abstract_vlm_params,
+                loss_fn=vlm_mod.vlm_loss_fn,
+                forward_logits=None,
+                hf_to_params=None,
+                save_hf_checkpoint=_save_native,
+            ),
+        )
+
+
+_register_vlm_families()
+
+VLM_MODEL_TYPES = ("qwen2_vl", "qwen2_5_vl", "qwen3_vl")
+
+
+def build_config(model_type: str = "", **overrides):
+    """Construct the right config class for a model_type (VLM vs text).
+
+    For VLM types, top-level non-VLM keys (dtype, remat, ...) flow into the
+    nested text config so the same override surface works for both.
+    """
+    overrides.pop("model_type", None)
+    if model_type in VLM_MODEL_TYPES:
+        from veomni_tpu.models.vlm import VLMConfig
+
+        vlm_kw = {
+            k: overrides.pop(k)
+            for k in ("vision", "image_token_id", "freeze_vision")
+            if k in overrides
+        }
+        text = dict(overrides.pop("text", {}) or {})
+        text.update(overrides)
+        return VLMConfig(model_type=model_type, text=text, **vlm_kw)
+    return TransformerConfig(model_type=model_type or "llama", **overrides)
+
+
 @dataclass
 class FoundationModel:
     """What build_foundation_model returns: config + family + (lazy) params."""
@@ -78,6 +142,11 @@ class FoundationModel:
         return self.family.get_parallel_plan(self.config)
 
     def load_hf(self, model_dir: str, target_shardings=None):
+        if self.family.hf_to_params is None:
+            raise NotImplementedError(
+                f"HF checkpoint import not wired for {self.family.model_type}; "
+                "load the native safetensors export instead"
+            )
         self.params = self.family.hf_to_params(model_dir, self.config, target_shardings)
         return self.params
 

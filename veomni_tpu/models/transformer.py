@@ -194,10 +194,18 @@ def forward_hidden(
     input_ids: jax.Array,          # [B,S] int32
     position_ids: jax.Array,       # [B,S] int32
     segment_ids: Optional[jax.Array] = None,  # [B,S] int32
+    inputs_embeds: Optional[jax.Array] = None,  # [B,S,H] overrides embedding
 ) -> Tuple[jax.Array, jax.Array]:
-    """Returns (final_hidden [B,S,H] in cfg.dtype, moe_aux_loss scalar)."""
+    """Returns (final_hidden [B,S,H] in cfg.dtype, moe_aux_loss scalar).
+
+    ``inputs_embeds`` lets composite models (VLM/omni) inject merged
+    multimodal embeddings while sharing the decoder stack."""
     compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
-    hidden = compute["embed_tokens"][input_ids]
+    hidden = (
+        inputs_embeds.astype(cfg.dtype)
+        if inputs_embeds is not None
+        else compute["embed_tokens"][input_ids]
+    )
     cos, sin = ops.rotary_tables(
         position_ids, cfg.head_dim, cfg.rope_theta, rope_scaling=cfg.rope_scaling
     )
@@ -229,6 +237,45 @@ def forward_logits(params, cfg, input_ids, position_ids, segment_ids=None):
     return jnp.dot(hidden, kernel, preferred_element_type=jnp.float32)
 
 
+def sequence_logprob_sums(
+    params: Params,
+    cfg: TransformerConfig,
+    batch: Dict[str, jax.Array],
+) -> jax.Array:
+    """Per-row sum of label log-probs [B] (the per-sample logit gather of the
+    reference RL/DPO trainers, ``base_rl_trainer.py:15-113``)."""
+    hidden, _ = forward_hidden(
+        params, cfg, batch["input_ids"], batch["position_ids"], batch.get("segment_ids")
+    )
+    kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
+
+    def row_nll(h_row, l_row):
+        loss_sum, _ = ops.fused_linear_cross_entropy(h_row, kernel, l_row)
+        return loss_sum
+
+    nll = jax.vmap(row_nll)(hidden, batch["labels"])
+    return -nll
+
+
+def head_loss(
+    params: Params, cfg: TransformerConfig, hidden: jax.Array, labels: jax.Array,
+    moe_aux: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """lm-head + CE in token-sum space, shared by text/VLM/omni loss fns."""
+    b, s, h = hidden.shape
+    kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
+    loss_sum, ntokens = ops.fused_linear_cross_entropy(
+        hidden.reshape(b * s, h), kernel, labels.reshape(b * s)
+    )
+    metrics = {"loss_sum": loss_sum, "ntokens": ntokens, "moe_aux_loss": moe_aux}
+    total = loss_sum
+    if cfg.is_moe and cfg.router_aux_loss_coef:
+        # aux loss is per-token-mean-like already; scale by token count to stay
+        # in sum space so dp/sp reduction normalizes both terms identically.
+        total = total + cfg.router_aux_loss_coef * moe_aux * ntokens
+    return total, metrics
+
+
 def loss_fn(
     params: Params,
     cfg: TransformerConfig,
@@ -242,15 +289,4 @@ def loss_fn(
     hidden, moe_aux = forward_hidden(
         params, cfg, batch["input_ids"], batch["position_ids"], batch.get("segment_ids")
     )
-    b, s, h = hidden.shape
-    kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
-    loss_sum, ntokens = ops.fused_linear_cross_entropy(
-        hidden.reshape(b * s, h), kernel, batch["labels"].reshape(b * s)
-    )
-    metrics = {"loss_sum": loss_sum, "ntokens": ntokens, "moe_aux_loss": moe_aux}
-    total = loss_sum
-    if cfg.is_moe and cfg.router_aux_loss_coef:
-        # aux loss is per-token-mean-like already; scale by token count to stay
-        # in sum space so dp/sp reduction normalizes both terms identically.
-        total = total + cfg.router_aux_loss_coef * moe_aux * ntokens
-    return total, metrics
+    return head_loss(params, cfg, hidden, batch["labels"], moe_aux)

@@ -1,0 +1,143 @@
+"""Native ViT vision encoder (functional, scan-over-layers).
+
+Reference capability: the vision towers inside
+``veomni/models/transformers/qwen2_vl`` / ``qwen3_vl`` (patch embed ->
+transformer blocks with full attention -> spatial merger projecting into the
+LLM embedding space). TPU-first simplifications:
+
+* fixed patch grid per image (config.image_size / patch_size), so every
+  image contributes a *static* number of tokens — XLA-friendly, and it also
+  subsumes the reference's ``dummy_forward`` deadlock prevention
+  (``qwen3_vl/generated/...:1312``): every rank runs the vision tower on its
+  (possibly all-padding) image slots each step, keeping collectives aligned
+  by construction.
+* full (non-causal) attention via the shared ``ops.attention`` facade;
+  per-layer params stacked for ``lax.scan`` like the text core.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu import ops
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    num_channels: int = 3
+    hidden_size: int = 256
+    intermediate_size: int = 1024
+    num_hidden_layers: int = 4
+    num_attention_heads: int = 4
+    layer_norm_eps: float = 1e-6
+    spatial_merge_size: int = 2  # 2x2 patch merge before projection
+    out_hidden_size: int = 1024  # LLM hidden size (projector output)
+    initializer_range: float = 0.02
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def tokens_per_image(self) -> int:
+        return (self.grid // self.spatial_merge_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def _layer_norm(x, weight, bias, eps):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    x = (x - mu) * jax.lax.rsqrt(var + eps)
+    return (x * weight + bias).astype(dtype)
+
+
+def init_vit_params(rng: jax.Array, cfg: ViTConfig, dtype=jnp.float32) -> Dict[str, Any]:
+    s = cfg.initializer_range
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    L = cfg.num_hidden_layers
+    keys = iter(jax.random.split(rng, 16))
+    patch_dim = cfg.num_channels * cfg.patch_size ** 2
+    merge_dim = h * cfg.spatial_merge_size ** 2
+
+    def init(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
+
+    return {
+        "patch_embed": init(next(keys), (patch_dim, h)),
+        "pos_embed": init(next(keys), (cfg.grid ** 2, h)),
+        "layers": {
+            "ln1_w": jnp.ones((L, h), dtype), "ln1_b": jnp.zeros((L, h), dtype),
+            "qkv": init(next(keys), (L, h, 3 * h)),
+            "qkv_bias": jnp.zeros((L, 3 * h), dtype),
+            "proj": init(next(keys), (L, h, h)),
+            "ln2_w": jnp.ones((L, h), dtype), "ln2_b": jnp.zeros((L, h), dtype),
+            "fc1": init(next(keys), (L, h, inter)),
+            "fc1_b": jnp.zeros((L, inter), dtype),
+            "fc2": init(next(keys), (L, inter, h)),
+            "fc2_b": jnp.zeros((L, h), dtype),
+        },
+        "merger": {
+            "ln_w": jnp.ones((h,), dtype), "ln_b": jnp.zeros((h,), dtype),
+            "fc1": init(next(keys), (merge_dim, merge_dim)),
+            "fc1_b": jnp.zeros((merge_dim,), dtype),
+            "fc2": init(next(keys), (merge_dim, cfg.out_hidden_size)),
+            "fc2_b": jnp.zeros((cfg.out_hidden_size,), dtype),
+        },
+    }
+
+
+def _vit_layer(x, lp, cfg: ViTConfig):
+    n, t, h = x.shape
+    y = _layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.layer_norm_eps)
+    qkv = jnp.dot(y, lp["qkv"]) + lp["qkv_bias"]
+    q, k, v = jnp.split(qkv.reshape(n, t, 3 * cfg.num_attention_heads, cfg.head_dim), 3, axis=2)
+    attn = ops.attention(q, k, v, causal=False)
+    x = x + jnp.dot(attn.reshape(n, t, h), lp["proj"])
+    y = _layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.layer_norm_eps)
+    y = jax.nn.gelu(jnp.dot(y, lp["fc1"]) + lp["fc1_b"])
+    return x + jnp.dot(y, lp["fc2"]) + lp["fc2_b"], None
+
+
+def vit_forward(params, cfg: ViTConfig, pixel_patches: jax.Array) -> jax.Array:
+    """pixel_patches [N_img, grid*grid, patch_dim] -> [N_img, tokens_per_image,
+    out_hidden_size]."""
+    x = jnp.dot(pixel_patches.astype(params["patch_embed"].dtype), params["patch_embed"])
+    x = x + params["pos_embed"]
+
+    layer = partial(_vit_layer, cfg=cfg)
+    x, _ = jax.lax.scan(lambda c, lp: layer(c, lp), x, params["layers"])
+
+    # spatial 2x2 merge: [N, g, g, h] -> [N, g/m * g/m, m*m*h]
+    n = x.shape[0]
+    g, m = cfg.grid, cfg.spatial_merge_size
+    h = cfg.hidden_size
+    x = x.reshape(n, g // m, m, g // m, m, h).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, (g // m) ** 2, m * m * h)
+    mg = params["merger"]
+    x = _layer_norm(x, jnp.tile(mg["ln_w"], m * m), jnp.tile(mg["ln_b"], m * m),
+                    cfg.layer_norm_eps)
+    x = jax.nn.gelu(jnp.dot(x, mg["fc1"]) + mg["fc1_b"])
+    return jnp.dot(x, mg["fc2"]) + mg["fc2_b"]
+
+
+def images_to_patches(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[N, H, W, C] uint8/float -> [N, grid*grid, patch_dim] normalized."""
+    n, hh, ww, c = images.shape
+    p = cfg.patch_size
+    g = cfg.grid
+    x = images.astype(jnp.float32) / 255.0 if images.dtype == jnp.uint8 else images.astype(jnp.float32)
+    x = (x - 0.5) / 0.5
+    x = x.reshape(n, g, p, g, p, c).transpose(0, 1, 3, 2, 4, 5).reshape(n, g * g, p * p * c)
+    return x

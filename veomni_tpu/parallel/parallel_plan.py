@@ -133,9 +133,9 @@ class ParallelPlan:
                     )
                     axes = None
             resolved.append(axes)
-        if stacked:
+        if stacked and len(shape) >= 1:
             resolved = [None] + resolved
-        return P(*resolved)
+        return P(*resolved[: len(shape)])
 
     def resolve(self, params, state: ParallelState):
         """params (pytree of arrays or ShapeDtypeStructs) -> pytree of NamedSharding."""

@@ -78,21 +78,27 @@ def build_train_step(
 
     def grads_one_micro(params, micro):
         (loss_sum, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, micro)
-        return grads, loss_sum, metrics["ntokens"]
+        extras = {
+            k: v.astype(jnp.float32)
+            for k, v in metrics.items()
+            if k not in ("ntokens",) and jnp.ndim(v) == 0
+        }
+        return grads, loss_sum, metrics["ntokens"], extras
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         params = state.params
 
         def accum(carry, micro):
             g_acc, loss_acc, tok_acc = carry
-            g, l, n = grads_one_micro(params, micro)
+            g, l, n, ex = grads_one_micro(params, micro)
             g_acc = jax.tree.map(jnp.add, g_acc, g)
-            return (g_acc, loss_acc + l, tok_acc + n), None
+            return (g_acc, loss_acc + l, tok_acc + n), ex
 
         zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        (grads, loss_sum, ntokens), _ = jax.lax.scan(
+        (grads, loss_sum, ntokens), extras_stacked = jax.lax.scan(
             accum, (zero_grads, jnp.float32(0.0), jnp.int32(0)), batch
         )
+        extras = jax.tree.map(lambda x: x.mean(0), extras_stacked)
         denom = jnp.maximum(ntokens, 1).astype(jnp.float32)
         grads = jax.tree.map(lambda g: g / denom, grads)
         grad_norm = optax.global_norm(grads)
@@ -106,18 +112,18 @@ def build_train_step(
             "loss": loss_sum / denom,
             "grad_norm": grad_norm,
             "ntokens": ntokens,
+            # auxiliary scalar metrics from the loss fn (e.g. dpo_acc),
+            # averaged over micro-steps
+            **extras,
         }
         return new_state, metrics
 
     donate = (0,) if env_bool("VEOMNI_DONATE_STATE") else ()
-    metrics_shardings = None
     if state_shardings is not None:
-        repl = NamedSharding(pstate.mesh, P())
-        metrics_shardings = {"loss": repl, "grad_norm": repl, "ntokens": repl}
         return jax.jit(
             step_fn,
             in_shardings=(state_shardings, batch_shardings),
-            out_shardings=(state_shardings, metrics_shardings),
+            out_shardings=(state_shardings, None),
             donate_argnums=donate,
         )
     return jax.jit(step_fn, donate_argnums=donate)

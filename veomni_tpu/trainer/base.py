@@ -130,9 +130,11 @@ class BaseTrainer:
             self.tokenizer = build_tokenizer(m.tokenizer_path)
 
     def _toy_config(self, overrides):
-        from veomni_tpu.models.config import TransformerConfig
+        from veomni_tpu.models.auto import build_config
 
-        return TransformerConfig(**overrides)
+        return build_config(overrides.get("model_type", ""), **{
+            k: v for k, v in overrides.items() if k != "model_type"
+        })
 
     def _build_data_transform(self):
         d = self.args.data
@@ -165,19 +167,33 @@ class BaseTrainer:
             micro_batch_size=local_mb,
             sp_size=ps.sp_size,
         )
-        self.dataloader = build_dataloader(
-            d.dataloader_type,
-            dataset=self.dataset,
-            collate_fn=collator,
-            micro_batch_size=local_mb,
-            grad_accum_steps=self.grad_accum_steps,
-            samples_per_micro_batch=max(1, d.samples_per_micro_batch * local_mb),
-            seed=t.seed,
-            dp_rank=jax.process_index(),
-            dp_size=nproc,
-            drop_last=d.drop_last,
-            infinite=True,
-        )
+        if d.dyn_bsz:
+            from veomni_tpu.data.dynamic_batching import DynamicBatchDataloader
+
+            self.dataloader = DynamicBatchDataloader(
+                self.dataset,
+                collator,
+                token_budget=local_mb * d.max_seq_len,
+                grad_accum_steps=self.grad_accum_steps,
+                buffer_size=d.dyn_bsz_buffer_size,
+                seed=t.seed,
+                dp_rank=jax.process_index(),
+                dp_size=nproc,
+            )
+        else:
+            self.dataloader = build_dataloader(
+                d.dataloader_type,
+                dataset=self.dataset,
+                collate_fn=collator,
+                micro_batch_size=local_mb,
+                grad_accum_steps=self.grad_accum_steps,
+                samples_per_micro_batch=max(1, d.samples_per_micro_batch * local_mb),
+                seed=t.seed,
+                dp_rank=jax.process_index(),
+                dp_size=nproc,
+                drop_last=d.drop_last,
+                infinite=True,
+            )
 
     def _build_parallelized_state(self):
         """Reference ``build_parallelize_model`` (torch_parallelize.py:546):
@@ -193,37 +209,72 @@ class BaseTrainer:
             t.lr_decay_style, lr=t.lr, train_steps=steps,
             lr_warmup_ratio=t.lr_warmup_ratio, lr_min=t.lr_min,
         )
-        abstract_params = model.abstract()
-        self.optimizer = build_optimizer(
-            abstract_params, optimizer=t.optimizer, lr=self.lr_schedule,
-            betas=tuple(t.betas), weight_decay=t.weight_decay,
-        )
-
-        def make_state(rng):
-            return build_train_state(model.family.init_params(rng, model.config), self.optimizer)
-
-        abs_state = jax.eval_shape(make_state, self.rng)
-        self.state_shardings = resolve_state_shardings(abs_state, plan, ps)
-        self.abstract_state = abs_state
-
-        if self.args.model.model_path:
-            params = model.load_hf(
-                self.args.model.model_path,
-                target_shardings=self.state_shardings.params,
+        def _make_optimizer(abstract_trainable):
+            return build_optimizer(
+                abstract_trainable, optimizer=t.optimizer, lr=self.lr_schedule,
+                betas=tuple(t.betas), weight_decay=t.weight_decay,
             )
+
+        from veomni_tpu.lora import LoraConfig
+        from veomni_tpu.train.train_step import TrainState
+
+        self.lora_config = LoraConfig.from_dict(self.args.model.lora)
+
+        def make_base(rng):
+            return model.family.init_params(rng, model.config)
+
+        param_shardings = resolve_state_shardings(
+            jax.eval_shape(make_base, self.rng), plan, ps
+        )
+        if self.args.model.model_path:
+            base_params = model.load_hf(
+                self.args.model.model_path, target_shardings=param_shardings
+            )
+        else:
+            base_params = jax.jit(make_base, out_shardings=param_shardings)(self.rng)
+
+        if self.lora_config is not None:
+            # frozen base + trainable adapter tree (reference base.py:411-462)
+            from veomni_tpu.lora import apply_lora_to_loss_fn, init_lora_params
+            from veomni_tpu.lora.lora import load_adapter, lora_parallel_plan_rules
+            from veomni_tpu.parallel.parallel_plan import ParallelPlan
+
+            self.base_params = base_params
+            lora = init_lora_params(self.rng, base_params, self.lora_config)
+            if self.args.model.lora_adapter_path:
+                lora = load_adapter(self.args.model.lora_adapter_path, lora)
+            self.optimizer = _make_optimizer(jax.eval_shape(lambda: lora))
+            plan = plan.merge(ParallelPlan(rules=lora_parallel_plan_rules()))
+            abs_state = jax.eval_shape(lambda l: build_train_state(l, self.optimizer), lora)
+            self.state_shardings = resolve_state_shardings(abs_state, plan, ps)
+            self.abstract_state = abs_state
+            lora = jax.jit(lambda l: l, out_shardings=self.state_shardings.params)(lora)
+            self.train_state = TrainState(
+                params=lora, opt_state=self.optimizer.init(lora), step=jnp.int32(0)
+            )
+            loss_fn = apply_lora_to_loss_fn(
+                lambda p, b: model.loss_fn(p, b), base_params
+            )
+        else:
+            self.base_params = None
+            self.optimizer = _make_optimizer(jax.eval_shape(lambda: base_params))
+            abs_state = jax.eval_shape(
+                lambda p: build_train_state(p, self.optimizer), base_params
+            )
+            self.state_shardings = resolve_state_shardings(abs_state, plan, ps)
+            self.abstract_state = abs_state
             opt_state = jax.jit(
                 self.optimizer.init, out_shardings=self.state_shardings.opt_state
-            )(params)
-            from veomni_tpu.train.train_step import TrainState
-
-            self.train_state = TrainState(params=params, opt_state=opt_state, step=jnp.int32(0))
-        else:
-            self.train_state = jax.jit(make_state, out_shardings=self.state_shardings)(self.rng)
+            )(base_params)
+            self.train_state = TrainState(
+                params=base_params, opt_state=opt_state, step=jnp.int32(0)
+            )
+            loss_fn = lambda params, batch: model.loss_fn(params, batch)
 
         self.batch_shardings = {
-            k: NamedSharding(ps.mesh, P(None, ps.dp_axes, ps.sp_axes)) for k in BATCH_KEYS
+            k: NamedSharding(ps.mesh, spec)
+            for k, spec in self._batch_sharding_map().items()
         }
-        loss_fn = lambda params, batch: model.loss_fn(params, batch)
         self.train_step = build_train_step(
             loss_fn, self.optimizer, ps,
             state_shardings=self.state_shardings,
@@ -261,6 +312,12 @@ class BaseTrainer:
                 WandbCallback(t.wandb_project, t.wandb_name,
                               config=dataclasses.asdict(self.args))
             )
+
+    def _batch_sharding_map(self):
+        """Per-key PartitionSpec for device batches; subclasses extend for
+        modality-specific keys (cf. reference DataCollateInfo sp_slice)."""
+        ps = self.parallel_state
+        return {k: P(None, ps.dp_axes, ps.sp_axes) for k in BATCH_KEYS}
 
     # ----------------------------------------------------------------- resume
     def try_resume(self):

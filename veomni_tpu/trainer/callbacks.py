@@ -119,13 +119,24 @@ class CheckpointCallback(Callback):
 
 class HFCheckpointCallback(Callback):
     """HF-format safetensors export at end of training
-    (reference HuggingfaceCkptCallback)."""
+    (reference HuggingfaceCkptCallback / HFLoraCkptCallback: LoRA runs export
+    both a merged full model and the adapter-only checkpoint)."""
 
     def on_train_end(self, trainer, state):
         if jax.process_index() != 0:
             return
         out = os.path.join(trainer.args.train.output_dir, "hf_ckpt")
-        trainer.model.save_hf(out, params=trainer.train_state.params)
+        params = trainer.train_state.params
+        if getattr(trainer, "base_params", None) is not None:
+            from veomni_tpu.lora import merge_lora_params
+            from veomni_tpu.lora.lora import save_adapter
+
+            save_adapter(
+                params, trainer.lora_config,
+                os.path.join(trainer.args.train.output_dir, "lora_adapter"),
+            )
+            params = jax.jit(merge_lora_params)(trainer.base_params, params)
+        trainer.model.save_hf(out, params=params)
 
 
 class ProfileCallback(Callback):

@@ -1,0 +1,161 @@
+"""Direct Preference Optimization trainer.
+
+Reference: ``veomni/trainer/text_dpo_trainer.py`` (486 LoC from-scratch DPO:
+chosen/rejected pairs, frozen reference policy, sigmoid preference loss).
+
+Design: each micro-batch stacks the chosen rows first and the rejected rows
+second ([2*P, S]); one forward computes per-row label-logprob sums for both
+policy and the frozen reference (inside the same jit program), and the DPO
+loss is  -logsigmoid(beta * ((pi_c - ref_c) - (pi_r - ref_r))).
+The grad-accum/clip/update machinery of the base train step is reused with
+"pairs" standing in for ntokens.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.data.data_collator import IGNORE_INDEX, TextPackingCollator
+from veomni_tpu.data.data_transform import DATA_TRANSFORM_REGISTRY
+from veomni_tpu.models.transformer import sequence_logprob_sums
+from veomni_tpu.trainer.base import BaseTrainer
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@DATA_TRANSFORM_REGISTRY.register("dpo")
+def build_dpo_transform(tokenizer=None, max_seq_len: int = 0, **_):
+    """Rows: {"prompt": ids|text, "chosen": ids|text, "rejected": ids|text}.
+    Prompt tokens are loss-masked in both branches."""
+
+    def tok(x):
+        if isinstance(x, str):
+            return tokenizer(x, add_special_tokens=False)["input_ids"]
+        return list(x)
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = tok(row["prompt"])
+        out = {}
+        for side in ("chosen", "rejected"):
+            resp = tok(row[side])
+            ids = prompt + resp
+            labels = [IGNORE_INDEX] * len(prompt) + resp
+            if max_seq_len:
+                ids, labels = ids[:max_seq_len], labels[:max_seq_len]
+            out[f"{side}_input_ids"] = ids
+            out[f"{side}_labels"] = labels
+        return out
+
+    return transform
+
+
+class DPOPairCollator:
+    """[2*P, S] with ADJACENT chosen/rejected rows ([c0, r0, c1, r1, ...]).
+
+    Adjacency (not halves) keeps pairs intact under multi-host batch
+    stitching: each process contributes whole pairs, so the global
+    concatenation along the batch dim preserves even=chosen / odd=rejected.
+    """
+
+    def __init__(self, seq_len: int, pairs: int, sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError("seq_len must divide sp_size")
+        self.seq_len = seq_len
+        self.pairs = pairs
+
+    def __call__(self, samples):
+        p, s = self.pairs, self.seq_len
+        out = {
+            "input_ids": np.zeros((2 * p, s), np.int32),
+            "labels": np.full((2 * p, s), IGNORE_INDEX, np.int32),
+            "position_ids": np.zeros((2 * p, s), np.int32),
+            "segment_ids": np.zeros((2 * p, s), np.int32),
+        }
+        for i, sample in enumerate(samples[:p]):
+            for half, side in enumerate(("chosen", "rejected")):
+                row = 2 * i + half
+                ids = np.asarray(sample[f"{side}_input_ids"], np.int32)[:s]
+                lab = np.asarray(sample[f"{side}_labels"], np.int32)[: len(ids)]
+                shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
+                n = len(ids)
+                out["input_ids"][row, :n] = ids
+                out["labels"][row, :n] = shifted
+                out["position_ids"][row, :n] = np.arange(n)
+                out["segment_ids"][row, :n] = 1
+        return out
+
+
+class TextDPOTrainer(BaseTrainer):
+    def _build_data_transform(self):
+        d = self.args.data
+        from veomni_tpu.data.data_transform import build_data_transform
+
+        self.data_transform = build_data_transform(
+            "dpo", tokenizer=self.tokenizer, max_seq_len=d.max_seq_len
+        )
+
+    def _build_dataloader(self):
+        from veomni_tpu.data.data_loader import build_dataloader
+
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        nproc = jax.process_count()
+        global_pairs = t.micro_batch_size * ps.dp_size
+        if global_pairs % nproc:
+            raise ValueError(
+                f"global pair count {global_pairs} not divisible by process count {nproc}"
+            )
+        pairs = global_pairs // nproc
+        collator = DPOPairCollator(d.max_seq_len, pairs, sp_size=ps.sp_size)
+        self.dataloader = build_dataloader(
+            d.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=collator,
+            micro_batch_size=pairs,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=pairs,
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            infinite=True,
+        )
+
+    def _build_parallelized_state(self):
+        if self.args.model.lora:
+            raise NotImplementedError(
+                "DPO + LoRA is not wired yet (adapter-tree params would need "
+                "a merged forward for both policy and reference)"
+            )
+        super()._build_parallelized_state()
+        # frozen reference policy = detached copy of the initial params
+        # (kept un-donated: the train state owns its own buffers)
+        self.ref_params = jax.tree.map(jnp.copy, self.train_state.params)
+        model, cfg = self.model, self.model.config
+        beta = float(self.args.train.dpo_beta)
+
+        def dpo_loss(params, batch):
+            logps = sequence_logprob_sums(params, cfg, batch)           # [2P]
+            ref_logps = sequence_logprob_sums(
+                jax.lax.stop_gradient(self.ref_params), cfg, batch
+            )
+            p = logps.shape[0] // 2
+            # even rows = chosen, odd rows = rejected (collator adjacency)
+            margin = (logps[0::2] - ref_logps[0::2]) - (logps[1::2] - ref_logps[1::2])
+            losses = -jax.nn.log_sigmoid(beta * margin)
+            acc = (margin > 0).astype(jnp.float32).mean()
+            return losses.sum(), {"ntokens": jnp.int32(p), "dpo_acc": acc}
+
+        from veomni_tpu.train import build_train_step
+
+        self.train_step = build_train_step(
+            dpo_loss, self.optimizer, self.parallel_state,
+            state_shardings=self.state_shardings,
+            batch_shardings=self.batch_shardings,
+            max_grad_norm=self.args.train.max_grad_norm,
+        )

@@ -1,0 +1,81 @@
+"""VLMTrainer: vision-language SFT.
+
+Reference: ``veomni/trainer/vlm_trainer.py:99-373`` (processor, freeze-vit
+toggles, model-owned collate hooks). Differences here: the multimodal
+collator is shape-uniform (see data/multimodal.py), so no dummy-forward or
+per-group LR machinery is needed; vision freezing happens functionally via
+``stop_gradient`` (VLMConfig.freeze_vision).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veomni_tpu.data.data_loader import build_dataloader
+from veomni_tpu.data.data_transform import build_data_transform
+from veomni_tpu.data.multimodal import VLMCollator
+from veomni_tpu.trainer.base import BaseTrainer
+
+
+class VLMTrainer(BaseTrainer):
+    BATCH_KEYS = (
+        "input_ids", "labels", "position_ids", "segment_ids",
+        "pixel_patches", "image_mask",
+    )
+
+    def _build_data_transform(self):
+        d = self.args.data
+        self.data_transform = build_data_transform(
+            "vlm",
+            tokenizer=self.tokenizer,
+            vision_config=self.model_vision_config(),
+            image_token_id=self.model.config.image_token_id,
+            max_seq_len=d.max_seq_len,
+            max_images=self.model.config.max_images,
+            text_keys=d.text_keys,
+        )
+
+    def model_vision_config(self):
+        return self.model.config.vision
+
+    def _build_dataloader(self):
+        import jax
+
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        nproc = jax.process_count()
+        local_mb = t.micro_batch_size * ps.dp_size // nproc
+        collator = VLMCollator(
+            seq_len=d.max_seq_len,
+            micro_batch_size=local_mb,
+            vision_config=self.model_vision_config(),
+            max_images=self.model.config.max_images,
+            sp_size=ps.sp_size,
+        )
+        self.dataloader = build_dataloader(
+            d.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=collator,
+            micro_batch_size=local_mb,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=local_mb,  # 1:1 (no packing)
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            drop_last=d.drop_last,
+            infinite=True,
+        )
+
+    def _batch_sharding_map(self):
+        ps = self.parallel_state
+        return {
+            "input_ids": P(None, ps.dp_axes, ps.sp_axes),
+            "labels": P(None, ps.dp_axes, ps.sp_axes),
+            "position_ids": P(None, ps.dp_axes, ps.sp_axes),
+            "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
+            # image slots shard over batch only (vision runs unsharded-on-seq)
+            "pixel_patches": P(None, ps.dp_axes, None, None, None),
+            "image_mask": P(None, ps.dp_axes, None),
+        }
