@@ -1,0 +1,129 @@
+"""Numerical parity vs HuggingFace transformers (torch CPU).
+
+This is the reference's core oracle (``tests/test_models_patch.py``: VeOmni
+modeling must produce identical loss/grads to upstream HF). Here: build a
+tiny HF model, save_pretrained, load through our HF importer, and compare
+token-mean loss (f32) on the same batch.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import transformers  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+DIMS = dict(
+    vocab_size=257, hidden_size=64, intermediate_size=112,
+    num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=256, tie_word_embeddings=False,
+)
+
+
+def _hf_model(tmp_path, kind):
+    torch.manual_seed(0)
+    if kind == "llama":
+        cfg = transformers.LlamaConfig(**DIMS, rope_theta=10000.0)
+        m = transformers.LlamaForCausalLM(cfg)
+    elif kind == "llama31":
+        cfg = transformers.LlamaConfig(
+            **DIMS, rope_theta=500000.0,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 64},
+        )
+        m = transformers.LlamaForCausalLM(cfg)
+    elif kind == "qwen2":
+        cfg = transformers.Qwen2Config(**DIMS)
+        m = transformers.Qwen2ForCausalLM(cfg)
+    elif kind == "qwen3":
+        cfg = transformers.Qwen3Config(**DIMS, head_dim=16)
+        m = transformers.Qwen3ForCausalLM(cfg)
+    elif kind == "qwen3_moe":
+        cfg = transformers.Qwen3MoeConfig(
+            **DIMS, head_dim=16, num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=48, norm_topk_prob=True,
+            decoder_sparse_step=1, mlp_only_layers=[],
+            router_aux_loss_coef=0.0, output_router_logits=False,
+        )
+        m = transformers.Qwen3MoeForCausalLM(cfg)
+    elif kind == "gemma3":
+        cfg = transformers.Gemma3TextConfig(
+            **{k: v for k, v in DIMS.items() if k != "tie_word_embeddings"},
+            head_dim=16, query_pre_attn_scalar=16,
+            sliding_window=16, rope_local_base_freq=10000.0, rope_theta=1000000.0,
+            layer_types=["sliding_attention", "sliding_attention", "full_attention"],
+        )
+        m = transformers.Gemma3ForCausalLM(cfg)
+    elif kind == "deepseek_v3":
+        cfg = transformers.DeepseekV3Config(
+            **{k: v for k, v in DIMS.items() if k not in ("num_key_value_heads",)},
+            num_key_value_heads=DIMS["num_attention_heads"],
+            q_lora_rank=24, kv_lora_rank=16,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            n_routed_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+            n_shared_experts=1, n_group=2, topk_group=1,
+            routed_scaling_factor=1.5, scoring_func="sigmoid", norm_topk_prob=True,
+            first_k_dense_replace=1,  # rope_interleave defaults True (real ckpts)
+        )
+        m = transformers.DeepseekV3ForCausalLM(cfg)
+    elif kind == "gpt_oss":
+        cfg = transformers.GptOssConfig(
+            **{k: v for k, v in DIMS.items()},
+            head_dim=16, num_local_experts=4, num_experts_per_tok=2,
+            sliding_window=16,
+            layer_types=["sliding_attention", "full_attention", "sliding_attention"],
+            router_aux_loss_coef=0.0, output_router_logits=False,
+        )
+        m = transformers.GptOssForCausalLM(cfg)
+    else:
+        raise ValueError(kind)
+    d = tmp_path / kind
+    m.save_pretrained(d)
+    return m.eval(), str(d)
+
+
+def _batch(seq=48, bsz=2, vocab=257, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, (bsz, seq)).astype(np.int64)
+
+
+def _hf_loss(model, ids):
+    with torch.no_grad():
+        out = model(input_ids=torch.tensor(ids), labels=torch.tensor(ids))
+    return float(out.loss)
+
+
+def _our_loss(model_dir, ids):
+    from veomni_tpu.models import build_foundation_model
+
+    model = build_foundation_model(model_dir, dtype=jnp.float32)
+    params = model.load_hf(model_dir)
+    b, s = ids.shape
+    labels = np.concatenate(
+        [ids[:, 1:], np.full((b, 1), -100)], axis=1
+    ).astype(np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(labels),
+        "position_ids": jnp.broadcast_to(jnp.arange(s), (b, s)),
+        "segment_ids": jnp.ones((b, s), jnp.int32),
+    }
+    loss_sum, metrics = jax.jit(model.loss_fn)(params, batch)
+    return float(loss_sum / metrics["ntokens"])
+
+
+@pytest.mark.parametrize(
+    "kind",
+    ["llama", "llama31", "qwen2", "qwen3", "qwen3_moe",
+     "gemma3", "deepseek_v3", "gpt_oss"],
+)
+def test_loss_parity_vs_hf(tmp_path, kind):
+    hf, model_dir = _hf_model(tmp_path, kind)
+    ids = _batch()
+    expected = _hf_loss(hf, ids)
+    got = _our_loss(model_dir, ids)
+    np.testing.assert_allclose(got, expected, rtol=2e-4,
+                               err_msg=f"{kind}: ours {got} vs HF {expected}")

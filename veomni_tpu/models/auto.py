@@ -52,7 +52,12 @@ class ModelFamily:
         return ParallelPlan(rules=rules)
 
 
-for _mt in ("llama", "qwen2", "qwen3", "qwen3_moe"):
+for _mt in (
+    "llama", "qwen2", "qwen3", "qwen3_moe",
+    "gemma3", "gemma3_text",
+    "deepseek_v2", "deepseek_v3",
+    "gpt_oss", "seed_oss", "glm_moe",
+):
     MODEL_REGISTRY.register(_mt, ModelFamily(model_type=_mt))
 
 

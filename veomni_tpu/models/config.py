@@ -39,14 +39,43 @@ class TransformerConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     attention_bias: bool = False
+    o_bias: bool = False  # bias on o_proj too (gpt_oss; qwen2 has qkv only)
+    mlp_bias: bool = False
     qk_norm: bool = False
     sliding_window: Optional[int] = None
+    # per-layer attention pattern: list of "sliding_attention"/"full_attention"
+    # (gemma3 / gpt_oss alternating local-global); None -> uniform
+    layer_types: Optional[List[str]] = None
+    rope_local_base_freq: float = 0.0  # gemma3: separate theta for sliding layers
+    # activation / norms / scaling dialects
+    hidden_act: str = "silu"            # silu | gelu_pytorch_tanh | gelu
+    norm_zero_centered: bool = False    # gemma family: weight is (1 + w)
+    sandwich_norms: bool = False        # gemma3 post-attn/pre+post-ffw norms
+    embed_scale: float = 0.0            # gemma: sqrt(hidden); 0 = off
+    final_logit_softcap: float = 0.0
+    query_pre_attn_scalar: float = 0.0  # gemma3: softmax scale = qpas^-0.5
+    attention_sinks: bool = False       # gpt_oss learned per-head sink logit
+    router_bias: bool = False           # gpt_oss router linear has a bias
+    # MLA (deepseek_v3): kv/q low-rank compression + rope/nope head split
+    rope_interleave: bool = False  # deepseek pairwise rope layout
+    q_lora_rank: int = 0
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
     # MoE (num_experts == 0 -> dense MLP)
     num_experts: int = 0
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
     norm_topk_prob: bool = True
     router_aux_loss_coef: float = 0.001
+    # deepseek routing dialect
+    scoring_func: str = "softmax"       # softmax | sigmoid (w/ correction bias)
+    routed_scaling_factor: float = 1.0
+    n_group: int = 0                    # group-limited routing (noaux-tc)
+    topk_group: int = 0
+    n_shared_experts: int = 0
+    first_k_dense_replace: int = 0      # leading dense layers (deepseek)
     # EP dispatch capacity factor; <= 0 means dropless (see parallel/moe.py)
     moe_capacity_factor: float = 0.0
     # numerics
@@ -68,6 +97,10 @@ class TransformerConfig:
         return self.num_experts > 0
 
     @property
+    def use_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
     def q_dim(self) -> int:
         return self.num_attention_heads * self.head_dim
 
@@ -75,18 +108,40 @@ class TransformerConfig:
     def kv_dim(self) -> int:
         return self.num_key_value_heads * self.head_dim
 
+    @property
+    def qk_head_dim(self) -> int:
+        """MLA query/key head dim (nope + rope parts)."""
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    def window_for_layer(self, i: int) -> int:
+        """Per-layer sliding window (0 = full attention)."""
+        if self.layer_types is not None:
+            sliding = self.layer_types[i] == "sliding_attention"
+        else:
+            sliding = self.sliding_window is not None
+        return int(self.sliding_window or 0) if sliding else 0
+
+
     # ------------------------------------------------------------------ HF io
     _HF_FIELDS = (
         "vocab_size hidden_size intermediate_size num_hidden_layers "
         "num_attention_heads num_key_value_heads rms_norm_eps rope_theta "
         "max_position_embeddings tie_word_embeddings sliding_window "
         "num_experts_per_tok moe_intermediate_size norm_topk_prob "
-        "router_aux_loss_coef initializer_range"
+        "router_aux_loss_coef initializer_range layer_types hidden_act "
+        "rope_local_base_freq q_lora_rank kv_lora_rank qk_nope_head_dim "
+        "qk_rope_head_dim v_head_dim routed_scaling_factor n_group "
+        "topk_group n_shared_experts first_k_dense_replace scoring_func "
+        "mlp_bias attention_bias"
     ).split()
 
     @classmethod
     def from_hf_config(cls, hf: Dict[str, Any], **overrides) -> "TransformerConfig":
         mt = hf.get("model_type", "llama")
+        if isinstance(hf.get("text_config"), dict):
+            # multimodal wrappers (gemma3, *-vl) nest the LM dialect
+            hf = {**hf, **hf["text_config"]}
+            mt = hf.get("model_type", mt)
         kw: Dict[str, Any] = {"model_type": mt}
         for name in cls._HF_FIELDS:
             if name in hf and hf[name] is not None:
@@ -95,17 +150,42 @@ class TransformerConfig:
             kw["head_dim"] = hf["head_dim"]
         if hf.get("rope_scaling"):
             kw["rope_scaling"] = dict(hf["rope_scaling"])
+        if hf.get("hidden_activation"):  # gemma naming
+            kw["hidden_act"] = hf["hidden_activation"]
         if mt in ("qwen2",):
             kw["attention_bias"] = True
         if mt in ("qwen3", "qwen3_moe"):
             kw["qk_norm"] = True
         if "attention_bias" in hf:
             kw["attention_bias"] = hf["attention_bias"]
-        if mt == "qwen3_moe":
-            kw["num_experts"] = hf.get("num_experts", 0)
-        elif "num_local_experts" in hf:
-            kw["num_experts"] = hf["num_local_experts"]
-        if not hf.get("use_sliding_window", mt == "gemma3"):
+        # expert count: our exports use "num_experts"; HF dialects vary
+        for key in ("num_experts", "n_routed_experts", "num_local_experts"):
+            if hf.get(key):
+                kw["num_experts"] = hf[key]
+                break
+        if mt in ("gemma3", "gemma3_text"):
+            kw.update(
+                model_type="gemma3",
+                qk_norm=True,
+                norm_zero_centered=True,
+                sandwich_norms=True,
+                embed_scale=hf["hidden_size"] ** 0.5,
+                query_pre_attn_scalar=hf.get("query_pre_attn_scalar", 256),
+                tie_word_embeddings=hf.get("tie_word_embeddings", True),
+            )
+            if hf.get("final_logit_softcapping"):
+                kw["final_logit_softcap"] = hf["final_logit_softcapping"]
+        if mt == "gpt_oss":
+            kw.update(attention_sinks=True, attention_bias=True, o_bias=True,
+                      mlp_bias=True, hidden_act="gpt_oss_glu", router_bias=True,
+                      num_experts=hf.get("num_local_experts", 0))
+        if mt in ("deepseek_v3", "deepseek_v2"):
+            kw["scoring_func"] = hf.get("scoring_func", "sigmoid")
+            kw["norm_topk_prob"] = hf.get("norm_topk_prob", True)
+            # deepseek trains bias-update (noaux-tc), not an aux loss term
+            kw["router_aux_loss_coef"] = hf.get("aux_loss_alpha", 0.0)
+            kw["rope_interleave"] = hf.get("rope_interleave", True)
+        if not hf.get("use_sliding_window", True) and mt.startswith("qwen"):
             kw["sliding_window"] = None
         kw.update(overrides)
         return cls(**kw)

@@ -36,19 +36,44 @@ _LAYER_MAP: List[Tuple[str, str, bool]] = [
     ("q_bias", "self_attn.q_proj.bias", False),
     ("k_bias", "self_attn.k_proj.bias", False),
     ("v_bias", "self_attn.v_proj.bias", False),
+    ("o_bias", "self_attn.o_proj.bias", False),
     ("q_norm", "self_attn.q_norm.weight", False),
     ("k_norm", "self_attn.k_norm.weight", False),
+    ("sinks", "self_attn.sinks", False),
+    # MLA (deepseek)
+    ("q_a_proj", "self_attn.q_a_proj.weight", True),
+    ("q_a_layernorm", "self_attn.q_a_layernorm.weight", False),
+    ("q_b_proj", "self_attn.q_b_proj.weight", True),
+    ("kv_a_proj_with_mqa", "self_attn.kv_a_proj_with_mqa.weight", True),
+    ("kv_a_layernorm", "self_attn.kv_a_layernorm.weight", False),
+    ("kv_b_proj", "self_attn.kv_b_proj.weight", True),
+    # norms
     ("post_attention_layernorm", "post_attention_layernorm.weight", False),
+    ("pre_feedforward_layernorm", "pre_feedforward_layernorm.weight", False),
+    ("post_feedforward_layernorm", "post_feedforward_layernorm.weight", False),
+    # dense mlp
     ("gate_proj", "mlp.gate_proj.weight", True),
     ("up_proj", "mlp.up_proj.weight", True),
     ("down_proj", "mlp.down_proj.weight", True),
+    ("gate_bias", "mlp.gate_proj.bias", False),
+    ("up_bias", "mlp.up_proj.bias", False),
+    ("down_bias", "mlp.down_proj.bias", False),
+    # routers
     ("router", "mlp.gate.weight", True),
+    ("e_score_correction_bias", "mlp.gate.e_score_correction_bias", False),
+    # shared experts (deepseek)
+    ("shared_experts.gate_proj", "mlp.shared_experts.gate_proj.weight", True),
+    ("shared_experts.up_proj", "mlp.shared_experts.up_proj.weight", True),
+    ("shared_experts.down_proj", "mlp.shared_experts.down_proj.weight", True),
 ]
 _EXPERT_MAP: List[Tuple[str, str]] = [
     ("experts.gate_proj", "mlp.experts.{e}.gate_proj.weight"),
     ("experts.up_proj", "mlp.experts.{e}.up_proj.weight"),
     ("experts.down_proj", "mlp.experts.{e}.down_proj.weight"),
 ]
+# gpt_oss stores experts as fused 3-D tensors (gate/up interleaved on the
+# last dim); handled explicitly in the load/save segment functions below
+# (reference counterpart: checkpoint_tensor_loading.py fused maps).
 
 
 def _read_all_tensors(model_dir: str) -> Dict[str, np.ndarray]:
@@ -77,6 +102,7 @@ def hf_to_params(
     raw = {re.sub(r"^model\.", "", k): v for k, v in _read_all_tensors(model_dir).items()}
     pd = cfg.param_dtype
     L = cfg.num_hidden_layers
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
 
     def grab(name: str) -> np.ndarray:
         if name not in raw:
@@ -86,31 +112,69 @@ def hf_to_params(
     def maybe_t(x, transpose):
         return x.T if transpose else x
 
-    layers: Dict[str, Any] = {}
-    for ours, hf_suffix, transpose in _LAYER_MAP:
-        if f"layers.0.{hf_suffix}" not in raw:
-            continue
-        stacked = np.stack(
-            [maybe_t(grab(f"layers.{i}.{hf_suffix}"), transpose) for i in range(L)]
-        )
-        layers[ours] = jnp.asarray(stacked, pd)
-    if cfg.is_moe:
-        for ours, hf_tmpl in _EXPERT_MAP:
-            per_layer = []
-            for i in range(L):
-                per_expert = [
-                    np.asarray(grab(f"layers.{i}.{hf_tmpl.format(e=e)}")).T
-                    for e in range(cfg.num_experts)
-                ]
-                per_layer.append(np.stack(per_expert))
-            a, b = ours.split(".")
-            layers.setdefault(a, {})[b] = jnp.asarray(np.stack(per_layer), pd)
+    def set_nested(tree, dotted, value):
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            tree = tree.setdefault(p, {})
+        tree[parts[-1]] = value
+
+    def load_segment(offset: int, count: int, moe_seg: bool) -> Dict[str, Any]:
+        layers: Dict[str, Any] = {}
+        for ours, hf_suffix, transpose in _LAYER_MAP:
+            if f"layers.{offset}.{hf_suffix}" not in raw:
+                continue
+            stacked = np.stack(
+                [maybe_t(grab(f"layers.{offset + i}.{hf_suffix}"), transpose)
+                 for i in range(count)]
+            )
+            set_nested(layers, ours, jnp.asarray(stacked, pd))
+        if moe_seg and cfg.is_moe:
+            if f"layers.{offset}.mlp.experts.gate_up_proj" in raw:
+                # gpt_oss fused experts: [E, H, 2I] gate/up interleaved
+                gu = np.stack([grab(f"layers.{offset + i}.mlp.experts.gate_up_proj")
+                               for i in range(count)])
+                experts = {
+                    "gate_proj": jnp.asarray(gu[..., ::2], pd),
+                    "up_proj": jnp.asarray(gu[..., 1::2], pd),
+                    "down_proj": jnp.asarray(
+                        np.stack([grab(f"layers.{offset + i}.mlp.experts.down_proj")
+                                  for i in range(count)]), pd),
+                }
+                if f"layers.{offset}.mlp.experts.gate_up_proj_bias" in raw:
+                    gub = np.stack([grab(f"layers.{offset + i}.mlp.experts.gate_up_proj_bias")
+                                    for i in range(count)])
+                    experts["gate_bias"] = jnp.asarray(gub[..., ::2], pd)
+                    experts["up_bias"] = jnp.asarray(gub[..., 1::2], pd)
+                    experts["down_bias"] = jnp.asarray(
+                        np.stack([grab(f"layers.{offset + i}.mlp.experts.down_proj_bias")
+                                  for i in range(count)]), pd)
+                layers["experts"] = experts
+                layers["router"] = jnp.asarray(
+                    np.stack([grab(f"layers.{offset + i}.mlp.router.weight").T
+                              for i in range(count)]), pd)
+                if f"layers.{offset}.mlp.router.bias" in raw:
+                    layers["router_bias"] = jnp.asarray(
+                        np.stack([grab(f"layers.{offset + i}.mlp.router.bias")
+                                  for i in range(count)]), pd)
+            else:
+                for ours, hf_tmpl in _EXPERT_MAP:
+                    per_layer = []
+                    for i in range(count):
+                        per_expert = [
+                            grab(f"layers.{offset + i}.{hf_tmpl.format(e=e)}").T
+                            for e in range(cfg.num_experts)
+                        ]
+                        per_layer.append(np.stack(per_expert))
+                    set_nested(layers, ours, jnp.asarray(np.stack(per_layer), pd))
+        return layers
 
     params: Dict[str, Any] = {
         "embed_tokens": jnp.asarray(grab("embed_tokens.weight"), pd),
-        "layers": layers,
         "norm": jnp.asarray(grab("norm.weight"), pd),
     }
+    if k_dense:
+        params["dense_layers"] = load_segment(0, k_dense, False)
+    params["layers"] = load_segment(k_dense, L - k_dense, True)
     if not cfg.tie_word_embeddings:
         if "lm_head.weight" in raw:
             params["lm_head"] = jnp.asarray(np.asarray(raw.pop("lm_head.weight")).T, pd)
@@ -125,6 +189,14 @@ def hf_to_params(
     return params
 
 
+def _get_nested(tree, dotted):
+    for p in dotted.split("."):
+        if not isinstance(tree, dict) or p not in tree:
+            return None
+        tree = tree[p]
+    return tree
+
+
 def params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, np.ndarray]:
     """Inverse mapping, for HF-format export (gathers to host)."""
     out: Dict[str, np.ndarray] = {}
@@ -134,19 +206,59 @@ def params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, np
     if "lm_head" in host:
         out["lm_head.weight"] = host["lm_head"].T
     L = cfg.num_hidden_layers
-    layers = host["layers"]
-    for ours, hf_suffix, transpose in _LAYER_MAP:
-        if ours not in layers:
-            continue
-        for i in range(L):
-            x = layers[ours][i]
-            out[f"model.layers.{i}.{hf_suffix}"] = x.T if transpose else x
-    if cfg.is_moe:
-        for ours, hf_tmpl in _EXPERT_MAP:
-            a, b = ours.split(".")
-            for i in range(L):
-                for e in range(cfg.num_experts):
-                    out[f"model.layers.{i}.{hf_tmpl.format(e=e)}"] = layers[a][b][i, e].T
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
+
+    def dump_segment(layers, offset, count, moe_seg):
+        for ours, hf_suffix, transpose in _LAYER_MAP:
+            if cfg.model_type == "gpt_oss" and ours in ("router", "router_bias"):
+                continue  # exported in the fused-expert block below
+            t = _get_nested(layers, ours)
+            if t is None:
+                continue
+            for i in range(count):
+                x = t[i]
+                out[f"model.layers.{offset + i}.{hf_suffix}"] = x.T if transpose else x
+        if moe_seg and cfg.is_moe:
+            ex = layers["experts"]
+            if cfg.model_type == "gpt_oss":
+                for i in range(count):
+                    gu = np.empty(
+                        (cfg.num_experts, cfg.hidden_size,
+                         2 * ex["gate_proj"].shape[-1]), ex["gate_proj"].dtype
+                    )
+                    gu[..., ::2] = ex["gate_proj"][i]
+                    gu[..., 1::2] = ex["up_proj"][i]
+                    pfx = f"model.layers.{offset + i}.mlp.experts"
+                    out[f"{pfx}.gate_up_proj"] = gu
+                    out[f"{pfx}.down_proj"] = ex["down_proj"][i]
+                    if "gate_bias" in ex:
+                        gub = np.empty(
+                            (cfg.num_experts, 2 * ex["gate_bias"].shape[-1]),
+                            ex["gate_bias"].dtype,
+                        )
+                        gub[..., ::2] = ex["gate_bias"][i]
+                        gub[..., 1::2] = ex["up_bias"][i]
+                        out[f"{pfx}.gate_up_proj_bias"] = gub
+                        out[f"{pfx}.down_proj_bias"] = ex["down_bias"][i]
+                    out[f"model.layers.{offset + i}.mlp.router.weight"] = (
+                        layers["router"][i].T
+                    )
+                    if "router_bias" in layers:
+                        out[f"model.layers.{offset + i}.mlp.router.bias"] = (
+                            layers["router_bias"][i]
+                        )
+            else:
+                for ours, hf_tmpl in _EXPERT_MAP:
+                    b = ours.split(".")[1]
+                    for i in range(count):
+                        for e in range(cfg.num_experts):
+                            out[f"model.layers.{offset + i}.{hf_tmpl.format(e=e)}"] = (
+                                ex[b][i, e].T
+                            )
+
+    if k_dense:
+        dump_segment(host["dense_layers"], 0, k_dense, False)
+    dump_segment(host["layers"], k_dense, L - k_dense, True)
     return out
 
 

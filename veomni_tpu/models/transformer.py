@@ -42,50 +42,128 @@ def _dense_init(key, shape, dtype, scale):
     return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
 
 
-def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
-    """Random init with HF-compatible structure (stacked layer dim first)."""
-    h, qd, kvd = cfg.hidden_size, cfg.q_dim, cfg.kv_dim
-    inter = cfg.intermediate_size
-    pd = cfg.param_dtype
+def _attn_params(keys, cfg: TransformerConfig, L: int, pd) -> Params:
+    h = cfg.hidden_size
     s = cfg.initializer_range
+    p: Params = {"input_layernorm": jnp.ones((L, h), pd)}
+    if cfg.use_mla:
+        # deepseek MLA: low-rank q/kv compression + rope/nope split
+        nh, qk, vd = cfg.num_attention_heads, cfg.qk_head_dim, cfg.v_head_dim
+        if cfg.q_lora_rank:
+            p["q_a_proj"] = _dense_init(next(keys), (L, h, cfg.q_lora_rank), pd, s)
+            p["q_a_layernorm"] = jnp.ones((L, cfg.q_lora_rank), pd)
+            p["q_b_proj"] = _dense_init(next(keys), (L, cfg.q_lora_rank, nh * qk), pd, s)
+        else:
+            p["q_proj"] = _dense_init(next(keys), (L, h, nh * qk), pd, s)
+        p["kv_a_proj_with_mqa"] = _dense_init(
+            next(keys), (L, h, cfg.kv_lora_rank + cfg.qk_rope_head_dim), pd, s
+        )
+        p["kv_a_layernorm"] = jnp.ones((L, cfg.kv_lora_rank), pd)
+        p["kv_b_proj"] = _dense_init(
+            next(keys), (L, cfg.kv_lora_rank, nh * (cfg.qk_nope_head_dim + vd)), pd, s
+        )
+        p["o_proj"] = _dense_init(next(keys), (L, nh * vd, h), pd, s)
+    else:
+        qd, kvd = cfg.q_dim, cfg.kv_dim
+        p["q_proj"] = _dense_init(next(keys), (L, h, qd), pd, s)
+        p["k_proj"] = _dense_init(next(keys), (L, h, kvd), pd, s)
+        p["v_proj"] = _dense_init(next(keys), (L, h, kvd), pd, s)
+        p["o_proj"] = _dense_init(next(keys), (L, qd, h), pd, s)
+        if cfg.attention_bias:
+            p["q_bias"] = jnp.zeros((L, qd), pd)
+            p["k_bias"] = jnp.zeros((L, kvd), pd)
+            p["v_bias"] = jnp.zeros((L, kvd), pd)
+        if cfg.o_bias:
+            p["o_bias"] = jnp.zeros((L, h), pd)
+        if cfg.qk_norm:
+            p["q_norm"] = jnp.ones((L, cfg.head_dim), pd)
+            p["k_norm"] = jnp.ones((L, cfg.head_dim), pd)
+        if cfg.attention_sinks:
+            p["sinks"] = jnp.zeros((L, cfg.num_attention_heads), pd)
+    p["post_attention_layernorm"] = jnp.ones((L, h), pd)
+    if cfg.sandwich_norms:
+        p["pre_feedforward_layernorm"] = jnp.ones((L, h), pd)
+        p["post_feedforward_layernorm"] = jnp.ones((L, h), pd)
+    return p
+
+
+def _dense_mlp_params(keys, cfg: TransformerConfig, L: int, pd) -> Params:
+    h, inter = cfg.hidden_size, cfg.intermediate_size
+    s = cfg.initializer_range
+    p = {
+        "gate_proj": _dense_init(next(keys), (L, h, inter), pd, s),
+        "up_proj": _dense_init(next(keys), (L, h, inter), pd, s),
+        "down_proj": _dense_init(next(keys), (L, inter, h), pd, s),
+    }
+    if cfg.mlp_bias:
+        p["gate_bias"] = jnp.zeros((L, inter), pd)
+        p["up_bias"] = jnp.zeros((L, inter), pd)
+        p["down_bias"] = jnp.zeros((L, h), pd)
+    return p
+
+
+def _moe_params(keys, cfg: TransformerConfig, L: int, pd) -> Params:
+    h = cfg.hidden_size
+    s = cfg.initializer_range
+    im = cfg.moe_intermediate_size or cfg.intermediate_size
+    e = cfg.num_experts
+    p: Params = {
+        "router": _dense_init(next(keys), (L, h, e), pd, s),
+        **({"router_bias": jnp.zeros((L, e), pd)} if cfg.router_bias else {}),
+        "experts": {
+            "gate_proj": _dense_init(next(keys), (L, e, h, im), pd, s),
+            "up_proj": _dense_init(next(keys), (L, e, h, im), pd, s),
+            "down_proj": _dense_init(next(keys), (L, e, im, h), pd, s),
+        },
+    }
+    if cfg.scoring_func == "sigmoid":
+        p["e_score_correction_bias"] = jnp.zeros((L, e), pd)
+    if cfg.mlp_bias:
+        p["experts"]["gate_bias"] = jnp.zeros((L, e, im), pd)
+        p["experts"]["up_bias"] = jnp.zeros((L, e, im), pd)
+        p["experts"]["down_bias"] = jnp.zeros((L, e, h), pd)
+    if cfg.n_shared_experts:
+        si = im * cfg.n_shared_experts
+        p["shared_experts"] = {
+            "gate_proj": _dense_init(next(keys), (L, h, si), pd, s),
+            "up_proj": _dense_init(next(keys), (L, h, si), pd, s),
+            "down_proj": _dense_init(next(keys), (L, si, h), pd, s),
+        }
+    return p
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Params:
+    """Random init with HF-compatible structure (stacked layer dim first).
+
+    With ``first_k_dense_replace`` (deepseek), the leading dense layers live
+    in a separate stacked subtree ``dense_layers`` so both segments scan
+    homogeneously.
+    """
+    h = cfg.hidden_size
+    pd = cfg.param_dtype
     keys = iter(jax.random.split(rng, 64))
     L = cfg.num_hidden_layers
-
-    layers: Params = {
-        "input_layernorm": jnp.ones((L, h), pd),
-        "q_proj": _dense_init(next(keys), (L, h, qd), pd, s),
-        "k_proj": _dense_init(next(keys), (L, h, kvd), pd, s),
-        "v_proj": _dense_init(next(keys), (L, h, kvd), pd, s),
-        "o_proj": _dense_init(next(keys), (L, qd, h), pd, s),
-        "post_attention_layernorm": jnp.ones((L, h), pd),
-    }
-    if cfg.attention_bias:
-        layers["q_bias"] = jnp.zeros((L, qd), pd)
-        layers["k_bias"] = jnp.zeros((L, kvd), pd)
-        layers["v_bias"] = jnp.zeros((L, kvd), pd)
-    if cfg.qk_norm:
-        layers["q_norm"] = jnp.ones((L, cfg.head_dim), pd)
-        layers["k_norm"] = jnp.ones((L, cfg.head_dim), pd)
-    if cfg.is_moe:
-        im = cfg.moe_intermediate_size or inter
-        layers["router"] = _dense_init(next(keys), (L, h, cfg.num_experts), pd, s)
-        layers["experts"] = {
-            "gate_proj": _dense_init(next(keys), (L, cfg.num_experts, h, im), pd, s),
-            "up_proj": _dense_init(next(keys), (L, cfg.num_experts, h, im), pd, s),
-            "down_proj": _dense_init(next(keys), (L, cfg.num_experts, im, h), pd, s),
-        }
-    else:
-        layers["gate_proj"] = _dense_init(next(keys), (L, h, inter), pd, s)
-        layers["up_proj"] = _dense_init(next(keys), (L, h, inter), pd, s)
-        layers["down_proj"] = _dense_init(next(keys), (L, inter, h), pd, s)
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
 
     params: Params = {
-        "embed_tokens": _dense_init(next(keys), (cfg.vocab_size, h), pd, s),
-        "layers": layers,
+        "embed_tokens": _dense_init(next(keys), (cfg.vocab_size, h), pd, cfg.initializer_range),
         "norm": jnp.ones((h,), pd),
     }
+    if k_dense:
+        params["dense_layers"] = {
+            **_attn_params(keys, cfg, k_dense, pd),
+            **_dense_mlp_params(keys, cfg, k_dense, pd),
+        }
+    main_L = L - k_dense
+    params["layers"] = {
+        **_attn_params(keys, cfg, main_L, pd),
+        **(_moe_params(keys, cfg, main_L, pd) if cfg.is_moe
+           else _dense_mlp_params(keys, cfg, main_L, pd)),
+    }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = _dense_init(next(keys), (h, cfg.vocab_size), pd, s)
+        params["lm_head"] = _dense_init(
+            next(keys), (h, cfg.vocab_size), pd, cfg.initializer_range
+        )
     return params
 
 
@@ -97,35 +175,124 @@ def abstract_params(cfg: TransformerConfig) -> Params:
 # --------------------------------------------------------------------------
 # Forward
 # --------------------------------------------------------------------------
+def gated_act(gate, up, cfg: TransformerConfig):
+    """Gated-MLP activation dialects."""
+    if cfg.hidden_act == "gpt_oss_glu":
+        # gpt_oss: clamped glu with alpha=1.702 and (up + 1) gating
+        limit = 7.0
+        gate = jnp.clip(gate, max=limit)
+        up = jnp.clip(up, min=-limit, max=limit)
+        glu = gate * jax.nn.sigmoid(gate * 1.702)
+        return (up + 1.0) * glu
+    if cfg.hidden_act in ("gelu_pytorch_tanh", "gelu"):
+        return jax.nn.gelu(gate, approximate=cfg.hidden_act != "gelu") * up
+    return ops.swiglu(gate, up)
+
+
+def route_tokens(x, lp, cfg: TransformerConfig):
+    """Router dialects -> (topk_idx [T,K], topk_weights [T,K], aux_loss).
+
+    softmax (llama4/qwen-moe lineage): softmax -> topk (-> renorm).
+    sigmoid (deepseek_v3 noaux-tc): sigmoid scores + correction bias,
+    group-limited top-k, weights from raw scores, routed scaling.
+    """
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    router_logits = jnp.dot(x, lp["router"], preferred_element_type=jnp.float32)
+    if cfg.router_bias:
+        router_logits = router_logits + lp["router_bias"].astype(jnp.float32)
+    if cfg.scoring_func == "sigmoid":
+        scores = jax.nn.sigmoid(router_logits)
+        choice = scores + lp["e_score_correction_bias"].astype(jnp.float32)
+        if cfg.n_group and cfg.topk_group and cfg.n_group > 1:
+            t = x.shape[0]
+            grouped = choice.reshape(t, cfg.n_group, e // cfg.n_group)
+            group_scores = jax.lax.top_k(grouped, 2)[0].sum(-1)  # [T, n_group]
+            _, top_groups = jax.lax.top_k(group_scores, cfg.topk_group)
+            group_mask = jnp.zeros_like(group_scores).at[
+                jnp.arange(t)[:, None], top_groups
+            ].set(1.0)
+            choice = jnp.where(
+                jnp.repeat(group_mask, e // cfg.n_group, axis=1) > 0, choice, -jnp.inf
+            )
+        _, topk_idx = jax.lax.top_k(choice, k)
+        topk_w = jnp.take_along_axis(scores, topk_idx, axis=-1)
+        if cfg.norm_topk_prob:
+            topk_w = topk_w / (topk_w.sum(-1, keepdims=True) + 1e-20)
+        topk_w = topk_w * cfg.routed_scaling_factor
+        aux = ops.load_balancing_loss(scores / jnp.maximum(scores.sum(-1, keepdims=True), 1e-20),
+                                      topk_idx, e)
+        return topk_idx, topk_w, aux
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    if cfg.model_type == "gpt_oss":
+        # gpt_oss: topk on logits, softmax over the selected k
+        topk_logits, topk_idx = jax.lax.top_k(router_logits, k)
+        topk_w = jax.nn.softmax(topk_logits, axis=-1)
+    else:
+        choice = probs
+        if cfg.n_group and cfg.topk_group and cfg.n_group > 1:
+            # deepseek_v2 group_limited_greedy: keep topk_group groups by max
+            t = x.shape[0]
+            grouped = choice.reshape(t, cfg.n_group, e // cfg.n_group)
+            group_scores = grouped.max(-1)
+            _, top_groups = jax.lax.top_k(group_scores, cfg.topk_group)
+            group_mask = jnp.zeros_like(group_scores).at[
+                jnp.arange(t)[:, None], top_groups
+            ].set(1.0)
+            choice = jnp.where(
+                jnp.repeat(group_mask, e // cfg.n_group, axis=1) > 0, choice, 0.0
+            )
+        topk_w, topk_idx = jax.lax.top_k(choice, k)
+        if cfg.norm_topk_prob:
+            topk_w = topk_w / jnp.clip(topk_w.sum(-1, keepdims=True), 1e-9)
+        if cfg.routed_scaling_factor != 1.0:
+            topk_w = topk_w * cfg.routed_scaling_factor
+    aux = ops.load_balancing_loss(probs, topk_idx, e)
+    return topk_idx, topk_w, aux
+
+
+def _expert_bias(experts: Params, name: str, expert_of_row):
+    if name in experts:
+        return experts[name][expert_of_row]
+    return 0.0
+
+
+def experts_apply_sorted(xs, experts: Params, group_sizes, expert_of_row, cfg):
+    """Grouped-GEMM expert MLP on expert-sorted tokens (shared by the local
+    and EP-dispatch paths)."""
+    gate = ops.group_gemm(xs, experts["gate_proj"], group_sizes)
+    up = ops.group_gemm(xs, experts["up_proj"], group_sizes)
+    gate = gate + _expert_bias(experts, "gate_bias", expert_of_row)
+    up = up + _expert_bias(experts, "up_bias", expert_of_row)
+    act = gated_act(gate, up, cfg).astype(xs.dtype)
+    out = ops.group_gemm(act, experts["down_proj"], group_sizes)
+    return out + _expert_bias(experts, "down_bias", expert_of_row)
+
+
+def _shared_experts_out(x, lp, cfg):
+    se = lp["shared_experts"]
+    return jnp.dot(gated_act(jnp.dot(x, se["gate_proj"]), jnp.dot(x, se["up_proj"]), cfg),
+                   se["down_proj"])
+
+
 def _moe_mlp(x, lp, cfg: TransformerConfig):
     """Single-device MoE: route -> sort by expert -> grouped GEMM -> unsort.
-
-    Matches the reference eager MoE semantics (softmax-then-topk with
-    optional topk renorm, qwen3_moe dialect). x: [T, H].
-    """
+    x: [T, H]. (Reference eager MoE semantics per dialect.)"""
     t, h = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
-    router_logits = jnp.dot(x, lp["router"], preferred_element_type=jnp.float32)  # [T,E]
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    topk_probs, topk_idx = jax.lax.top_k(probs, k)  # [T,K]
-    if cfg.norm_topk_prob:
-        topk_probs = topk_probs / jnp.clip(topk_probs.sum(-1, keepdims=True), 1e-9)
-    topk_probs = topk_probs.astype(x.dtype)
+    topk_idx, topk_w, aux = route_tokens(x, lp, cfg)
+    topk_w = topk_w.astype(x.dtype)
 
     flat_expert = topk_idx.reshape(-1)  # [T*K]
     sort_idx = jnp.argsort(flat_expert)  # stable
     token_idx = sort_idx // k
     xs = x[token_idx]  # [T*K, H] sorted by expert
     group_sizes = jnp.bincount(flat_expert, length=e)
+    out = experts_apply_sorted(xs, lp["experts"], group_sizes, flat_expert[sort_idx], cfg)
 
-    gate = ops.group_gemm(xs, lp["experts"]["gate_proj"], group_sizes)
-    up = ops.group_gemm(xs, lp["experts"]["up_proj"], group_sizes)
-    act = ops.swiglu(gate, up)
-    out = ops.group_gemm(act, lp["experts"]["down_proj"], group_sizes)  # [T*K, H]
-
-    weight = topk_probs.reshape(-1)[sort_idx][:, None]
+    weight = topk_w.reshape(-1)[sort_idx][:, None]
     combined = jnp.zeros((t, h), out.dtype).at[token_idx].add(out * weight)
-    aux = ops.load_balancing_loss(probs, topk_idx, e)
+    if cfg.n_shared_experts:
+        combined = combined + _shared_experts_out(x, lp, cfg)
     return combined, aux
 
 
@@ -142,11 +309,12 @@ def _activation_constraint():
     return lambda x: jax.lax.with_sharding_constraint(x, sharding)
 
 
-def _decoder_layer(hidden, lp, *, cfg: TransformerConfig, cos, sin, segment_ids):
-    b, s, h = hidden.shape
-    constrain = _activation_constraint()
-    hidden = constrain(hidden)
-    x = ops.rms_norm(hidden, lp["input_layernorm"], cfg.rms_norm_eps)
+def _norm(x, w, cfg: TransformerConfig):
+    return ops.rms_norm(x, w, cfg.rms_norm_eps, zero_centered=cfg.norm_zero_centered)
+
+
+def _standard_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window, sinks):
+    b, s, _ = x.shape
     q = jnp.dot(x, lp["q_proj"])
     kk = jnp.dot(x, lp["k_proj"])
     v = jnp.dot(x, lp["v_proj"])
@@ -158,19 +326,86 @@ def _decoder_layer(hidden, lp, *, cfg: TransformerConfig, cos, sin, segment_ids)
     kk = kk.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
     v = v.reshape(b, s, cfg.num_key_value_heads, cfg.head_dim)
     if cfg.qk_norm:
-        q = ops.rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-        kk = ops.rms_norm(kk, lp["k_norm"], cfg.rms_norm_eps)
+        q = _norm(q, lp["q_norm"], cfg)
+        kk = _norm(kk, lp["k_norm"], cfg)
     q, kk = ops.apply_rotary(q, kk, cos, sin)
+    scale = (
+        cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar
+        else cfg.head_dim ** -0.5
+    )
     attn = ops.attention(
         q, kk, v, segment_ids=segment_ids, causal=True,
-        sliding_window=cfg.sliding_window,
+        softmax_scale=scale, sliding_window=window, sinks=sinks,
     )
-    attn = attn.reshape(b, s, cfg.q_dim)
-    hidden = hidden + jnp.dot(attn, lp["o_proj"])
+    out = jnp.dot(attn.reshape(b, s, cfg.q_dim), lp["o_proj"])
+    if "o_bias" in lp:
+        out = out + lp["o_bias"]
+    return out
+
+
+def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window):
+    """DeepSeek MLA (training form): materialize per-head k/v from the
+    low-rank kv latent; rope applies to the shared rope-part only.
+    (Reference: deepseek_v3 generated modeling.)"""
+    b, s, _ = x.shape
+    nh = cfg.num_attention_heads
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+
+    if cfg.q_lora_rank:
+        q = jnp.dot(_norm(jnp.dot(x, lp["q_a_proj"]), lp["q_a_layernorm"], cfg), lp["q_b_proj"])
+    else:
+        q = jnp.dot(x, lp["q_proj"])
+    q = q.reshape(b, s, nh, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+
+    kv_a = jnp.dot(x, lp["kv_a_proj_with_mqa"])  # [B,S, kvlr + dr]
+    c_kv, k_rope = kv_a[..., : cfg.kv_lora_rank], kv_a[..., cfg.kv_lora_rank:]
+    kv = jnp.dot(_norm(c_kv, lp["kv_a_layernorm"], cfg), lp["kv_b_proj"])
+    kv = kv.reshape(b, s, nh, dn + dv)
+    k_nope, v = kv[..., :dn], kv[..., dn:]
+
+    q_rope, k_rope = ops.apply_rotary(
+        q_rope, k_rope.reshape(b, s, 1, dr), cos, sin,
+        interleaved=cfg.rope_interleave,
+    )
+    k = jnp.concatenate([k_nope, jnp.broadcast_to(k_rope, (b, s, nh, dr))], axis=-1)
+    q = jnp.concatenate([q_nope, q_rope], axis=-1)
+    from veomni_tpu.ops.rotary import yarn_attention_factor
+
+    scale = (dn + dr) ** -0.5 * yarn_attention_factor(cfg.rope_scaling, dr)
+    attn = ops.attention(
+        q, k, v, segment_ids=segment_ids, causal=True,
+        softmax_scale=scale, sliding_window=window,
+    )
+    return jnp.dot(attn.reshape(b, s, nh * dv), lp["o_proj"])
+
+
+def _decoder_layer(
+    hidden, lp, *, cfg: TransformerConfig, cos, sin, segment_ids,
+    window=None, is_moe_segment=None,
+):
+    b, s, h = hidden.shape
+    is_moe = cfg.is_moe if is_moe_segment is None else is_moe_segment
+    constrain = _activation_constraint()
+    hidden = constrain(hidden)
+    x = _norm(hidden, lp["input_layernorm"], cfg)
+    if cfg.use_mla:
+        attn_out = _mla_attention(x, lp, cfg, cos, sin, segment_ids, window)
+    else:
+        attn_out = _standard_attention(
+            x, lp, cfg, cos, sin, segment_ids, window, lp.get("sinks")
+        )
+    if cfg.sandwich_norms:
+        attn_out = _norm(attn_out, lp["post_attention_layernorm"], cfg)
+    hidden = hidden + attn_out
 
     hidden = constrain(hidden)
-    x = ops.rms_norm(hidden, lp["post_attention_layernorm"], cfg.rms_norm_eps)
-    if cfg.is_moe:
+    pre_norm = (
+        lp["pre_feedforward_layernorm"] if cfg.sandwich_norms
+        else lp["post_attention_layernorm"]
+    )
+    x = _norm(hidden, pre_norm, cfg)
+    if is_moe:
         from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
 
         ps = get_parallel_state_or_none()
@@ -182,9 +417,17 @@ def _decoder_layer(hidden, lp, *, cfg: TransformerConfig, cos, sin, segment_ids)
             out, aux = _moe_mlp(x.reshape(b * s, h), lp, cfg)
             out = out.reshape(b, s, h)
     else:
-        out = jnp.dot(ops.swiglu(jnp.dot(x, lp["gate_proj"]), jnp.dot(x, lp["up_proj"])),
-                      lp["down_proj"])
+        gate = jnp.dot(x, lp["gate_proj"])
+        up = jnp.dot(x, lp["up_proj"])
+        if cfg.mlp_bias:
+            gate = gate + lp["gate_bias"]
+            up = up + lp["up_bias"]
+        out = jnp.dot(gated_act(gate, up, cfg), lp["down_proj"])
+        if cfg.mlp_bias:
+            out = out + lp["down_bias"]
         aux = jnp.float32(0.0)
+    if cfg.sandwich_norms:
+        out = _norm(out, lp["post_feedforward_layernorm"], cfg)
     return constrain(hidden + out), aux
 
 
@@ -201,28 +444,72 @@ def forward_hidden(
     ``inputs_embeds`` lets composite models (VLM/omni) inject merged
     multimodal embeddings while sharing the decoder stack."""
     compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
-    hidden = (
-        inputs_embeds.astype(cfg.dtype)
-        if inputs_embeds is not None
-        else compute["embed_tokens"][input_ids]
+    if inputs_embeds is not None:
+        hidden = inputs_embeds.astype(cfg.dtype)
+    else:
+        hidden = compute["embed_tokens"][input_ids]
+        if cfg.embed_scale:
+            hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
+
+    rope_dim = cfg.qk_rope_head_dim if cfg.use_mla else cfg.head_dim
+    cos_g, sin_g = ops.rotary_tables(
+        position_ids, rope_dim, cfg.rope_theta, rope_scaling=cfg.rope_scaling
     )
-    cos, sin = ops.rotary_tables(
-        position_ids, cfg.head_dim, cfg.rope_theta, rope_scaling=cfg.rope_scaling
+    cos_g, sin_g = cos_g.astype(cfg.dtype), sin_g.astype(cfg.dtype)
+    dual_rope = bool(cfg.rope_local_base_freq)
+    if dual_rope:
+        cos_l, sin_l = ops.rotary_tables(position_ids, rope_dim, cfg.rope_local_base_freq)
+        cos_l, sin_l = cos_l.astype(cfg.dtype), sin_l.astype(cfg.dtype)
+
+    L = cfg.num_hidden_layers
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
+
+    def run_segment(hidden, layer_tree, offset, count, is_moe_seg):
+        """Scan consecutive layers; *static* per-run window/rope signature so
+        full-attention layers keep the flash-kernel fast path (per-layer
+        patterns like gemma3's 5:1 sliding:full become a few short scans)."""
+        sigs = [
+            (cfg.window_for_layer(offset + i),
+             dual_rope and cfg.window_for_layer(offset + i) > 0)
+            for i in range(count)
+        ]
+        runs = []  # (start, n, window, local_rope)
+        for i, sig in enumerate(sigs):
+            if runs and (runs[-1][2], runs[-1][3]) == sig:
+                runs[-1][1] += 1
+            else:
+                runs.append([i, 1, *sig])
+
+        aux_total = jnp.float32(0.0)
+        for start, n, window, local in runs:
+            sub = (
+                layer_tree if n == count
+                else jax.tree.map(lambda t: t[start:start + n], layer_tree)
+            )
+            cos, sin = (cos_l, sin_l) if local else (cos_g, sin_g)
+            body = partial(
+                _decoder_layer, cfg=cfg, cos=cos, sin=sin,
+                segment_ids=segment_ids, window=window or None,
+                is_moe_segment=is_moe_seg,
+            )
+            if cfg.remat:
+                body = jax.checkpoint(
+                    body, policy=jax.checkpoint_policies.nothing_saveable
+                )
+            hidden, auxes = jax.lax.scan(lambda c, lp: body(c, lp), hidden, sub)
+            aux_total = aux_total + auxes.sum()
+        return hidden, aux_total
+
+    auxes_total = jnp.float32(0.0)
+    if k_dense:
+        hidden, aux0 = run_segment(hidden, compute["dense_layers"], 0, k_dense, False)
+        auxes_total = auxes_total + aux0
+    hidden, auxes = run_segment(
+        hidden, compute["layers"], k_dense, L - k_dense, cfg.is_moe
     )
-    cos = cos.astype(cfg.dtype)
-    sin = sin.astype(cfg.dtype)
-
-    body = partial(_decoder_layer, cfg=cfg, cos=cos, sin=sin, segment_ids=segment_ids)
-    if cfg.remat:
-        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
-
-    def scan_fn(carry, lp):
-        new_hidden, aux = body(carry, lp)
-        return new_hidden, aux
-
-    hidden, auxes = jax.lax.scan(scan_fn, hidden, compute["layers"])
-    hidden = ops.rms_norm(hidden, compute["norm"], cfg.rms_norm_eps)
-    return hidden, auxes.sum()
+    auxes_total = auxes_total + auxes
+    hidden = _norm(hidden, compute["norm"], cfg)
+    return hidden, auxes_total
 
 
 def lm_head_kernel(params: Params, cfg: TransformerConfig):
@@ -234,7 +521,10 @@ def lm_head_kernel(params: Params, cfg: TransformerConfig):
 def forward_logits(params, cfg, input_ids, position_ids, segment_ids=None):
     hidden, _ = forward_hidden(params, cfg, input_ids, position_ids, segment_ids)
     kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
-    return jnp.dot(hidden, kernel, preferred_element_type=jnp.float32)
+    logits = jnp.dot(hidden, kernel, preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap:
+        logits = cfg.final_logit_softcap * jnp.tanh(logits / cfg.final_logit_softcap)
+    return logits
 
 
 def sequence_logprob_sums(
@@ -265,7 +555,8 @@ def head_loss(
     b, s, h = hidden.shape
     kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
     loss_sum, ntokens = ops.fused_linear_cross_entropy(
-        hidden.reshape(b * s, h), kernel, labels.reshape(b * s)
+        hidden.reshape(b * s, h), kernel, labels.reshape(b * s),
+        logit_softcap=cfg.final_logit_softcap or None,
     )
     metrics = {"loss_sum": loss_sum, "ntokens": ntokens, "moe_aux_loss": moe_aux}
     total = loss_sum

@@ -37,7 +37,8 @@ def _attention_xla(
     segment_ids: Optional[jax.Array] = None,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
-    sliding_window: Optional[int] = None,
+    sliding_window=None,  # python int OR traced int32 scalar (0/<=0 = full)
+    sinks: Optional[jax.Array] = None,  # [Hq] learned sink logits (gpt_oss)
 ):
     b, sq, hq, d = q.shape
     sk = k.shape[1]
@@ -53,7 +54,9 @@ def _attention_xla(
         ki = jnp.arange(sk)[None, :]
         mask = qi >= ki
         if sliding_window is not None:
-            mask = mask & (qi - ki < sliding_window)
+            # traced windows encode "full attention" as <= 0
+            in_window = (qi - ki < sliding_window) | jnp.less_equal(sliding_window, 0)
+            mask = mask & in_window
         mask = mask[None, None]
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
@@ -61,7 +64,15 @@ def _attention_xla(
         mask = seg if mask is None else (mask & seg)
     if mask is not None:
         scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if sinks is not None:
+        # per-head sink logit participates in the softmax denominator only
+        sink = jnp.broadcast_to(
+            sinks.astype(jnp.float32)[None, :, None, None], (b, hq, sq, 1)
+        )
+        full = jnp.concatenate([scores, sink], axis=-1)
+        probs = jax.nn.softmax(full, axis=-1)[..., :sk].astype(q.dtype)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -72,13 +83,15 @@ def attention(
     segment_ids: Optional[jax.Array] = None,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
-    sliding_window: Optional[int] = None,
+    sliding_window=None,
+    sinks: Optional[jax.Array] = None,
 ):
     """SP-aware facade (reference ``ops/kernels/attention/__init__.py:30-86``):
     under an ambient ParallelState with ulysses > 1, wraps the resolved
     kernel in the Ulysses a2a shard_map."""
     inner = resolve_op("attention")
-    kwargs = dict(causal=causal, softmax_scale=softmax_scale, sliding_window=sliding_window)
+    kwargs = dict(causal=causal, softmax_scale=softmax_scale,
+                  sliding_window=sliding_window, sinks=sinks)
     from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
 
     pstate = get_parallel_state_or_none()

@@ -346,18 +346,22 @@ def flash_attention(
     segment_ids: Optional[jax.Array] = None,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
-    sliding_window: Optional[int] = None,
+    sliding_window=None,
+    sinks: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ):
     """[B, S, H, D] facade-layout wrapper. Falls back to the XLA impl for
-    shapes/features the kernel doesn't cover (sliding window, tiny/ragged S).
+    shapes/features the kernel doesn't cover (sliding window, sinks, MLA's
+    asymmetric v-dim, tiny/ragged S).
     """
     b, s, hq, d = q.shape
     bq, bk = min(block_q, s), min(block_k, s)
     # kernel path needs lane-aligned blocks that tile the sequence exactly
     if (
         sliding_window is not None
+        or sinks is not None
+        or v.shape[-1] != d
         or s % bq or s % bk or bq % 128 or bk % 128
         or hq % k.shape[2]
     ):
@@ -366,6 +370,7 @@ def flash_attention(
         return _attention_xla(
             q, k, v, segment_ids=segment_ids, causal=causal,
             softmax_scale=softmax_scale, sliding_window=sliding_window,
+            sinks=sinks,
         )
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
     if segment_ids is None:

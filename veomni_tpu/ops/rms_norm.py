@@ -13,13 +13,16 @@ from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
 
 
 @KERNEL_REGISTRY.register("rms_norm", "xla")
-def _rms_norm_xla(x, weight, eps: float = 1e-6):
+def _rms_norm_xla(x, weight, eps: float = 1e-6, zero_centered: bool = False):
     dtype = x.dtype
     x = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     x = x * jnp.reciprocal(jnp.sqrt(var + eps))
-    return (x * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if zero_centered:  # gemma family stores (w - 1)
+        w = 1.0 + w
+    return (x * w).astype(dtype)
 
 
-def rms_norm(x, weight, eps: float = 1e-6):
-    return resolve_op("rms_norm")(x, weight, eps)
+def rms_norm(x, weight, eps: float = 1e-6, zero_centered: bool = False):
+    return resolve_op("rms_norm")(x, weight, eps, zero_centered)

@@ -11,14 +11,24 @@ import jax.numpy as jnp
 from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
 
 
-def _scale_inv_freq(inv_freq, rope_scaling):
-    """Apply HF-style rope_scaling (llama3 / linear) to base frequencies."""
+import math
+
+
+def yarn_get_mscale(factor: float, mscale: float = 1.0) -> float:
+    if factor <= 1.0:
+        return 1.0
+    return 0.1 * mscale * math.log(factor) + 1.0
+
+
+def _scale_inv_freq(inv_freq, rope_scaling, head_dim: int, theta: float):
+    """Apply HF-style rope_scaling (llama3 / linear / yarn) to base freqs.
+    Returns (inv_freq, attention_scale_multiplier)."""
     if not rope_scaling:
-        return inv_freq
+        return inv_freq, 1.0
     rtype = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
     factor = float(rope_scaling.get("factor", 1.0))
     if rtype in ("linear",):
-        return inv_freq / factor
+        return inv_freq / factor, 1.0
     if rtype == "llama3":
         low = float(rope_scaling.get("low_freq_factor", 1.0))
         high = float(rope_scaling.get("high_freq_factor", 4.0))
@@ -28,19 +38,74 @@ def _scale_inv_freq(inv_freq, rope_scaling):
         smooth = (orig / wavelen - low) / (high - low)
         smooth = jnp.clip(smooth, 0.0, 1.0)
         scaled = inv_freq / factor
-        return (1 - smooth) * scaled + smooth * inv_freq
-    if rtype in ("default", "dynamic", "yarn"):
-        return inv_freq  # dynamic/yarn: training-time tables use base freqs
+        return (1 - smooth) * scaled + smooth * inv_freq, 1.0
+    if rtype == "yarn":
+        orig = float(rope_scaling.get("original_max_position_embeddings", 4096))
+        beta_fast = float(rope_scaling.get("beta_fast", 32))
+        beta_slow = float(rope_scaling.get("beta_slow", 1))
+
+        def correction_dim(num_rot):
+            return (head_dim / 2) * math.log(orig / (num_rot * 2 * math.pi)) / math.log(theta)
+
+        low = max(math.floor(correction_dim(beta_fast)), 0)
+        high = min(math.ceil(correction_dim(beta_slow)), head_dim // 2 - 1)
+        ramp = jnp.clip(
+            (jnp.arange(head_dim // 2, dtype=jnp.float32) - low) / max(high - low, 1e-3),
+            0.0, 1.0,
+        )
+        extrap_mask = 1.0 - ramp  # 1 where high-freq (keep base)
+        inv = inv_freq / factor * (1 - extrap_mask) + inv_freq * extrap_mask
+        mscale_all_dim = float(rope_scaling.get("mscale_all_dim", 0.0))
+        # deepseek attention-scale correction (applied by the caller)
+        att = yarn_get_mscale(factor, mscale_all_dim) ** 2 if mscale_all_dim else 1.0
+        # HF also scales cos/sin by yarn_get_mscale(factor, mscale)/yarn_get_mscale(factor, mscale_all_dim)
+        return inv, att
+    if rtype in ("default", "dynamic"):
+        return inv_freq, 1.0
     raise ValueError(f"unsupported rope_scaling type {rtype!r}")
 
 
-def rotary_tables(positions, head_dim: int, theta: float = 10000.0, rope_scaling=None):
-    """positions [B,S] int -> (cos, sin) each [B,S,head_dim]."""
+def yarn_attention_factor(rope_scaling, head_dim: int) -> float:
+    """Softmax-scale multiplier for yarn (deepseek mscale^2 correction)."""
+    if not rope_scaling:
+        return 1.0
+    rtype = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rtype != "yarn":
+        return 1.0
+    factor = float(rope_scaling.get("factor", 1.0))
+    mscale_all_dim = float(rope_scaling.get("mscale_all_dim", 0.0))
+    if not mscale_all_dim:
+        return 1.0
+    return yarn_get_mscale(factor, mscale_all_dim) ** 2
+
+
+def rotary_tables(
+    positions, head_dim: int, theta: float = 10000.0, rope_scaling=None,
+    interleaved: bool = False,
+):
+    """positions [B,S] int -> (cos, sin) each [B,S,head_dim].
+
+    ``interleaved``: pairwise (deepseek) layout — each half-frequency entry
+    is repeated twice adjacently instead of concatenated halves. Also scales
+    cos/sin by the yarn mscale ratio when rope_scaling requests it (HF
+    deepseek _compute_yarn_parameters attention_factor)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    inv_freq = _scale_inv_freq(inv_freq, rope_scaling)
+    inv_freq, _ = _scale_inv_freq(inv_freq, rope_scaling, head_dim, theta)
     ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,S,D/2]
-    ang = jnp.concatenate([ang, ang], axis=-1)  # [B,S,D]
-    return jnp.cos(ang), jnp.sin(ang)
+    if interleaved:
+        ang = jnp.repeat(ang, 2, axis=-1)  # [B,S,D] pairwise
+    else:
+        ang = jnp.concatenate([ang, ang], axis=-1)  # [B,S,D]
+    scale = 1.0
+    if rope_scaling and rope_scaling.get("rope_type", rope_scaling.get("type")) == "yarn":
+        factor = float(rope_scaling.get("factor", 1.0))
+        mscale = float(rope_scaling.get("mscale", 1.0))
+        mscale_all = float(rope_scaling.get("mscale_all_dim", 0.0))
+        if mscale_all:
+            scale = yarn_get_mscale(factor, mscale) / yarn_get_mscale(factor, mscale_all)
+        else:
+            scale = yarn_get_mscale(factor, 1.0)
+    return jnp.cos(ang) * scale, jnp.sin(ang) * scale
 
 
 def _rotate_half(x):
@@ -48,18 +113,25 @@ def _rotate_half(x):
     return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
 
 
+def _rotate_interleave(x):
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+
 @KERNEL_REGISTRY.register("rotary", "xla")
-def _apply_rotary_xla(q, k, cos, sin):
+def _apply_rotary_xla(q, k, cos, sin, interleaved: bool = False):
     """q [B,S,Hq,D], k [B,S,Hk,D], cos/sin [B,S,D]."""
     dtype = q.dtype
+    rot = _rotate_interleave if interleaved else _rotate_half
     cos = cos[..., None, :]
     sin = sin[..., None, :]
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
-    q_out = qf * cos + _rotate_half(qf) * sin
-    k_out = kf * cos + _rotate_half(kf) * sin
+    q_out = qf * cos + rot(qf) * sin
+    k_out = kf * cos + rot(kf) * sin
     return q_out.astype(dtype), k_out.astype(dtype)
 
 
-def apply_rotary(q, k, cos, sin):
-    return resolve_op("rotary")(q, k, cos, sin)
+def apply_rotary(q, k, cos, sin, interleaved: bool = False):
+    return resolve_op("rotary")(q, k, cos, sin, interleaved)

@@ -36,10 +36,10 @@ from veomni_tpu import ops
 from veomni_tpu.parallel.parallel_state import AXIS_EP, ParallelState
 
 
-def _dispatch_combine(x2d, topk_idx, topk_probs, gate_w, up_w, down_w, *,
+def _dispatch_combine(x2d, topk_idx, topk_probs, experts_local, *, cfg,
                       ep: int, e_loc: int, capacity: int, dtype):
-    """Per-device body. x2d [T,H]; topk_* [T,K]; expert weights local
-    [e_loc, H, I] / [e_loc, I, H]."""
+    """Per-device body. x2d [T,H]; topk_* [T,K]; experts_local: dict of
+    expert tensors with local expert dim [e_loc, ...]."""
     t, h = x2d.shape
     k = topk_idx.shape[-1]
     n_assign = t * k
@@ -82,9 +82,11 @@ def _dispatch_combine(x2d, topk_idx, topk_probs, gate_w, up_w, down_w, *,
     xs = rx[sort_idx]
     group_sizes = jnp.bincount(rle_safe, length=e_loc)
 
-    gate = ops.group_gemm(xs, gate_w, group_sizes)
-    up = ops.group_gemm(xs, up_w, group_sizes)
-    out_s = ops.group_gemm(ops.swiglu(gate, up), down_w, group_sizes)
+    from veomni_tpu.models.transformer import experts_apply_sorted
+
+    out_s = experts_apply_sorted(
+        xs, experts_local, group_sizes, rle_safe[sort_idx], cfg
+    )
 
     out = jnp.zeros_like(rx).at[sort_idx].set(out_s)
     out = out.reshape(ep, capacity, h)
@@ -106,15 +108,13 @@ def ep_moe_mlp(x, lp, cfg, pstate: ParallelState):
     ep = pstate.ep_size
     e_loc = e // ep
 
-    # ---- routing + aux loss on the global view (cheap; GSPMD-sharded)
-    router_logits = jnp.einsum(
-        "bsh,he->bse", x, lp["router"], preferred_element_type=jnp.float32
-    )
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    topk_probs, topk_idx = jax.lax.top_k(probs, k)
-    if cfg.norm_topk_prob:
-        topk_probs = topk_probs / jnp.clip(topk_probs.sum(-1, keepdims=True), 1e-9)
-    aux = ops.load_balancing_loss(probs.reshape(-1, e), topk_idx.reshape(-1, k), e)
+    # ---- routing + aux loss on the global view (cheap; GSPMD-sharded),
+    # shared with the single-device path so every dialect matches
+    from veomni_tpu.models.transformer import route_tokens
+
+    topk_idx, topk_probs, aux = route_tokens(x.reshape(b * s, h), lp, cfg)
+    topk_idx = topk_idx.reshape(b, s, k)
+    topk_probs = topk_probs.reshape(b, s, k)
 
     # ---- dispatch/compute/combine inside shard_map
     dp, spx = pstate.dp_axes, pstate.sp_axes
@@ -129,25 +129,31 @@ def ep_moe_mlp(x, lp, cfg, pstate: ParallelState):
 
     x_spec = P(dp, spx, None)
     topk_spec = P(dp, spx, None)
-    ew_spec = P(AXIS_EP, None, None)
+    experts = lp["experts"]
+    # expert tensors shard dim 0 (experts) over ep; other dims gathered local
+    experts_specs = jax.tree.map(
+        lambda t: P(AXIS_EP, *([None] * (t.ndim - 1))), experts
+    )
 
-    def body(x3, ti, tp, gw, uw, dw):
+    def body(x3, ti, tp, experts_local):
         bl, sl, _ = x3.shape
         out = _dispatch_combine(
             x3.reshape(bl * sl, h), ti.reshape(bl * sl, k), tp.reshape(bl * sl, k),
-            gw, uw, dw, ep=ep, e_loc=e_loc, capacity=capacity, dtype=x3.dtype,
+            experts_local, cfg=cfg, ep=ep, e_loc=e_loc, capacity=capacity,
+            dtype=x3.dtype,
         )
         return out.reshape(bl, sl, h)
 
     fn = shard_map(
         body,
         mesh=pstate.mesh,
-        in_specs=(x_spec, topk_spec, topk_spec, ew_spec, ew_spec, ew_spec),
+        in_specs=(x_spec, topk_spec, topk_spec, experts_specs),
         out_specs=x_spec,
         check_vma=False,
     )
-    out = fn(
-        x, topk_idx, topk_probs,
-        lp["experts"]["gate_proj"], lp["experts"]["up_proj"], lp["experts"]["down_proj"],
-    )
+    out = fn(x, topk_idx, topk_probs, experts)
+    if cfg.n_shared_experts:
+        from veomni_tpu.models.transformer import _shared_experts_out
+
+        out = out + _shared_experts_out(x, lp, cfg)
     return out, aux

@@ -92,7 +92,7 @@ class ParallelPlan:
 
     rules: Dict[str, SpecTemplate] = field(default_factory=dict)
     default_fsdp: bool = True
-    stacked_layer_prefixes: Tuple[str, ...] = ("layers",)
+    stacked_layer_prefixes: Tuple[str, ...] = ("layers", "dense_layers")
 
     def _default_spec(self, shape, state: ParallelState) -> SpecTemplate:
         if not self.default_fsdp or not shape:

@@ -63,12 +63,14 @@ def ulysses_attention(
     # GQA: repeat kv heads up to a multiple of sp (reference ulysses.py:42-48)
     kv_rep = sp // math.gcd(hkv, sp)
 
+    sinks = attn_kwargs.pop("sinks", None)
     dp, spx = pstate.dp_axes, pstate.sp_axes
     qkv_spec = P(dp, spx, None, None)
     seg_spec = P(dp, spx) if segment_ids is not None else None
+    sinks_spec = P(AXIS_ULYSSES) if sinks is not None else None
 
-    def body(q, k, v, seg):
-        # local shapes: [b, s/sp, h, d]
+    def body(q, k, v, seg, snk):
+        # local shapes: [b, s/sp, h, d]; snk holds this rank's head slice
         k = _repeat_heads(k, kv_rep)
         v = _repeat_heads(v, kv_rep)
         # heads -> scattered, seq -> gathered
@@ -81,10 +83,10 @@ def ulysses_attention(
         seg_g = None
         if seg is not None:
             seg_g = jax.lax.all_gather(seg, AXIS_ULYSSES, axis=1, tiled=True)  # [b, s]
-        out = inner_attention(q_g, k_g, v_g, segment_ids=seg_g, **attn_kwargs)
+        out = inner_attention(q_g, k_g, v_g, segment_ids=seg_g, sinks=snk, **attn_kwargs)
         return a2a(out, split_axis=1, concat_axis=2)  # [b, s/sp, hq, d]
 
-    in_specs = (qkv_spec, qkv_spec, qkv_spec, seg_spec)
+    in_specs = (qkv_spec, qkv_spec, qkv_spec, seg_spec, sinks_spec)
     fn = shard_map(
         body,
         mesh=pstate.mesh,
@@ -92,7 +94,7 @@ def ulysses_attention(
         out_specs=qkv_spec,
         check_vma=False,
     )
-    return fn(q, k, v, segment_ids)
+    return fn(q, k, v, segment_ids, sinks)
 
 
 def sp_pad_length(seq_len: int, sp_size: int) -> int:
