@@ -1,0 +1,24 @@
+"""Omni (audio+vision+text) training entry point.
+
+Reference: ``tasks/omni/train_omni_model.py`` — the reference's fully linear
+trainer-free script; here the same library calls are wrapped by OmniTrainer.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from veomni_tpu.arguments import VeOmniArguments, parse_args, save_args
+from veomni_tpu.trainer.omni_trainer import OmniTrainer
+
+
+def main():
+    args = parse_args(VeOmniArguments)
+    save_args(args, args.train.output_dir)
+    trainer = OmniTrainer(args)
+    trainer.train()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""DiT diffusion training entry point (reference: ``tasks/train_dit.py``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from veomni_tpu.arguments import VeOmniArguments, parse_args, save_args
+from veomni_tpu.trainer.dit_trainer import DiTTrainer
+
+
+def main():
+    args = parse_args(VeOmniArguments)
+    save_args(args, args.train.output_dir)
+    trainer = DiTTrainer(args)
+    trainer.train()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Text RL (PPO-style) post-training entry point.
+
+Reference: ``tasks/train_text_rl.py`` — rollouts come from an external
+engine; this consumes (prompt, response, advantage, old_logprobs) rows.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from veomni_tpu.arguments import VeOmniArguments, parse_args, save_args
+from veomni_tpu.trainer.rl_trainer import BaseRLTrainer
+
+
+def main():
+    args = parse_args(VeOmniArguments)
+    save_args(args, args.train.output_dir)
+    trainer = BaseRLTrainer(args)
+    trainer.train()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Aux subsystems: channel loss accounting, MoE router monitor, determinism
+shim, remat policies."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.arguments import VeOmniArguments
+
+TOY = {
+    "model_type": "qwen3",
+    "vocab_size": 256,
+    "hidden_size": 64,
+    "intermediate_size": 128,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "head_dim": 16,
+    "qk_norm": True,
+}
+
+
+def test_channel_loss_e2e(tmp_path):
+    from veomni_tpu.trainer import TextTrainer
+
+    rng = np.random.default_rng(0)
+    with open(tmp_path / "data.jsonl", "w") as f:
+        for i in range(128):
+            f.write(json.dumps({
+                "input_ids": rng.integers(0, 256, int(rng.integers(16, 60))).tolist(),
+                "channel": "web" if i % 2 else "code",
+            }) + "\n")
+
+    args = VeOmniArguments()
+    args.model.config_overrides = dict(TOY)
+    args.data.train_path = str(tmp_path / "data.jsonl")
+    args.data.data_type = "pretokenized"
+    args.data.max_seq_len = 128
+    args.data.channel_list = ["code", "web"]
+    args.train.output_dir = str(tmp_path / "out")
+    args.train.micro_batch_size = 1
+    args.train.train_steps = 3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.save_hf_weights = False
+    args.train.log_steps = 100
+    trainer = TextTrainer(args)
+    cb = [c for c in trainer.callbacks if type(c).__name__ == "ChannelLossCallback"][0]
+    trainer.train()
+    assert sum(cb._counts) > 0, "no channel tokens accounted"
+    assert all(c > 0 for c in cb._counts), f"channel counts {cb._counts}"
+    trainer.checkpointer.close()
+
+
+def test_moe_router_capture():
+    from veomni_tpu.models import TransformerConfig, build_foundation_model
+    from veomni_tpu.utils.moe_monitor import capture_router_stats
+
+    cfg = TransformerConfig(
+        **{**TOY, "model_type": "qwen3_moe"},
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        dtype=jnp.float32,
+    )
+    model = build_foundation_model(config=cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = {
+        "input_ids": jnp.ones((1, 32), jnp.int32),
+        "position_ids": jnp.broadcast_to(jnp.arange(32), (1, 32)),
+        "segment_ids": jnp.ones((1, 32), jnp.int32),
+    }
+    stats = capture_router_stats(model, params, batch)
+    assert stats["expert_load"].shape == (2, 4)  # 2 moe layers, 4 experts
+    np.testing.assert_allclose(stats["expert_load"].sum(1), 1.0, rtol=1e-6)
+
+
+def test_remat_policies_run():
+    from veomni_tpu.models import TransformerConfig, build_foundation_model
+
+    for policy in ("nothing", "dots"):
+        cfg = TransformerConfig(**TOY, dtype=jnp.float32, remat_policy=policy)
+        model = build_foundation_model(config=cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        batch = {
+            "input_ids": jnp.ones((1, 16), jnp.int32),
+            "labels": jnp.ones((1, 16), jnp.int32),
+            "position_ids": jnp.broadcast_to(jnp.arange(16), (1, 16)),
+            "segment_ids": jnp.ones((1, 16), jnp.int32),
+        }
+        g = jax.grad(lambda p: model.loss_fn(p, batch)[0])(params)
+        assert np.isfinite(float(jax.tree.leaves(g)[0].sum()))
+
+
+def test_batch_invariant_shim():
+    from veomni_tpu.utils.determinism import set_batch_invariant_mode
+
+    with set_batch_invariant_mode(True):
+        pass

@@ -1,0 +1,61 @@
+"""Omni composite model: audio encoder, multi-modality merge, e2e training."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.arguments import VeOmniArguments
+
+TEXT = dict(model_type="qwen2", vocab_size=600, hidden_size=64,
+            intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, attention_bias=True)
+VISION = dict(image_size=28, patch_size=7, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=2, num_attention_heads=2, spatial_merge_size=2)
+AUDIO = dict(n_mels=16, max_frames=32, subsample=4, hidden_size=32,
+             intermediate_size=64, num_hidden_layers=2, num_attention_heads=2)
+
+
+def test_audio_encoder_shapes():
+    from veomni_tpu.models.omni import AudioEncoderConfig, audio_forward, init_audio_params
+
+    cfg = AudioEncoderConfig(**AUDIO, out_hidden_size=64)
+    params = init_audio_params(jax.random.PRNGKey(0), cfg)
+    feats = audio_forward(params, cfg, jnp.ones((3, 32, 16)))
+    assert feats.shape == (3, cfg.tokens_per_audio, 64)
+
+
+def test_omni_trainer_e2e(tmp_path):
+    from veomni_tpu.trainer.omni_trainer import OmniTrainer
+
+    rng = np.random.default_rng(0)
+    with open(tmp_path / "omni.jsonl", "w") as f:
+        for i in range(48):
+            row = {"input_ids": rng.integers(0, 500, int(rng.integers(10, 30))).tolist()}
+            if i % 2:
+                row["images"] = [rng.random((28, 28, 3)).tolist()]
+            if i % 3:
+                row["audio"] = [rng.random((32, 16)).tolist()]
+            f.write(json.dumps(row) + "\n")
+
+    args = VeOmniArguments()
+    args.model.config_overrides = {
+        "text": dict(TEXT), "vision": dict(VISION), "audio": dict(AUDIO),
+        "image_token_id": 510, "audio_token_id": 511, "freeze_audio": False,
+    }
+    args.data.train_path = str(tmp_path / "omni.jsonl")
+    args.data.max_seq_len = 96
+    args.train.output_dir = str(tmp_path / "out")
+    args.train.micro_batch_size = 1
+    args.train.train_steps = 3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.save_hf_weights = True
+    args.train.log_steps = 100
+    trainer = OmniTrainer(args)
+    ctl = trainer.train()
+    assert ctl.global_step == 3
+    assert np.isfinite(ctl.metrics["loss"])
+    assert (tmp_path / "out" / "hf_ckpt" / "language_model" / "model.safetensors").exists()
+    trainer.checkpointer.close()

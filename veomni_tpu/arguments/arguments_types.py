@@ -46,6 +46,9 @@ class DataArguments:
     drop_last: bool = True
     dyn_bsz: bool = False             # token-budget dynamic batching
     dyn_bsz_buffer_size: int = 200
+    # per-source loss accounting: names of data channels; samples carry a
+    # "channel" field (name or index). Empty = disabled.
+    channel_list: List[str] = field(default_factory=list)
     samples_per_micro_batch: int = 8  # packing fill pool per micro-batch
 
 
@@ -78,6 +81,7 @@ class TrainingArguments:
     betas: List[float] = field(default_factory=lambda: [0.9, 0.999])
     max_grad_norm: float = 1.0
     dpo_beta: float = 0.1
+    ppo_clip_ratio: float = 0.2
     # schedule/steps
     train_steps: int = 0              # 0 -> derive from epochs * len(dataloader)
     num_train_epochs: int = 1

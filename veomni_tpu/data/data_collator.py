@@ -63,7 +63,9 @@ class TextPackingCollator:
         *,
         sp_size: int = 1,
         drop_oversized: bool = True,
+        with_channels: bool = False,
     ):
+        self.with_channels = with_channels
         if seq_len % max(sp_size, 1):
             raise ValueError(f"seq_len {seq_len} must be divisible by sp_size {sp_size}")
         self.seq_len = seq_len
@@ -79,7 +81,8 @@ class TextPackingCollator:
         return {
             "pending": [
                 {"input_ids": list(map(int, s["input_ids"])),
-                 "labels": list(map(int, s.get("labels", s["input_ids"])))}
+                 "labels": list(map(int, s.get("labels", s["input_ids"]))),
+                 **({"channel": int(s["channel"])} if "channel" in s else {})}
                 for s in self._pending
             ],
             "dropped_oversized": self.dropped_oversized,
@@ -97,6 +100,7 @@ class TextPackingCollator:
         labels = np.full((b, s), IGNORE_INDEX, np.int32)
         position_ids = np.zeros((b, s), np.int32)
         segment_ids = np.zeros((b, s), np.int32)
+        channel_ids = np.full((b, s), -1, np.int32) if self.with_channels else None
         fill = [0] * b
         nseg = [0] * b
 
@@ -125,9 +129,14 @@ class TextPackingCollator:
             position_ids[row, lo:hi] = np.arange(n)
             nseg[row] += 1
             segment_ids[row, lo:hi] = nseg[row]
+            if channel_ids is not None:
+                channel_ids[row, lo:hi] = int(sample.get("channel", -1))
             fill[row] = hi
 
-        return PackedBatch(input_ids, labels, position_ids, segment_ids).as_dict()
+        out = PackedBatch(input_ids, labels, position_ids, segment_ids).as_dict()
+        if channel_ids is not None:
+            out["channel_ids"] = channel_ids
+        return out
 
 
 def stack_micro_batches(micro_batches: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:

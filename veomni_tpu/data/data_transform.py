@@ -16,10 +16,16 @@ IGNORE_INDEX = -100
 
 
 @DATA_TRANSFORM_REGISTRY.register("pretokenized")
-def build_pretokenized_transform(tokenizer=None, **_) -> Callable:
+def build_pretokenized_transform(tokenizer=None, channel_list=None, **_) -> Callable:
+    channel_index = {name: i for i, name in enumerate(channel_list or [])}
+
     def transform(row: Dict[str, Any]) -> Dict[str, Any]:
         ids = list(row["input_ids"])
-        return {"input_ids": ids, "labels": list(row.get("labels", ids))}
+        out = {"input_ids": ids, "labels": list(row.get("labels", ids))}
+        if "channel" in row:
+            ch = row["channel"]
+            out["channel"] = channel_index.get(ch, ch if isinstance(ch, int) else -1)
+        return out
 
     return transform
 

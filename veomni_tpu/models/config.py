@@ -82,6 +82,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16       # activation/compute dtype
     param_dtype: Any = jnp.float32  # master param dtype
     remat: bool = True              # jax.checkpoint each decoder layer
+    # remat policy: "nothing" (full recompute), "dots" (save matmul outputs),
+    # "offload" (save dots to host memory — the TPU analogue of the
+    # reference's CPU activation offload, distributed/offloading.py:74)
+    remat_policy: str = "nothing"
     initializer_range: float = 0.02
 
     def __post_init__(self):

@@ -1,0 +1,179 @@
+"""DiT: diffusion transformer for latent image/video generation.
+
+Reference capability: ``veomni/models/diffusers/`` (wan_t2v, qwen_image,
+ltx2_3 DiT models trained by DiTTrainer with the FlowMatch scheduler).
+TPU-first design mirrors the text core: stacked adaLN-zero blocks scanned
+with ``lax.scan``, full (non-causal) attention through the shared
+``ops.attention`` facade, conditioning = timestep sinusoidal embedding +
+(pre-computed) text/condition embedding.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu import ops
+
+
+@dataclass
+class DiTConfig:
+    model_type: str = "dit"
+    latent_size: int = 32      # latent grid (H == W)
+    latent_channels: int = 4
+    patch_size: int = 2
+    hidden_size: int = 384
+    num_hidden_layers: int = 8
+    num_attention_heads: int = 6
+    mlp_ratio: float = 4.0
+    cond_dim: int = 512        # pre-computed condition embedding dim
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    def __post_init__(self):
+        if isinstance(self.dtype, str):
+            self.dtype = getattr(jnp, self.dtype)
+        if isinstance(self.param_dtype, str):
+            self.param_dtype = getattr(jnp, self.param_dtype)
+
+    @property
+    def tokens(self) -> int:
+        return (self.latent_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.latent_channels * self.patch_size ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0):
+    """Sinusoidal [B] -> [B, dim]."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+def init_dit_params(rng: jax.Array, cfg: DiTConfig) -> Dict[str, Any]:
+    pd = cfg.param_dtype
+    s = cfg.initializer_range
+    h = cfg.hidden_size
+    inter = int(h * cfg.mlp_ratio)
+    L = cfg.num_hidden_layers
+    keys = iter(jax.random.split(rng, 32))
+
+    def init(shape, scale=s):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(pd)
+
+    return {
+        "patch_embed": init((cfg.patch_dim, h)),
+        "pos_embed": init((cfg.tokens, h)),
+        "t_embed": {"fc1": init((256, h)), "fc2": init((h, h))},
+        "cond_embed": init((cfg.cond_dim, h)),
+        "layers": {
+            # adaLN-zero: 6 modulation vectors per block from the cond signal
+            "mod": jnp.zeros((L, h, 6 * h), pd),
+            "mod_bias": jnp.zeros((L, 6 * h), pd),
+            "qkv": init((L, h, 3 * h)),
+            "proj": init((L, h, h)),
+            "fc1": init((L, h, inter)),
+            "fc2": init((L, inter, h)),
+        },
+        "final_mod": jnp.zeros((h, 2 * h), pd),
+        "final_mod_bias": jnp.zeros((2 * h,), pd),
+        "final_proj": jnp.zeros((h, cfg.patch_dim), pd),  # zero-init output
+    }
+
+
+def abstract_dit_params(cfg: DiTConfig):
+    return jax.eval_shape(lambda: init_dit_params(jax.random.PRNGKey(0), cfg))
+
+
+def _modulate(x, shift, scale):
+    return x * (1 + scale[:, None, :]) + shift[:, None, :]
+
+
+def _ln(x):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6)
+
+
+def _dit_block(x, c, lp, cfg: DiTConfig):
+    """x [B,T,H]; c [B,H] conditioning."""
+    b, t, h = x.shape
+    mod = jnp.dot(jax.nn.silu(c), lp["mod"]) + lp["mod_bias"]  # [B, 6H]
+    sa_shift, sa_scale, sa_gate, mlp_shift, mlp_scale, mlp_gate = jnp.split(mod, 6, -1)
+
+    y = _modulate(_ln(x), sa_shift, sa_scale)
+    qkv = jnp.dot(y, lp["qkv"]).reshape(b, t, 3 * cfg.num_attention_heads, cfg.head_dim)
+    q, k, v = jnp.split(qkv, 3, axis=2)
+    attn = ops.attention(q, k, v, causal=False).reshape(b, t, h)
+    x = x + sa_gate[:, None, :] * jnp.dot(attn, lp["proj"])
+
+    y = _modulate(_ln(x), mlp_shift, mlp_scale)
+    y = jnp.dot(jax.nn.gelu(jnp.dot(y, lp["fc1"]), approximate=True), lp["fc2"])
+    return x + mlp_gate[:, None, :] * y, None
+
+
+def patchify(latents: jax.Array, cfg: DiTConfig) -> jax.Array:
+    """[B, G, G, C] -> [B, T, patch_dim]."""
+    b = latents.shape[0]
+    g, p, c = cfg.latent_size, cfg.patch_size, cfg.latent_channels
+    n = g // p
+    x = latents.reshape(b, n, p, n, p, c).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, n * n, p * p * c)
+
+
+def unpatchify(x: jax.Array, cfg: DiTConfig) -> jax.Array:
+    b = x.shape[0]
+    g, p, c = cfg.latent_size, cfg.patch_size, cfg.latent_channels
+    n = g // p
+    x = x.reshape(b, n, n, p, p, c).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, g, g, c)
+
+
+def dit_forward(params, cfg: DiTConfig, noisy_latents, t, cond) -> jax.Array:
+    """noisy_latents [B,G,G,C]; t [B]; cond [B, cond_dim] -> velocity field."""
+    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    x = jnp.dot(patchify(noisy_latents.astype(cfg.dtype), cfg), compute["patch_embed"])
+    x = x + compute["pos_embed"]
+
+    temb = timestep_embedding(t * 1000.0, 256).astype(cfg.dtype)
+    c = jnp.dot(jax.nn.silu(jnp.dot(temb, compute["t_embed"]["fc1"])),
+                compute["t_embed"]["fc2"])
+    c = c + jnp.dot(cond.astype(cfg.dtype), compute["cond_embed"])
+
+    body = partial(_dit_block, cfg=cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body, policy=jax.checkpoint_policies.nothing_saveable)
+    x, _ = jax.lax.scan(lambda carry, lp: body(carry, c, lp), x, compute["layers"])
+
+    mod = jnp.dot(jax.nn.silu(c), compute["final_mod"]) + compute["final_mod_bias"]
+    shift, scale = jnp.split(mod, 2, -1)
+    x = _modulate(_ln(x), shift, scale)
+    out = jnp.dot(x, compute["final_proj"])
+    return unpatchify(out.astype(jnp.float32), cfg)
+
+
+def dit_loss_fn(params, cfg: DiTConfig, batch) -> Tuple[jax.Array, Dict]:
+    """FlowMatch MSE: batch {latents, noise, t, cond} (noise/t sampled by the
+    collator so the jit step stays rng-free)."""
+    x0 = batch["latents"].astype(jnp.float32)
+    noise = batch["noise"].astype(jnp.float32)
+    t = batch["t"]
+    x_t = (1.0 - t[:, None, None, None]) * x0 + t[:, None, None, None] * noise
+    target = noise - x0
+    pred = dit_forward(params, cfg, x_t, t, batch["cond"])
+    per_sample = ((pred - target) ** 2).mean(axis=(1, 2, 3))
+    return per_sample.sum(), {"ntokens": jnp.int32(x0.shape[0])}

@@ -1,0 +1,178 @@
+"""Omni composite: any-modality encoders + foundation LM.
+
+Reference: ``veomni/models/seed_omni/modeling_seed_omni.py:63-423``
+(SeedOmniModel = N encoders (vision/audio) + foundation LM + N decoders,
+per-module configs, trainable-only toggles) and qwen2_5_omni/qwen3_omni_moe.
+
+TPU design: like the VLM, every modality occupies *static slots* —
+``pixel_patches [B, max_images, P, D]`` and ``audio_features
+[B, max_audio, frames, mels]`` — and encoder outputs are scattered into the
+token stream at modality-placeholder positions. Freezing is functional
+(stop_gradient per module). Image-generation decoders integrate as a DiT
+head trained separately (models/dit.py); generation-side fusion is round-2
+scope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.models import transformer
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.models.vision import ViTConfig, _vit_layer, init_vit_params, vit_forward
+from veomni_tpu.models.vlm import merge_image_features
+
+
+@dataclass
+class AudioEncoderConfig:
+    n_mels: int = 80
+    max_frames: int = 100          # input frames per audio slot
+    subsample: int = 4             # conv time-subsampling factor
+    hidden_size: int = 256
+    intermediate_size: int = 1024
+    num_hidden_layers: int = 4
+    num_attention_heads: int = 4
+    layer_norm_eps: float = 1e-6
+    out_hidden_size: int = 1024
+    initializer_range: float = 0.02
+
+    @property
+    def tokens_per_audio(self) -> int:
+        return self.max_frames // self.subsample
+
+
+@dataclass
+class OmniConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    vision: Optional[ViTConfig] = None
+    audio: Optional[AudioEncoderConfig] = None
+    image_token_id: int = 151655
+    audio_token_id: int = 151646
+    freeze_vision: bool = False
+    freeze_audio: bool = False
+    freeze_text: bool = False
+    max_images: int = 2
+    max_audio: int = 2
+    model_type: str = "seed_omni"
+
+    def __post_init__(self):
+        if isinstance(self.text, dict):
+            self.text = TransformerConfig(**self.text)
+        if isinstance(self.vision, dict):
+            self.vision = ViTConfig(**self.vision)
+        if isinstance(self.audio, dict):
+            self.audio = AudioEncoderConfig(**self.audio)
+        for enc in (self.vision, self.audio):
+            if enc is not None:
+                enc.out_hidden_size = self.text.hidden_size
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "text"), name)
+
+
+def init_audio_params(rng: jax.Array, cfg: AudioEncoderConfig, dtype=jnp.float32):
+    s = cfg.initializer_range
+    h = cfg.hidden_size
+    keys = iter(jax.random.split(rng, 8))
+
+    def init(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(dtype)
+
+    L = cfg.num_hidden_layers
+    inter = cfg.intermediate_size
+    return {
+        # frame stacking "conv": subsample frames by stacking then projecting
+        "subsample_proj": init((cfg.n_mels * cfg.subsample, h)),
+        "pos_embed": init((cfg.tokens_per_audio, h)),
+        "layers": {
+            "ln1_w": jnp.ones((L, h), dtype), "ln1_b": jnp.zeros((L, h), dtype),
+            "qkv": init((L, h, 3 * h)), "qkv_bias": jnp.zeros((L, 3 * h), dtype),
+            "proj": init((L, h, h)),
+            "ln2_w": jnp.ones((L, h), dtype), "ln2_b": jnp.zeros((L, h), dtype),
+            "fc1": init((L, h, inter)), "fc1_b": jnp.zeros((L, inter), dtype),
+            "fc2": init((L, inter, h)), "fc2_b": jnp.zeros((L, h), dtype),
+        },
+        "out_proj": init((h, cfg.out_hidden_size)),
+    }
+
+
+def audio_forward(params, cfg: AudioEncoderConfig, features: jax.Array) -> jax.Array:
+    """features [N, max_frames, n_mels] -> [N, tokens_per_audio, out_hidden]."""
+    n, frames, mels = features.shape
+    t = cfg.tokens_per_audio
+    x = features.astype(params["subsample_proj"].dtype)
+    x = x[:, : t * cfg.subsample].reshape(n, t, cfg.subsample * mels)
+    x = jnp.dot(x, params["subsample_proj"]) + params["pos_embed"]
+
+    # reuse the generic full-attention encoder block (vision._vit_layer works
+    # on any [N, T, H] with the same param names)
+    vit_like = ViTConfig(
+        hidden_size=cfg.hidden_size, intermediate_size=cfg.intermediate_size,
+        num_attention_heads=cfg.num_attention_heads,
+        layer_norm_eps=cfg.layer_norm_eps,
+    )
+    body = partial(_vit_layer, cfg=vit_like)
+    x, _ = jax.lax.scan(lambda c, lp: body(c, lp), x, params["layers"])
+    return jnp.dot(x, params["out_proj"])
+
+
+def init_omni_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
+    r1, r2, r3 = jax.random.split(rng, 3)
+    params: Dict[str, Any] = {
+        "language_model": transformer.init_params(r1, cfg.text),
+    }
+    if cfg.vision is not None:
+        params["vision_tower"] = init_vit_params(r2, cfg.vision, cfg.text.param_dtype)
+    if cfg.audio is not None:
+        params["audio_tower"] = init_audio_params(r3, cfg.audio, cfg.text.param_dtype)
+    return params
+
+
+def abstract_omni_params(cfg: OmniConfig):
+    return jax.eval_shape(lambda: init_omni_params(jax.random.PRNGKey(0), cfg))
+
+
+def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
+    tcfg = cfg.text
+    lm_params = params["language_model"]
+    if cfg.freeze_text:
+        lm_params = jax.lax.stop_gradient(lm_params)
+    lm = jax.tree.map(lambda p: p.astype(tcfg.dtype), lm_params)
+    input_ids = batch["input_ids"]
+    embeds = lm["embed_tokens"][input_ids]
+    if tcfg.embed_scale:  # forward_hidden skips this for inputs_embeds
+        embeds = embeds * jnp.asarray(tcfg.embed_scale, tcfg.dtype)
+
+    if cfg.vision is not None and "pixel_patches" in batch:
+        vp = params["vision_tower"]
+        if cfg.freeze_vision:
+            vp = jax.lax.stop_gradient(vp)
+        patches = batch["pixel_patches"]
+        bi, mi = patches.shape[:2]
+        feats = vit_forward(vp, cfg.vision, patches.reshape(bi * mi, *patches.shape[2:]))
+        feats = feats.reshape(bi, mi, *feats.shape[1:])
+        embeds = merge_image_features(
+            embeds, input_ids, feats, batch["image_mask"], cfg.image_token_id
+        )
+    if cfg.audio is not None and "audio_features" in batch:
+        ap = params["audio_tower"]
+        if cfg.freeze_audio:
+            ap = jax.lax.stop_gradient(ap)
+        af = batch["audio_features"]
+        bi, ma = af.shape[:2]
+        feats = audio_forward(ap, cfg.audio, af.reshape(bi * ma, *af.shape[2:]))
+        feats = feats.reshape(bi, ma, *feats.shape[1:])
+        embeds = merge_image_features(  # same ordered-slot merge, audio token
+            embeds, input_ids, feats, batch["audio_mask"], cfg.audio_token_id
+        )
+
+    hidden, moe_aux = transformer.forward_hidden(
+        lm_params, tcfg, input_ids, batch["position_ids"],
+        batch.get("segment_ids"), inputs_embeds=embeds,
+    )
+    return transformer.head_loss(lm_params, tcfg, hidden, batch["labels"], moe_aux)

@@ -35,6 +35,18 @@ from veomni_tpu.models.config import TransformerConfig
 Params = Dict[str, Any]
 
 
+def _remat_policy(cfg: TransformerConfig):
+    """Map cfg.remat_policy to a jax.checkpoint policy (the TPU analogue of
+    the reference's activation-offload contexts, ``offloading.py:32-74``)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "offload":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
+    return jax.checkpoint_policies.nothing_saveable
+
+
 # --------------------------------------------------------------------------
 # Init
 # --------------------------------------------------------------------------
@@ -274,12 +286,19 @@ def _shared_experts_out(x, lp, cfg):
                    se["down_proj"])
 
 
+# set by utils/moe_monitor.capture_routing to collect per-layer expert
+# choices during an eager (non-jit) replay forward
+ROUTER_CAPTURE: Optional[list] = None
+
+
 def _moe_mlp(x, lp, cfg: TransformerConfig):
     """Single-device MoE: route -> sort by expert -> grouped GEMM -> unsort.
     x: [T, H]. (Reference eager MoE semantics per dialect.)"""
     t, h = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
     topk_idx, topk_w, aux = route_tokens(x, lp, cfg)
+    if ROUTER_CAPTURE is not None:
+        ROUTER_CAPTURE.append(jax.lax.stop_gradient(topk_idx))
     topk_w = topk_w.astype(x.dtype)
 
     flat_expert = topk_idx.reshape(-1)  # [T*K]
@@ -493,9 +512,7 @@ def forward_hidden(
                 is_moe_segment=is_moe_seg,
             )
             if cfg.remat:
-                body = jax.checkpoint(
-                    body, policy=jax.checkpoint_policies.nothing_saveable
-                )
+                body = jax.checkpoint(body, policy=_remat_policy(cfg))
             hidden, auxes = jax.lax.scan(lambda c, lp: body(c, lp), hidden, sub)
             aux_total = aux_total + auxes.sum()
         return hidden, aux_total

@@ -107,6 +107,8 @@ def vlm_loss_fn(
 
     input_ids = batch["input_ids"]
     embeds = lm["embed_tokens"][input_ids]
+    if tcfg.embed_scale:  # forward_hidden skips this for inputs_embeds
+        embeds = embeds * jnp.asarray(tcfg.embed_scale, tcfg.dtype)
 
     patches = batch["pixel_patches"]
     bi, mi = patches.shape[:2]

@@ -24,7 +24,7 @@ from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
 IGNORE_INDEX = -100
 
 
-def _chunk_ce(h, lab, kernel, logit_softcap):
+def _chunk_ce_per_token_body(h, lab, kernel, logit_softcap):
     logits = jnp.dot(h, kernel, preferred_element_type=jnp.float32)  # [C, V]
     if logit_softcap:
         logits = logit_softcap * jnp.tanh(logits / logit_softcap)
@@ -32,7 +32,11 @@ def _chunk_ce(h, lab, kernel, logit_softcap):
     lab_safe = jnp.where(valid, lab, 0)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, lab_safe[:, None], axis=-1)[:, 0]
-    nll = jnp.where(valid, logz - gold, 0.0)
+    return jnp.where(valid, logz - gold, 0.0), valid
+
+
+def _chunk_ce(h, lab, kernel, logit_softcap):
+    nll, valid = _chunk_ce_per_token_body(h, lab, kernel, logit_softcap)
     return nll.sum(), valid.sum()
 
 
@@ -60,6 +64,32 @@ def _flce_eager(
     hidden, kernel, labels, *, chunk_size: int = 0, logit_softcap: Optional[float] = None
 ) -> Tuple[jax.Array, jax.Array]:
     return _chunk_ce(hidden, labels, kernel, logit_softcap)
+
+
+def _chunk_ce_per_token(h, lab, kernel, logit_softcap):
+    return _chunk_ce_per_token_body(h, lab, kernel, logit_softcap)[0]
+
+
+def fused_linear_cross_entropy_per_token(
+    hidden, kernel, labels, *, chunk_size: int = 4096,
+    logit_softcap: Optional[float] = None,
+):
+    """Per-token NLL [T] (0 where ignored) — the channel-loss / RL path
+    (reference chunk_logprobs, ``ops/kernels/cross_entropy/``)."""
+    t, _ = hidden.shape
+    chunk = min(chunk_size, t)
+    pad = (-t) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=IGNORE_INDEX)
+    n = (t + pad) // chunk
+    hs = hidden.reshape(n, chunk, hidden.shape[-1])
+    ls = labels.reshape(n, chunk)
+    body = jax.checkpoint(
+        partial(_chunk_ce_per_token, kernel=kernel, logit_softcap=logit_softcap)
+    )
+    nll = jax.lax.map(lambda args: body(*args), (hs, ls)).reshape(-1)
+    return nll[:t]
 
 
 def fused_linear_cross_entropy(hidden, kernel, labels, **kwargs):

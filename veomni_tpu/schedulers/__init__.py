@@ -1,0 +1,3 @@
+from veomni_tpu.schedulers.flow_match import FlowMatchScheduler
+
+__all__ = ["FlowMatchScheduler"]

@@ -1,0 +1,97 @@
+"""Per-data-source ("channel") loss accounting.
+
+Reference: ``veomni/trainer/callbacks/channel_loss_callback.py`` (1517 LoC —
+per-source loss/token tracking, checkpointable). TPU design: the collator
+stamps each token with its sample's channel id; the loss fn returns
+per-channel (sum, count) vectors that flow through the train step's extras
+and are accumulated/averaged by ChannelLossCallback.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.models import transformer
+from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy_per_token
+from veomni_tpu.trainer.callbacks import Callback
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def make_channel_loss_fn(model, num_channels: int) -> Callable:
+    """Wrap the text loss to additionally emit per-channel sums.
+    batch needs 'channel_ids' [B,S] (-1 on ignored/pad tokens)."""
+    cfg = model.config
+
+    def loss_fn(params, batch):
+        hidden, moe_aux = transformer.forward_hidden(
+            params, cfg, batch["input_ids"], batch["position_ids"],
+            batch.get("segment_ids"),
+        )
+        b, s, h = hidden.shape
+        kernel = transformer.lm_head_kernel(params, cfg).astype(cfg.dtype)
+        nll = fused_linear_cross_entropy_per_token(
+            hidden.reshape(b * s, h), kernel, batch["labels"].reshape(b * s),
+            logit_softcap=cfg.final_logit_softcap or None,
+        )
+        valid = (batch["labels"].reshape(-1) != -100)
+        loss_sum = nll.sum()
+        ntokens = valid.sum()
+        ch = batch["channel_ids"].reshape(-1)
+        ch_safe = jnp.where(valid & (ch >= 0), ch, num_channels)
+        ch_loss = jax.ops.segment_sum(nll, ch_safe, num_segments=num_channels + 1)[:-1]
+        ch_tokens = jax.ops.segment_sum(
+            valid.astype(jnp.float32), ch_safe, num_segments=num_channels + 1
+        )[:-1]
+        total = loss_sum
+        if cfg.is_moe and cfg.router_aux_loss_coef:
+            total = total + cfg.router_aux_loss_coef * moe_aux * ntokens
+        return total, {
+            "ntokens": ntokens,
+            "channel_loss_sums": ch_loss,
+            "channel_token_counts": ch_tokens,
+        }
+
+    return loss_fn
+
+
+class ChannelLossCallback(Callback):
+    """Accumulates per-channel token-mean loss and logs it periodically
+    (checkpointable via state in extra_state)."""
+
+    def __init__(self, channel_names: List[str], log_steps: int = 50):
+        self.names = list(channel_names)
+        self.log_steps = log_steps
+        self._sums = [0.0] * len(self.names)
+        self._counts = [0.0] * len(self.names)
+
+    def on_step_end(self, trainer, state):
+        sums = state.metrics.pop("channel_loss_sums", None)
+        counts = state.metrics.pop("channel_token_counts", None)
+        if sums is None:
+            return
+        import numpy as np
+
+        sums = np.asarray(sums)
+        counts = np.asarray(counts)
+        for i in range(len(self.names)):
+            self._sums[i] += float(sums[i])
+            self._counts[i] += float(counts[i])
+        if state.global_step % self.log_steps == 0:
+            parts = [
+                f"{n}={self._sums[i] / max(self._counts[i], 1):.4f}"
+                f"({int(self._counts[i])}tok)"
+                for i, n in enumerate(self.names)
+            ]
+            logger.info_rank0("channel_loss | %s", " | ".join(parts))
+
+    def state_dict(self):
+        return {"sums": self._sums, "counts": self._counts}
+
+    def load_state_dict(self, state):
+        self._sums = list(state.get("sums", self._sums))
+        self._counts = list(state.get("counts", self._counts))

@@ -81,7 +81,7 @@ def build_train_step(
         extras = {
             k: v.astype(jnp.float32)
             for k, v in metrics.items()
-            if k not in ("ntokens",) and jnp.ndim(v) == 0
+            if k not in ("ntokens",) and jnp.ndim(v) <= 1
         }
         return grads, loss_sum, metrics["ntokens"], extras
 
@@ -98,7 +98,12 @@ def build_train_step(
         (grads, loss_sum, ntokens), extras_stacked = jax.lax.scan(
             accum, (zero_grads, jnp.float32(0.0), jnp.int32(0)), batch
         )
-        extras = jax.tree.map(lambda x: x.mean(0), extras_stacked)
+        # scalar extras average over micro-steps; vector extras (per-channel
+        # sums) accumulate
+        extras = {
+            k: (x.sum(0) if x.ndim > 1 else x.mean(0))
+            for k, x in extras_stacked.items()
+        }
         denom = jnp.maximum(ntokens, 1).astype(jnp.float32)
         grads = jax.tree.map(lambda g: g / denom, grads)
         grad_norm = optax.global_norm(grads)

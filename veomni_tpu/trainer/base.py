@@ -141,6 +141,7 @@ class BaseTrainer:
         self.data_transform = build_data_transform(
             d.data_type, tokenizer=self.tokenizer,
             text_keys=d.text_keys, max_seq_len=d.max_seq_len,
+            channel_list=d.channel_list,
         )
 
     def _build_dataset(self):
@@ -166,6 +167,7 @@ class BaseTrainer:
             seq_len=d.max_seq_len,
             micro_batch_size=local_mb,
             sp_size=ps.sp_size,
+            with_channels=bool(d.channel_list),
         )
         if d.dyn_bsz:
             from veomni_tpu.data.dynamic_batching import DynamicBatchDataloader
@@ -234,6 +236,8 @@ class BaseTrainer:
             base_params = jax.jit(make_base, out_shardings=param_shardings)(self.rng)
 
         if self.lora_config is not None:
+            if self.args.data.channel_list:
+                raise NotImplementedError("LoRA + channel_list not wired yet")
             # frozen base + trainable adapter tree (reference base.py:411-462)
             from veomni_tpu.lora import apply_lora_to_loss_fn, init_lora_params
             from veomni_tpu.lora.lora import load_adapter, lora_parallel_plan_rules
@@ -269,7 +273,17 @@ class BaseTrainer:
             self.train_state = TrainState(
                 params=base_params, opt_state=opt_state, step=jnp.int32(0)
             )
-            loss_fn = lambda params, batch: model.loss_fn(params, batch)
+            if self.args.data.channel_list:
+                from veomni_tpu.train.channel_loss import make_channel_loss_fn
+
+                if "embed_tokens" not in model.abstract():
+                    raise NotImplementedError(
+                        "data.channel_list is only wired for text-family "
+                        "models (composite VLM/omni param trees unsupported)"
+                    )
+                loss_fn = make_channel_loss_fn(model, len(self.args.data.channel_list))
+            else:
+                loss_fn = lambda params, batch: model.loss_fn(params, batch)
 
         self.batch_shardings = {
             k: NamedSharding(ps.mesh, spec)
@@ -299,6 +313,12 @@ class BaseTrainer:
             LoggingCallback(t.log_steps),
             CheckpointCallback(self.checkpointer, t.save_steps),
         ]
+        if self.args.data.channel_list:
+            from veomni_tpu.train.channel_loss import ChannelLossCallback
+
+            self.callbacks.append(
+                ChannelLossCallback(self.args.data.channel_list, t.log_steps * 10)
+            )
         if t.enable_profiling:
             self.callbacks.append(
                 ProfileCallback(t.output_dir, t.profile_start_step, t.profile_end_step)
@@ -317,7 +337,8 @@ class BaseTrainer:
         """Per-key PartitionSpec for device batches; subclasses extend for
         modality-specific keys (cf. reference DataCollateInfo sp_slice)."""
         ps = self.parallel_state
-        return {k: P(None, ps.dp_axes, ps.sp_axes) for k in BATCH_KEYS}
+        keys = BATCH_KEYS + (("channel_ids",) if self.args.data.channel_list else ())
+        return {k: P(None, ps.dp_axes, ps.sp_axes) for k in keys}
 
     # ----------------------------------------------------------------- resume
     def try_resume(self):
@@ -362,7 +383,10 @@ class BaseTrainer:
                     }
                 self.train_state, metrics = self.train_step(self.train_state, batch)
                 ctl.global_step += 1
-                ctl.metrics = {k: float(v) for k, v in metrics.items()}
+                ctl.metrics = {
+                    k: (float(v) if np.ndim(v) == 0 else np.asarray(v))
+                    for k, v in metrics.items()
+                }
                 ctl.metrics["lr"] = float(self.lr_schedule(ctl.global_step))
                 self._fire("on_step_end", ctl)
             self._fire("on_train_end", ctl)

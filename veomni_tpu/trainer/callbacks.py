@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import jax
+import numpy as np
 
 from veomni_tpu.utils.logging import get_logger
 
@@ -65,10 +66,14 @@ class EnvironMeterCallback(Callback):
 
     def on_step_begin(self, trainer, state):
         batch = trainer.current_batch
-        if batch is not None:
+        if batch is None:
+            return
+        if "labels" in batch:
             labels = batch["labels"]
-            ntokens = int((labels != -100).sum())
-            self.meter.add(ntokens, seq_len=labels.shape[-1])
+            self.meter.add(int((labels != -100).sum()), seq_len=labels.shape[-1])
+        else:  # diffusion batches: count samples
+            first = next(iter(batch.values()))
+            self.meter.add(int(np.prod(first.shape[:2])), seq_len=1)
 
     def on_step_end(self, trainer, state):
         state.metrics.update(self.meter.step())
@@ -90,6 +95,12 @@ class CheckpointCallback(Callback):
             if hasattr(trainer.dataloader, "state_dict")
             else None,
             "meter": trainer.meter.state_dict() if trainer.meter else None,
+            # any stateful callback (e.g. ChannelLossCallback) rides along
+            "callbacks": {
+                type(cb).__name__: cb.state_dict()
+                for cb in trainer.callbacks
+                if hasattr(cb, "state_dict")
+            },
         }
 
     def on_train_begin(self, trainer, state):
@@ -103,6 +114,10 @@ class CheckpointCallback(Callback):
                 trainer.dataloader.load_state_dict(extra["dataloader"])
             if extra.get("meter") and trainer.meter:
                 trainer.meter.load_state_dict(extra["meter"])
+            for cb in trainer.callbacks:
+                cb_state = extra.get("callbacks", {}).get(type(cb).__name__)
+                if cb_state and hasattr(cb, "load_state_dict"):
+                    cb.load_state_dict(cb_state)
 
     def on_step_end(self, trainer, state):
         if self.save_steps and state.global_step % self.save_steps == 0:

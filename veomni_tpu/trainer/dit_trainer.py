@@ -1,0 +1,125 @@
+"""DiT (diffusion) trainer.
+
+Reference: ``veomni/trainer/dit_trainer.py:168-595`` — condition-model
+offline embedding cache + FlowMatch loss. Contract here: the dataset holds
+pre-computed latents + condition embeddings (the reference also trains from
+cached latents/embeddings); the collator samples noise and timesteps with a
+checkpointable numpy RNG so the jitted step is random-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from veomni_tpu.models.dit import DiTConfig, abstract_dit_params, dit_loss_fn, init_dit_params
+from veomni_tpu.schedulers import FlowMatchScheduler
+from veomni_tpu.trainer.base import BaseTrainer
+
+
+class DiTCollator:
+    """Rows {latents [G,G,C], cond [cond_dim]} -> batch + sampled noise/t."""
+
+    def __init__(self, cfg: DiTConfig, micro_batch_size: int,
+                 scheduler: FlowMatchScheduler, seed: int = 0):
+        self.cfg = cfg
+        self.micro_batch_size = micro_batch_size
+        self.scheduler = scheduler
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, samples) -> Dict[str, np.ndarray]:
+        b = self.micro_batch_size
+        g, c = self.cfg.latent_size, self.cfg.latent_channels
+        latents = np.zeros((b, g, g, c), np.float32)
+        cond = np.zeros((b, self.cfg.cond_dim), np.float32)
+        for i, s in enumerate(samples[:b]):
+            latents[i] = np.asarray(s["latents"], np.float32).reshape(g, g, c)
+            cond[i] = np.asarray(s["cond"], np.float32)
+        return {
+            "latents": latents,
+            "cond": cond,
+            "noise": self._rng.standard_normal((b, g, g, c)).astype(np.float32),
+            "t": self.scheduler.sample_timesteps(self._rng, b),
+        }
+
+    def state_dict(self):
+        return {"rng_state": self._rng.bit_generator.state}
+
+    def load_state_dict(self, state):
+        if "rng_state" in state:
+            self._rng.bit_generator.state = state["rng_state"]
+
+
+class DiTTrainer(BaseTrainer):
+    def _build_model(self):
+        overrides = dict(self.args.model.config_overrides)
+        overrides.pop("model_type", None)
+        overrides.setdefault("dtype", self.args.train.compute_dtype)
+        overrides["remat"] = self.args.train.enable_gradient_checkpointing
+        cfg = DiTConfig(**overrides)
+        from veomni_tpu.models.auto import FoundationModel, ModelFamily
+
+        family = ModelFamily(
+            model_type="dit",
+            config_cls=DiTConfig,
+            init_params=init_dit_params,
+            abstract_params=abstract_dit_params,
+            loss_fn=dit_loss_fn,
+            forward_logits=None,
+            hf_to_params=None,
+            save_hf_checkpoint=self._save_native,
+        )
+        self.model = FoundationModel(config=cfg, family=family)
+        self.tokenizer = None
+        self.scheduler = FlowMatchScheduler()
+
+    @staticmethod
+    def _save_native(params, cfg, out_dir):
+        import os
+
+        from safetensors.flax import save_file
+
+        from veomni_tpu.parallel.parallel_plan import param_path_str
+
+        os.makedirs(out_dir, exist_ok=True)
+        flat = {}
+        jax.tree_util.tree_map_with_path(
+            lambda p, x: flat.__setitem__(param_path_str(p), jax.device_get(x)), params
+        )
+        save_file(flat, f"{out_dir}/model.safetensors")
+
+    def _build_data_transform(self):
+        self.data_transform = None  # rows are already latents + cond
+
+    def _build_dataloader(self):
+        from veomni_tpu.data.data_loader import build_dataloader
+
+        t = self.args.train
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        nproc = jax.process_count()
+        local_mb = t.micro_batch_size * ps.dp_size // nproc
+        self.dataloader = build_dataloader(
+            self.args.data.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=DiTCollator(self.model.config, local_mb, self.scheduler, t.seed),
+            micro_batch_size=local_mb,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=local_mb,
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            infinite=True,
+        )
+
+    def _batch_sharding_map(self):
+        ps = self.parallel_state
+        return {
+            "latents": P(None, ps.dp_axes, None, None, None),
+            "noise": P(None, ps.dp_axes, None, None, None),
+            "cond": P(None, ps.dp_axes, None),
+            "t": P(None, ps.dp_axes),
+        }

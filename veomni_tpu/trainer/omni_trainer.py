@@ -1,0 +1,176 @@
+"""Omni trainer: any-modality (text+image+audio) SFT.
+
+Reference: ``tasks/omni/train_omni_model.py`` (linear script over the same
+library calls) + ``veomni/trainer`` omni paths with per-module parallel-state
+scoping (``use_parallel_state``). Here all modules share one mesh; per-module
+heterogeneous SP is a round-2 item (the scoping machinery already exists in
+parallel_state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from veomni_tpu.data.data_collator import IGNORE_INDEX
+from veomni_tpu.data.data_loader import build_dataloader
+from veomni_tpu.data.multimodal import images_to_patches_np, load_image
+from veomni_tpu.models.auto import FoundationModel, ModelFamily
+from veomni_tpu.models.omni import (
+    OmniConfig,
+    abstract_omni_params,
+    init_omni_params,
+    omni_loss_fn,
+)
+from veomni_tpu.trainer.base import BaseTrainer
+
+
+class OmniCollator:
+    """Rows: tokenized text with modality placeholders + image/audio slots."""
+
+    def __init__(self, cfg: OmniConfig, seq_len: int, micro_batch_size: int,
+                 sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError("seq_len % sp_size != 0")
+        self.cfg = cfg
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        cfg = self.cfg
+        b, s = self.micro_batch_size, self.seq_len
+        out: Dict[str, np.ndarray] = {
+            "input_ids": np.zeros((b, s), np.int32),
+            "labels": np.full((b, s), IGNORE_INDEX, np.int32),
+            "position_ids": np.zeros((b, s), np.int32),
+            "segment_ids": np.zeros((b, s), np.int32),
+        }
+        if cfg.vision is not None:
+            vp = cfg.vision.grid ** 2
+            pd = cfg.vision.num_channels * cfg.vision.patch_size ** 2
+            out["pixel_patches"] = np.zeros((b, cfg.max_images, vp, pd), np.float32)
+            out["image_mask"] = np.zeros((b, cfg.max_images), bool)
+        if cfg.audio is not None:
+            out["audio_features"] = np.zeros(
+                (b, cfg.max_audio, cfg.audio.max_frames, cfg.audio.n_mels), np.float32
+            )
+            out["audio_mask"] = np.zeros((b, cfg.max_audio), bool)
+
+        for i, sample in enumerate(samples[:b]):
+            ids: list = []
+            labels: list = []
+            images = sample.get("images", [])[: cfg.max_images]
+            audios = sample.get("audio", [])[: cfg.max_audio]
+            if cfg.vision is not None:
+                for k, im in enumerate(images):
+                    t_img = cfg.vision.tokens_per_image
+                    ids += [cfg.image_token_id] * t_img
+                    labels += [IGNORE_INDEX] * t_img
+                    arr = load_image(im, cfg.vision.image_size)
+                    out["pixel_patches"][i, k] = images_to_patches_np(
+                        arr[None], cfg.vision
+                    )[0]
+                    out["image_mask"][i, k] = True
+            if cfg.audio is not None:
+                for k, au in enumerate(audios):
+                    t_au = cfg.audio.tokens_per_audio
+                    ids += [cfg.audio_token_id] * t_au
+                    labels += [IGNORE_INDEX] * t_au
+                    feat = np.asarray(au, np.float32)
+                    frames = min(len(feat), cfg.audio.max_frames)
+                    out["audio_features"][i, k, :frames] = feat[:frames]
+                    out["audio_mask"][i, k] = True
+            text = list(sample["input_ids"])
+            ids += text
+            labels += list(sample.get("labels", text))
+            ids, labels = ids[:s], labels[:s]
+            shifted = np.concatenate(
+                [np.asarray(labels[1:], np.int32), [IGNORE_INDEX]]
+            ).astype(np.int32)
+            n = len(ids)
+            out["input_ids"][i, :n] = np.asarray(ids, np.int32)
+            out["labels"][i, :n] = shifted[:n]
+            out["position_ids"][i, :n] = np.arange(n)
+            out["segment_ids"][i, :n] = 1
+        return out
+
+
+class OmniTrainer(BaseTrainer):
+    def _build_model(self):
+        overrides = dict(self.args.model.config_overrides)
+        overrides.pop("model_type", None)
+        text = dict(overrides.pop("text", {}))
+        text.setdefault("dtype", self.args.train.compute_dtype)
+        text["remat"] = self.args.train.enable_gradient_checkpointing
+        cfg = OmniConfig(text=text, **overrides)
+        family = ModelFamily(
+            model_type="seed_omni",
+            config_cls=OmniConfig,
+            init_params=init_omni_params,
+            abstract_params=abstract_omni_params,
+            loss_fn=omni_loss_fn,
+            forward_logits=None,
+            hf_to_params=None,
+            save_hf_checkpoint=self._save_native,
+        )
+        self.model = FoundationModel(config=cfg, family=family)
+        self.tokenizer = None
+
+    @staticmethod
+    def _save_native(params, cfg, out_dir):
+        import os
+
+        from safetensors.flax import save_file
+
+        from veomni_tpu.models import hf_io
+        from veomni_tpu.parallel.parallel_plan import param_path_str
+
+        os.makedirs(out_dir, exist_ok=True)
+        flat = {}
+        jax.tree_util.tree_map_with_path(
+            lambda p, x: flat.__setitem__(param_path_str(p), jax.device_get(x)), params
+        )
+        save_file(flat, f"{out_dir}/model.safetensors")
+        hf_io.save_hf_checkpoint(
+            params["language_model"], cfg.text, f"{out_dir}/language_model"
+        )
+
+    def _build_data_transform(self):
+        self.data_transform = None  # rows are pretokenized + raw media
+
+    def _build_dataloader(self):
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        nproc = jax.process_count()
+        local_mb = t.micro_batch_size * ps.dp_size // nproc
+        self.dataloader = build_dataloader(
+            d.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=OmniCollator(
+                self.model.config, d.max_seq_len, local_mb, sp_size=ps.sp_size
+            ),
+            micro_batch_size=local_mb,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=local_mb,
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            infinite=True,
+        )
+
+    def _batch_sharding_map(self):
+        ps = self.parallel_state
+        cfg = self.model.config
+        base = {k: P(None, ps.dp_axes, ps.sp_axes) for k in (
+            "input_ids", "labels", "position_ids", "segment_ids")}
+        if cfg.vision is not None:
+            base["pixel_patches"] = P(None, ps.dp_axes, None, None, None)
+            base["image_mask"] = P(None, ps.dp_axes, None)
+        if cfg.audio is not None:
+            base["audio_features"] = P(None, ps.dp_axes, None, None, None)
+            base["audio_mask"] = P(None, ps.dp_axes, None)
+        return base

@@ -1,0 +1,168 @@
+"""Base RL (PPO-style) post-training trainer.
+
+Reference: ``veomni/trainer/base_rl_trainer.py:39`` — packs and SP-slices in
+the train loop, gathers per-sample logprobs post-forward; rollouts come from
+an external engine (verl integration), which is also the contract here:
+the dataset provides (sequence, response mask, advantage, old_logprob).
+
+Loss: clipped importance-sampling surrogate per response token
+  ratio = exp(logp - old_logp);  L = -mean(min(r*A, clip(r, 1±eps)*A)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.data.data_collator import IGNORE_INDEX
+from veomni_tpu.data.data_transform import DATA_TRANSFORM_REGISTRY
+from veomni_tpu.models import transformer
+from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy_per_token
+from veomni_tpu.trainer.base import BaseTrainer
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@DATA_TRANSFORM_REGISTRY.register("rl")
+def build_rl_transform(tokenizer=None, max_seq_len: int = 0, **_):
+    """Rows: {"prompt": ids, "response": ids, "advantage": float,
+    "old_logprobs": [len(response)] (optional; 0 = on-policy first step)}."""
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = list(row["prompt"])
+        resp = list(row["response"])
+        ids = (prompt + resp)[: max_seq_len or None]
+        labels = ([IGNORE_INDEX] * len(prompt) + resp)[: len(ids)]
+        # sentinel +1.0 (impossible logprob) marks "on-policy": the loss uses
+        # stop_gradient(logp) there so ratio == 1 exactly on the first step
+        old = row.get("old_logprobs")
+        old_lp = ([1.0] * len(prompt) + list(old or [1.0] * len(resp)))[: len(ids)]
+        return {
+            "input_ids": ids,
+            "labels": labels,
+            "old_logprobs": old_lp,
+            "advantage": float(row.get("advantage", 0.0)),
+        }
+
+    return transform
+
+
+class RLSampleCollator:
+    """One sample per row [B, S] + per-token old logprobs + per-row advantage."""
+
+    def __init__(self, seq_len: int, micro_batch_size: int, sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError("seq_len % sp_size != 0")
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+
+    def __call__(self, samples):
+        b, s = self.micro_batch_size, self.seq_len
+        out = {
+            "input_ids": np.zeros((b, s), np.int32),
+            "labels": np.full((b, s), IGNORE_INDEX, np.int32),
+            "position_ids": np.zeros((b, s), np.int32),
+            "segment_ids": np.zeros((b, s), np.int32),
+            "old_logprobs": np.zeros((b, s), np.float32),
+            "advantages": np.zeros((b,), np.float32),
+        }
+        for i, sample in enumerate(samples[:b]):
+            ids = np.asarray(sample["input_ids"], np.int32)[:s]
+            lab = np.asarray(sample["labels"], np.int32)[: len(ids)]
+            old = np.asarray(sample["old_logprobs"], np.float32)[: len(ids)]
+            shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
+            shifted_old = np.concatenate([old[1:], [0.0]]).astype(np.float32)
+            n = len(ids)
+            out["input_ids"][i, :n] = ids
+            out["labels"][i, :n] = shifted
+            out["old_logprobs"][i, :n] = shifted_old
+            out["position_ids"][i, :n] = np.arange(n)
+            out["segment_ids"][i, :n] = 1
+            out["advantages"][i] = sample["advantage"]
+        return out
+
+
+class BaseRLTrainer(BaseTrainer):
+    def _build_data_transform(self):
+        from veomni_tpu.data.data_transform import build_data_transform
+
+        self.data_transform = build_data_transform(
+            "rl", tokenizer=self.tokenizer, max_seq_len=self.args.data.max_seq_len
+        )
+
+    def _build_dataloader(self):
+        from veomni_tpu.data.data_loader import build_dataloader
+
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        nproc = jax.process_count()
+        local_mb = t.micro_batch_size * ps.dp_size // nproc
+        self.dataloader = build_dataloader(
+            d.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=RLSampleCollator(d.max_seq_len, local_mb, sp_size=ps.sp_size),
+            micro_batch_size=local_mb,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=local_mb,
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            infinite=True,
+        )
+
+    def _batch_sharding_map(self):
+        from jax.sharding import PartitionSpec as P
+
+        ps = self.parallel_state
+        base = {k: P(None, ps.dp_axes, ps.sp_axes) for k in (
+            "input_ids", "labels", "position_ids", "segment_ids", "old_logprobs")}
+        base["advantages"] = P(None, ps.dp_axes)
+        return base
+
+    def _build_parallelized_state(self):
+        if self.args.model.lora:
+            raise NotImplementedError("RL + LoRA not wired yet")
+        super()._build_parallelized_state()
+        model, cfg = self.model, self.model.config
+        eps = float(self.args.train.ppo_clip_ratio)
+
+        def rl_loss(params, batch):
+            hidden, _ = transformer.forward_hidden(
+                params, cfg, batch["input_ids"], batch["position_ids"],
+                batch.get("segment_ids"),
+            )
+            b, s, h = hidden.shape
+            kernel = transformer.lm_head_kernel(params, cfg).astype(cfg.dtype)
+            nll = fused_linear_cross_entropy_per_token(
+                hidden.reshape(b * s, h), kernel, batch["labels"].reshape(b * s)
+            ).reshape(b, s)
+            logp = -nll
+            valid = batch["labels"] != IGNORE_INDEX
+            old = batch["old_logprobs"]
+            # +1.0 sentinel = on-policy token: ratio pinned to 1 (see transform)
+            old = jnp.where(old > 0.5, jax.lax.stop_gradient(logp), old)
+            ratio = jnp.exp(jnp.where(valid, logp - old, 0.0))
+            adv = batch["advantages"][:, None]
+            surrogate = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - eps, 1 + eps) * adv
+            )
+            ntokens = valid.sum()
+            loss = -(jnp.where(valid, surrogate, 0.0)).sum()
+            return loss, {
+                "ntokens": ntokens,
+                "ratio_mean": jnp.where(valid, ratio, 0.0).sum() / jnp.maximum(ntokens, 1),
+            }
+
+        from veomni_tpu.train import build_train_step
+
+        self.train_step = build_train_step(
+            rl_loss, self.optimizer, self.parallel_state,
+            state_shardings=self.state_shardings,
+            batch_shardings=self.batch_shardings,
+            max_grad_norm=self.args.train.max_grad_norm,
+        )

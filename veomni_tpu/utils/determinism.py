@@ -1,0 +1,30 @@
+"""Determinism utilities.
+
+Reference: ``veomni/ops/batch_invariant_ops/`` (Triton batch-invariant
+matmul/norm swapped in per micro-step, ``trainer/base.py:737,750``) and
+``enable_full_determinism`` (``utils/helper.py:425-463``: cublas workspace,
+deterministic algorithms).
+
+On TPU these are no-op shims by design: XLA:TPU compiles fixed reduction
+orders for fixed shapes, so the same program on the same inputs is bitwise
+reproducible, and batch invariance holds whenever the compiled shape is the
+same (our static-shape pipeline guarantees that). The context manager is
+kept so reference-style call sites port cleanly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from veomni_tpu.utils.helper import set_seed
+
+
+@contextlib.contextmanager
+def set_batch_invariant_mode(enabled: bool = True):
+    """No-op on TPU (XLA static-shape programs are batch-invariant)."""
+    yield
+
+
+def enable_full_determinism(seed: int):
+    """Seed all RNG streams; XLA handles the rest (see module docstring)."""
+    return set_seed(seed)
