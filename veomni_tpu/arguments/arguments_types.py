@@ -18,7 +18,7 @@ class ModelArguments:
     tokenizer_path: str = ""         # defaults to config_path
     model_type: str = ""             # override/bypass config.json model_type
     attn_implementation: str = "auto"    # auto|xla|pallas_flash
-    moe_implementation: str = "auto"     # auto|xla_ragged|pallas
+    moe_implementation: str = "auto"     # auto|xla|xla_ragged|pallas|pallas_gmm
     ops_implementation: Dict[str, str] = field(default_factory=dict)  # op -> impl pin
     # tiny-model construction without config.json (tests/toy configs)
     config_overrides: Dict[str, Any] = field(default_factory=dict)

@@ -26,5 +26,10 @@ def _group_gemm_ragged(tokens, weights, group_sizes):
     ).astype(tokens.dtype)
 
 
+# alias so VEOMNI_FORCE_EAGER_OPS (which looks for an "xla" impl) and generic
+# "xla" pins reach the eager path
+KERNEL_REGISTRY.register("group_gemm", "xla")(_group_gemm_ragged)
+
+
 def group_gemm(tokens, weights, group_sizes):
     return resolve_op("group_gemm")(tokens, weights, group_sizes)

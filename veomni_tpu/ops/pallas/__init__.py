@@ -6,3 +6,4 @@ priority over the XLA-eager fallbacks on TPU.
 """
 
 from veomni_tpu.ops.pallas import flash_attention as _flash_attention  # noqa: F401
+from veomni_tpu.ops.pallas import grouped_gemm as _grouped_gemm  # noqa: F401
