@@ -1,0 +1,99 @@
+"""Offline conversion: orbax train-state checkpoint -> HF safetensors.
+
+Reference: ``scripts/merge_dcp_to_hf.py`` + ``dcp_to_torch_state_dict``
+(``checkpoint/dcp_checkpointer.py:859``) — consolidate a sharded training
+checkpoint into an inference-ready HF directory without running the trainer.
+
+Usage:
+  python scripts/merge_checkpoint_to_hf.py \
+      --ckpt_dir output/run/checkpoints [--step N] \
+      --config <dir with config.json or inline overrides JSON> \
+      --out_dir output/run/hf_merged [--platform cpu]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt_dir", required=True)
+    ap.add_argument("--step", type=int, default=None)
+    ap.add_argument("--config", required=True,
+                    help="HF config dir, or a JSON string of config overrides")
+    ap.add_argument("--out_dir", required=True)
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform to restore on (cpu avoids TPU claims)")
+    args = ap.parse_args()
+
+    import re
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.models.auto import build_config
+
+    # single config-resolution path (handles VLM/composite model_types too)
+    if os.path.isdir(args.config):
+        with open(os.path.join(args.config, "config.json")) as f:
+            hf = json.load(f)
+        from veomni_tpu.models.config import TransformerConfig
+
+        mt = hf.get("model_type", "")
+        from veomni_tpu.models.auto import VLM_MODEL_TYPES
+
+        if mt in VLM_MODEL_TYPES:
+            config = build_config(mt, text=hf.get("text_config", hf))
+        else:
+            config = TransformerConfig.from_hf_config(hf)
+    else:
+        overrides = json.loads(args.config)
+        mt = overrides.pop("model_type", "")
+        if not mt:
+            raise SystemExit(
+                "inline --config JSON must include model_type (silent "
+                "llama-family fallback would mis-map family-specific tensors)"
+            )
+        config = build_config(mt, **overrides)
+    model = build_foundation_model(config=config)
+
+    # read-only step discovery (no Checkpointer: avoid mkdir/threads)
+    if args.step is not None:
+        step = args.step
+    else:
+        steps = sorted(
+            int(m.group(1))
+            for d in (os.listdir(args.ckpt_dir) if os.path.isdir(args.ckpt_dir) else [])
+            if (m := re.match(r"^global_step_(\d+)$", d))
+        )
+        step = steps[-1] if steps else None
+    if step is None:
+        raise SystemExit(f"no checkpoints under {args.ckpt_dir}")
+
+    # Restore with an abstract target built from on-disk metadata (works
+    # without knowing the optimizer that produced the checkpoint). NOTE: this
+    # orbax version has no partial/placeholder restore, so optimizer moments
+    # (~2x params bytes) are materialized too — budget host RAM accordingly.
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(args.ckpt_dir), f"global_step_{step}", "train_state")
+    ckptr = ocp.StandardCheckpointer()
+    meta = ckptr.metadata(path).item_metadata
+    target = jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+        {"params": meta["params"], "opt_state": meta["opt_state"], "step": meta["step"]},
+    )
+    restored = ckptr.restore(path, target)
+    model.save_hf(args.out_dir, params=restored["params"])
+    print(f"merged step {step} -> {args.out_dir}")
+
+
+if __name__ == "__main__":
+    main()
