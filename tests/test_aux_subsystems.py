@@ -21,6 +21,14 @@ TOY = {
     "qk_norm": True,
 }
 
+TOY_MOE = {
+    **TOY,
+    "model_type": "qwen3_moe",
+    "num_experts": 4,
+    "num_experts_per_tok": 2,
+    "moe_intermediate_size": 32,
+}
+
 
 def test_channel_loss_e2e(tmp_path):
     from veomni_tpu.trainer import TextTrainer
@@ -97,3 +105,100 @@ def test_batch_invariant_shim():
 
     with set_batch_invariant_mode(True):
         pass
+
+
+def test_checkpointer_skips_uncommitted_step(tmp_path):
+    """A crash mid-async-save leaves only the orbax tmp payload; resume must
+    fall back to the last committed step (ADVICE r1: checkpointer.py:87)."""
+    import os
+
+    from veomni_tpu.checkpoint import build_checkpointer
+
+    ckptr = build_checkpointer(str(tmp_path), async_save=False)
+    state = {"w": jnp.arange(4, dtype=jnp.float32)}
+    ckptr.save(2, state, {"global_step": 2})
+    ckptr.wait()
+    # fake a crashed save of step 4: tmp dir + eager extra_state, no payload
+    crashed = tmp_path / "global_step_4"
+    os.makedirs(crashed / "train_state.orbax-checkpoint-tmp-123")
+    (crashed / "extra_state.json").write_text('{"global_step": 4}')
+    assert ckptr.list_steps() == [2]
+    assert ckptr.latest_step() == 2
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+    restored, extra = ckptr.load(abstract)
+    assert extra["global_step"] == 2
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4))
+
+    # re-saving step 4 after the crash must commit and become visible even
+    # though stale tmp debris existed (review r2: stale sibling must not
+    # permanently mask a later successful save)
+    ckptr.save(4, state, {"global_step": 4})
+    ckptr.wait()
+    assert ckptr.latest_step() == 4
+    restored, extra = ckptr.load(abstract)
+    assert extra["global_step"] == 4
+    ckptr.close()
+
+
+def test_hf_config_roundtrip_moe_keys():
+    """to_hf_config must emit the expert-count key + activation spelling HF
+    transformers expects for each MoE dialect (ADVICE r1: config.py:202)."""
+    from veomni_tpu.models.config import TransformerConfig
+
+    for mt, hf_key in [
+        ("qwen3_moe", "num_experts"),
+        ("deepseek_v3", "n_routed_experts"),
+        ("gpt_oss", "num_local_experts"),
+    ]:
+        cfg = TransformerConfig(
+            model_type=mt, num_experts=8, num_experts_per_tok=2,
+            moe_intermediate_size=32,
+            hidden_act="gpt_oss_glu" if mt == "gpt_oss" else "silu",
+        )
+        hf = cfg.to_hf_config()
+        assert hf.get(hf_key) == 8, (mt, hf)
+        assert hf["hidden_act"] in ("silu",), (mt, hf["hidden_act"])
+        back = TransformerConfig.from_hf_config(hf)
+        assert back.num_experts == 8, mt
+        if mt == "gpt_oss":
+            assert back.hidden_act == "gpt_oss_glu"
+
+
+def test_ep_capacity_drop_metric():
+    """Capacity-mode EP surfaces a nonzero dropped-assignment fraction while
+    dropless reports exactly zero (ADVICE r1: moe.py:65)."""
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+
+    from veomni_tpu.models import TransformerConfig
+
+    destroy_parallel_state()
+    try:
+        ps = init_parallel_state(ep_size=2)  # 4 devices: ep2 x fsdp2
+        batch = {
+            "input_ids": jnp.ones((4, 32), jnp.int32),
+            "labels": jnp.ones((4, 32), jnp.int32),
+            "position_ids": jnp.broadcast_to(jnp.arange(32), (4, 32)),
+            "segment_ids": jnp.ones((4, 32), jnp.int32),
+        }
+        # identical tokens route identically -> tight capacity guarantees drops
+        cfg = TransformerConfig(
+            **dict(TOY_MOE, dtype=jnp.float32, moe_capacity_factor=0.25)
+        )
+        model = build_foundation_model(config=cfg)
+        params = model.init(jax.random.PRNGKey(0))
+        with use_parallel_state(ps):
+            _, metrics = model.loss_fn(params, batch)
+        assert float(metrics["moe_dropped_frac"]) > 0.0
+
+        cfg2 = TransformerConfig(**dict(TOY_MOE, dtype=jnp.float32))
+        model2 = build_foundation_model(config=cfg2)
+        params2 = model2.init(jax.random.PRNGKey(0))
+        with use_parallel_state(ps):
+            _, metrics2 = model2.loss_fn(params2, batch)
+        assert float(metrics2["moe_dropped_frac"]) == 0.0
+    finally:
+        destroy_parallel_state()

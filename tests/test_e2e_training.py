@@ -14,12 +14,15 @@ import pytest
 from veomni_tpu.arguments import VeOmniArguments
 
 
-def _write_dummy_data(path, n=512, vocab=256, seed=0):
+def _write_dummy_data(path, n=512, vocab=256, seed=0, channels=None):
     rng = np.random.default_rng(seed)
     rows = []
     for _ in range(n):
         ln = int(rng.integers(16, 100))
-        rows.append({"input_ids": rng.integers(0, vocab, ln).tolist()})
+        row = {"input_ids": rng.integers(0, vocab, ln).tolist()}
+        if channels:
+            row["channel"] = channels[int(rng.integers(0, len(channels)))]
+        rows.append(row)
     with open(path, "w") as f:
         for r in rows:
             f.write(json.dumps(r) + "\n")
@@ -80,24 +83,100 @@ def test_e2e_training_fsdp_sp(tmp_path):
     trainer.checkpointer.close()
 
 
-def test_e2e_resume(tmp_path):
+def _host_tree(tree):
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _assert_trees_identical(a, b, what):
+    import jax
+
+    leaves_a, treedef_a = jax.tree.flatten(a)
+    leaves_b, treedef_b = jax.tree.flatten(b)
+    assert treedef_a == treedef_b, f"{what}: tree structure differs"
+    for i, (la, lb) in enumerate(zip(leaves_a, leaves_b)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+            f"{what}: leaf {i} ({treedef_a}) not bit-identical; "
+            f"max abs diff {np.abs(np.asarray(la, np.float64) - np.asarray(lb, np.float64)).max()}"
+        )
+
+
+def _run_resume_case(tmp_path, *, data_kwargs=None, data_overrides=None,
+                     **train_overrides):
+    """8 straight steps vs (4 steps, save, restart, 4 steps) must produce
+    bit-identical params, opt_state, and dataloader cursor (reference
+    CheckpointerCallback exact-resume contract, checkpoint_callback.py:60-115)."""
     from veomni_tpu.parallel.parallel_state import destroy_parallel_state
     from veomni_tpu.trainer import TextTrainer
 
-    _write_dummy_data(tmp_path / "data.jsonl")
-    args = _make_args(tmp_path, save_steps=4, train_steps=4)
-    trainer = TextTrainer(args)
-    trainer.train()
-    step4_loss_params = trainer.train_state.params
-    import jax
+    _write_dummy_data(tmp_path / "data.jsonl", **(data_kwargs or {}))
 
-    p4 = jax.tree.map(lambda x: np.asarray(x), step4_loss_params)
-    trainer.checkpointer.close()
+    def make(out_name, **over):
+        args = _make_args(tmp_path, **{**train_overrides, **over})
+        args.train.output_dir = str(tmp_path / out_name)
+        for k, v in (data_overrides or {}).items():
+            setattr(args.data, k, v)
+        return args
+
+    # ---- run A: 8 straight steps, one trainer
+    trainer_a = TextTrainer(make("a", train_steps=8, save_steps=0))
+    ctl_a = trainer_a.train()
+    assert ctl_a.global_step == 8
+    ref_state = _host_tree(
+        {"params": trainer_a.train_state.params,
+         "opt_state": trainer_a.train_state.opt_state}
+    )
+    ref_loader = (
+        trainer_a.dataloader.state_dict()
+        if hasattr(trainer_a.dataloader, "state_dict") else None
+    )
+    trainer_a.checkpointer.close()
     destroy_parallel_state()
 
-    # new trainer, resume from step 4, run to 8
-    args2 = _make_args(tmp_path, save_steps=4, train_steps=8)
-    trainer2 = TextTrainer(args2)
-    ctl = trainer2.train()
-    assert ctl.global_step == 8
-    trainer2.checkpointer.close()
+    # ---- run B: 4 steps, save, fresh process-equivalent restart, 4 more.
+    # train_steps stays 8 (the lr-schedule horizon must match run A); a
+    # callback stops the first leg after step 4, like a preempted job.
+    from veomni_tpu.trainer.callbacks import Callback
+
+    class StopAt(Callback):
+        def __init__(self, at):
+            self.at = at
+
+        def on_step_end(self, trainer, state):
+            if state.global_step >= self.at:
+                state.should_stop = True
+
+    trainer_b1 = TextTrainer(make("b", train_steps=8, save_steps=4))
+    trainer_b1.callbacks.append(StopAt(4))
+    trainer_b1.train()
+    trainer_b1.checkpointer.close()
+    destroy_parallel_state()
+
+    trainer_b2 = TextTrainer(make("b", train_steps=8, save_steps=4))
+    ctl_b = trainer_b2.train()
+    assert ctl_b.global_step == 8
+
+    got_state = _host_tree(
+        {"params": trainer_b2.train_state.params,
+         "opt_state": trainer_b2.train_state.opt_state}
+    )
+    _assert_trees_identical(ref_state, got_state, "resumed train_state")
+    if ref_loader is not None and hasattr(trainer_b2.dataloader, "state_dict"):
+        assert ref_loader == trainer_b2.dataloader.state_dict(), (
+            "dataloader cursor state diverged after resume"
+        )
+    trainer_b2.checkpointer.close()
+    destroy_parallel_state()
+
+
+def test_e2e_resume_exact(tmp_path):
+    _run_resume_case(tmp_path)
+
+
+def test_e2e_resume_exact_dynbsz_channels(tmp_path):
+    _run_resume_case(
+        tmp_path,
+        data_kwargs={"channels": ["code", "web"]},
+        data_overrides={"dyn_bsz": True, "channel_list": ["code", "web"]},
+    )

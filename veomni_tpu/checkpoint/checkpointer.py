@@ -38,6 +38,27 @@ class Checkpointer:
         self.async_save = async_save
         self.max_to_keep = max_to_keep
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        # startup is the only moment no save can be in flight anywhere, so
+        # clear crashed-save debris here (never during save(): a lagging host
+        # could rmtree a faster host's live tmp dir)
+        if jax.process_index() == 0:
+            self._clean_debris()
+
+    def _clean_debris(self):
+        import shutil
+
+        for d in os.listdir(self.ckpt_dir):
+            if not _STEP_RE.match(d):
+                continue
+            step_dir = os.path.join(self.ckpt_dir, d)
+            if not os.path.isdir(os.path.join(step_dir, "train_state")):
+                # crash before commit: only tmp payload/extra_state remain
+                logger.warning_rank0("removing uncommitted checkpoint debris %s", d)
+                shutil.rmtree(step_dir, ignore_errors=True)
+            else:
+                for sub in os.listdir(step_dir):
+                    if ".orbax-checkpoint-tmp" in sub:
+                        shutil.rmtree(os.path.join(step_dir, sub), ignore_errors=True)
 
     # ------------------------------------------------------------------ save
     def save(self, step: int, train_state, extra_state: Optional[Dict[str, Any]] = None):
@@ -75,12 +96,25 @@ class Checkpointer:
             shutil.rmtree(os.path.join(self.ckpt_dir, f"global_step_{s}"), ignore_errors=True)
 
     # ------------------------------------------------------------------ load
+    def _is_committed(self, step: int) -> bool:
+        """True iff the step's train_state payload finished committing.
+
+        A crash during an async Orbax save leaves the step dir with only the
+        uncommitted ``*.orbax-checkpoint-tmp-*`` payload (and possibly an
+        eagerly-written extra_state.json). Orbax renames the tmp dir to its
+        final name atomically on commit, so the final ``train_state`` dir
+        existing IS the commit marker — a stale tmp *sibling* from an earlier
+        crashed save must not invalidate a later successful one.
+        """
+        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
+        return os.path.isdir(os.path.join(step_dir, "train_state"))
+
     def list_steps(self):
         out = []
         if os.path.isdir(self.ckpt_dir):
             for d in os.listdir(self.ckpt_dir):
                 m = _STEP_RE.match(d)
-                if m and os.path.isdir(os.path.join(self.ckpt_dir, d)):
+                if m and self._is_committed(int(m.group(1))):
                     out.append(int(m.group(1)))
         return sorted(out)
 
@@ -92,9 +126,21 @@ class Checkpointer:
         """Restore into the sharding/dtype structure of ``abstract_state``
         (a pytree of sharded jax.ShapeDtypeStructs). Returns (state, extra)."""
         if step is None:
-            step = self.latest_step()
-            if step is None:
-                return None, None
+            # walk back through committed steps so a corrupt latest checkpoint
+            # still resumes; if EVERY step fails the failure is systemic (e.g.
+            # abstract_state no longer matches the run) and must surface
+            last_err = None
+            for cand in reversed(self.list_steps()):
+                try:
+                    return self.load(abstract_state, step=cand)
+                except Exception as e:
+                    last_err = e
+                    logger.warning_rank0(
+                        "restore of step %d failed: %s; trying previous step", cand, e
+                    )
+            if last_err is not None:
+                raise last_err
+            return None, None
         self.wait()
         path = os.path.join(self.ckpt_dir, f"global_step_{step}", "train_state")
         restored = self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract_state))

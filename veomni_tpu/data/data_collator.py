@@ -27,6 +27,28 @@ import numpy as np
 IGNORE_INDEX = -100
 
 
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _json_safe(x) for k, x in v.items()}
+    return v
+
+
+def serialize_sample(sample: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe copy of a buffered sample preserving *every* key (token
+    lists, channel tags, any future fields) so resume doesn't lose state."""
+    return {k: _json_safe(v) for k, v in sample.items()}
+
+
 @dataclass
 class DataCollateInfo:
     """Per-key collation metadata (reference DataCollateInfo: pack_dim,
@@ -79,12 +101,7 @@ class TextPackingCollator:
 
     def state_dict(self) -> Dict[str, Any]:
         return {
-            "pending": [
-                {"input_ids": list(map(int, s["input_ids"])),
-                 "labels": list(map(int, s.get("labels", s["input_ids"]))),
-                 **({"channel": int(s["channel"])} if "channel" in s else {})}
-                for s in self._pending
-            ],
+            "pending": [serialize_sample(s) for s in self._pending],
             "dropped_oversized": self.dropped_oversized,
         }
 

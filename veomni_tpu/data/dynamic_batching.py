@@ -65,13 +65,12 @@ class DynBszBuffer:
         return batch
 
     def state_dict(self) -> Dict[str, Any]:
-        return {
-            "buffer": [
-                {"input_ids": list(map(int, s["input_ids"])),
-                 "labels": list(map(int, s.get("labels", s["input_ids"])))}
-                for s in self._buf
-            ]
-        }
+        from veomni_tpu.data.data_collator import serialize_sample
+
+        # persist every sample key (channel etc.), mirroring
+        # TextPackingCollator.state_dict — dropping fields here misattributes
+        # channel loss for buffered samples after resume
+        return {"buffer": [serialize_sample(s) for s in self._buf]}
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self._buf = list(state.get("buffer", []))

@@ -199,6 +199,18 @@ class TransformerConfig:
         with open(os.path.join(path, "config.json")) as f:
             return cls.from_hf_config(json.load(f), **overrides)
 
+    # inverse of the expert-count probing in from_hf_config: the key HF
+    # transformers expects for each MoE dialect (extra keys are tolerated by
+    # HF, but the canonical one must be present for the count to round-trip)
+    _HF_EXPERT_KEY = {
+        "deepseek_v2": "n_routed_experts",
+        "deepseek_v3": "n_routed_experts",
+        "gpt_oss": "num_local_experts",
+        "mixtral": "num_local_experts",
+    }
+    # internal dialect activation names -> HF spellings
+    _HF_ACT_SPELLING = {"gpt_oss_glu": "silu"}
+
     def to_hf_config(self) -> Dict[str, Any]:
         hf = {"model_type": self.model_type, "head_dim": self.head_dim,
               "attention_bias": self.attention_bias}
@@ -206,6 +218,14 @@ class TransformerConfig:
             hf["rope_scaling"] = self.rope_scaling
         for name in self._HF_FIELDS:
             hf[name] = getattr(self, name)
+        hf["hidden_act"] = self._HF_ACT_SPELLING.get(self.hidden_act, self.hidden_act)
         if self.is_moe:
-            hf["num_experts"] = self.num_experts
+            hf[self._HF_EXPERT_KEY.get(self.model_type, "num_experts")] = self.num_experts
+        if self.model_type in ("gemma3", "gemma3_text"):
+            hf["hidden_activation"] = hf.pop("hidden_act")
+            hf["query_pre_attn_scalar"] = self.query_pre_attn_scalar
+            if self.final_logit_softcap:
+                hf["final_logit_softcapping"] = self.final_logit_softcap
+        if self.model_type in ("deepseek_v2", "deepseek_v3"):
+            hf["aux_loss_alpha"] = hf.pop("router_aux_loss_coef")
         return hf

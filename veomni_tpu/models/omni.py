@@ -171,8 +171,10 @@ def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
             embeds, input_ids, feats, batch["audio_mask"], cfg.audio_token_id
         )
 
-    hidden, moe_aux = transformer.forward_hidden(
+    hidden, moe_aux, moe_dropped = transformer.forward_hidden(
         lm_params, tcfg, input_ids, batch["position_ids"],
         batch.get("segment_ids"), inputs_embeds=embeds,
     )
-    return transformer.head_loss(lm_params, tcfg, hidden, batch["labels"], moe_aux)
+    return transformer.head_loss(
+        lm_params, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+    )

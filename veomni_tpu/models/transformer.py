@@ -424,6 +424,7 @@ def _decoder_layer(
         else lp["post_attention_layernorm"]
     )
     x = _norm(hidden, pre_norm, cfg)
+    dropped = jnp.float32(0.0)
     if is_moe:
         from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
 
@@ -431,7 +432,7 @@ def _decoder_layer(
         if ps is not None and ps.ep_enabled:
             from veomni_tpu.parallel.moe import ep_moe_mlp
 
-            out, aux = ep_moe_mlp(x, lp, cfg, ps)
+            out, aux, dropped = ep_moe_mlp(x, lp, cfg, ps)
         else:
             out, aux = _moe_mlp(x.reshape(b * s, h), lp, cfg)
             out = out.reshape(b, s, h)
@@ -447,7 +448,7 @@ def _decoder_layer(
         aux = jnp.float32(0.0)
     if cfg.sandwich_norms:
         out = _norm(out, lp["post_feedforward_layernorm"], cfg)
-    return constrain(hidden + out), aux
+    return constrain(hidden + out), (aux, dropped)
 
 
 def forward_hidden(
@@ -457,8 +458,9 @@ def forward_hidden(
     position_ids: jax.Array,       # [B,S] int32
     segment_ids: Optional[jax.Array] = None,  # [B,S] int32
     inputs_embeds: Optional[jax.Array] = None,  # [B,S,H] overrides embedding
-) -> Tuple[jax.Array, jax.Array]:
-    """Returns (final_hidden [B,S,H] in cfg.dtype, moe_aux_loss scalar).
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (final_hidden [B,S,H] in cfg.dtype, moe_aux_loss scalar,
+    moe_dropped_frac scalar — mean EP capacity-drop fraction, 0 when dropless).
 
     ``inputs_embeds`` lets composite models (VLM/omni) inject merged
     multimodal embeddings while sharing the decoder stack."""
@@ -500,6 +502,7 @@ def forward_hidden(
                 runs.append([i, 1, *sig])
 
         aux_total = jnp.float32(0.0)
+        drop_total = jnp.float32(0.0)
         for start, n, window, local in runs:
             sub = (
                 layer_tree if n == count
@@ -513,20 +516,26 @@ def forward_hidden(
             )
             if cfg.remat:
                 body = jax.checkpoint(body, policy=_remat_policy(cfg))
-            hidden, auxes = jax.lax.scan(lambda c, lp: body(c, lp), hidden, sub)
+            hidden, (auxes, drops) = jax.lax.scan(lambda c, lp: body(c, lp), hidden, sub)
             aux_total = aux_total + auxes.sum()
-        return hidden, aux_total
+            drop_total = drop_total + drops.sum()
+        return hidden, aux_total, drop_total
 
     auxes_total = jnp.float32(0.0)
+    drops_total = jnp.float32(0.0)
     if k_dense:
-        hidden, aux0 = run_segment(hidden, compute["dense_layers"], 0, k_dense, False)
+        hidden, aux0, drop0 = run_segment(hidden, compute["dense_layers"], 0, k_dense, False)
         auxes_total = auxes_total + aux0
-    hidden, auxes = run_segment(
+        drops_total = drops_total + drop0
+    hidden, auxes, drops = run_segment(
         hidden, compute["layers"], k_dense, L - k_dense, cfg.is_moe
     )
     auxes_total = auxes_total + auxes
+    drops_total = drops_total + drops
     hidden = _norm(hidden, compute["norm"], cfg)
-    return hidden, auxes_total
+    # mean dropped-assignment fraction over the MoE layers (diagnostic)
+    n_moe = (L - k_dense) if cfg.is_moe else 0
+    return hidden, auxes_total, drops_total / max(n_moe, 1)
 
 
 def lm_head_kernel(params: Params, cfg: TransformerConfig):
@@ -536,7 +545,7 @@ def lm_head_kernel(params: Params, cfg: TransformerConfig):
 
 
 def forward_logits(params, cfg, input_ids, position_ids, segment_ids=None):
-    hidden, _ = forward_hidden(params, cfg, input_ids, position_ids, segment_ids)
+    hidden, _, _ = forward_hidden(params, cfg, input_ids, position_ids, segment_ids)
     kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
     logits = jnp.dot(hidden, kernel, preferred_element_type=jnp.float32)
     if cfg.final_logit_softcap:
@@ -551,7 +560,7 @@ def sequence_logprob_sums(
 ) -> jax.Array:
     """Per-row sum of label log-probs [B] (the per-sample logit gather of the
     reference RL/DPO trainers, ``base_rl_trainer.py:15-113``)."""
-    hidden, _ = forward_hidden(
+    hidden, _, _ = forward_hidden(
         params, cfg, batch["input_ids"], batch["position_ids"], batch.get("segment_ids")
     )
     kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
@@ -566,7 +575,7 @@ def sequence_logprob_sums(
 
 def head_loss(
     params: Params, cfg: TransformerConfig, hidden: jax.Array, labels: jax.Array,
-    moe_aux: jax.Array,
+    moe_aux: jax.Array, moe_dropped: jax.Array = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """lm-head + CE in token-sum space, shared by text/VLM/omni loss fns."""
     b, s, h = hidden.shape
@@ -576,6 +585,8 @@ def head_loss(
         logit_softcap=cfg.final_logit_softcap or None,
     )
     metrics = {"loss_sum": loss_sum, "ntokens": ntokens, "moe_aux_loss": moe_aux}
+    if moe_dropped is not None:
+        metrics["moe_dropped_frac"] = moe_dropped
     total = loss_sum
     if cfg.is_moe and cfg.router_aux_loss_coef:
         # aux loss is per-token-mean-like already; scale by token count to stay
@@ -594,7 +605,7 @@ def loss_fn(
     batch: input_ids/position_ids/segment_ids [B,S], labels [B,S] pre-shifted
     with -100 padding (collator contract, reference data_collator.py:371-428).
     """
-    hidden, moe_aux = forward_hidden(
+    hidden, moe_aux, moe_dropped = forward_hidden(
         params, cfg, batch["input_ids"], batch["position_ids"], batch.get("segment_ids")
     )
-    return head_loss(params, cfg, hidden, batch["labels"], moe_aux)
+    return head_loss(params, cfg, hidden, batch["labels"], moe_aux, moe_dropped)

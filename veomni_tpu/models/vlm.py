@@ -118,10 +118,10 @@ def vlm_loss_fn(
         embeds, input_ids, feats, batch["image_mask"], cfg.image_token_id
     )
 
-    hidden, moe_aux = transformer.forward_hidden(
+    hidden, moe_aux, moe_dropped = transformer.forward_hidden(
         params["language_model"], tcfg, input_ids, batch["position_ids"],
         batch.get("segment_ids"), inputs_embeds=embeds,
     )
     return transformer.head_loss(
-        params["language_model"], tcfg, hidden, batch["labels"], moe_aux
+        params["language_model"], tcfg, hidden, batch["labels"], moe_aux, moe_dropped
     )

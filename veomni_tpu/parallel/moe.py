@@ -58,6 +58,8 @@ def _dispatch_combine(x2d, topk_idx, topk_probs, experts_local, *, cfg,
     slot = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1     # [T*K]
     keep = slot < capacity
 
+    dropped_frac = 1.0 - keep.astype(jnp.float32).mean()
+
     send_x = jnp.zeros((ep, capacity, h), dtype)
     send_le = jnp.full((ep, capacity), -1, jnp.int32)
     # dropped assignments get an out-of-bounds destination -> mode="drop"
@@ -97,12 +99,15 @@ def _dispatch_combine(x2d, topk_idx, topk_probs, experts_local, *, cfg,
     flat_back = back[jnp.where(keep, dest_s, 0), jnp.where(keep, slot, 0)]
     contrib = jnp.where(keep[:, None], flat_back * w_s[:, None], 0.0)
     combined = jnp.zeros((t, h), dtype).at[tok_s].add(contrib)
-    return combined
+    return combined, dropped_frac
 
 
 def ep_moe_mlp(x, lp, cfg, pstate: ParallelState):
     """Expert-parallel MoE layer forward. x [B, S, H] globally sharded
-    (dp, sp, -); returns ([B, S, H], aux_loss)."""
+    (dp, sp, -); returns ([B, S, H], aux_loss, dropped_frac) where
+    dropped_frac is the mesh-mean fraction of (token, expert) assignments
+    discarded by the capacity bound (0 in dropless mode) — the observability
+    counterpart of the reference's dropless variable-split a2a."""
     b, s, h = x.shape
     e, k = cfg.num_experts, cfg.num_experts_per_tok
     ep = pstate.ep_size
@@ -137,23 +142,24 @@ def ep_moe_mlp(x, lp, cfg, pstate: ParallelState):
 
     def body(x3, ti, tp, experts_local):
         bl, sl, _ = x3.shape
-        out = _dispatch_combine(
+        out, dropped = _dispatch_combine(
             x3.reshape(bl * sl, h), ti.reshape(bl * sl, k), tp.reshape(bl * sl, k),
             experts_local, cfg=cfg, ep=ep, e_loc=e_loc, capacity=capacity,
             dtype=x3.dtype,
         )
-        return out.reshape(bl, sl, h)
+        dropped = jax.lax.pmean(dropped, axis_name=pstate.mesh.axis_names)
+        return out.reshape(bl, sl, h), dropped
 
     fn = shard_map(
         body,
         mesh=pstate.mesh,
         in_specs=(x_spec, topk_spec, topk_spec, experts_specs),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()),
         check_vma=False,
     )
-    out = fn(x, topk_idx, topk_probs, experts)
+    out, dropped = fn(x, topk_idx, topk_probs, experts)
     if cfg.n_shared_experts:
         from veomni_tpu.models.transformer import _shared_experts_out
 
         out = out + _shared_experts_out(x, lp, cfg)
-    return out, aux
+    return out, aux, dropped

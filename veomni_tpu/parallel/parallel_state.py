@@ -198,14 +198,29 @@ def init_parallel_state(
     """Build the Mesh and register a ParallelState under ``name``.
 
     ``dp_shard_size=-1`` infers the FSDP shard extent from the device count
-    (reference behavior). ``ep_size`` must divide the inferred dp_shard.
+    (reference behavior); ``dp_replicate_size=-1`` infers the replicate extent
+    instead (the DDP mapping: all non-shard/sp/tp devices replicate).
+    ``ep_size`` must divide the (inferred) dp_shard.
     """
     if cp_size != 1:
         raise NotImplementedError(
             "Ring attention (cp) is not supported yet."  # parity: parallel_state.py:81-82
         )
+    for label, size in (("dp_replicate_size", dp_replicate_size),
+                        ("dp_shard_size", dp_shard_size)):
+        if size < 1 and size != -1:
+            raise ValueError(f"{label} must be >= 1 or -1 (infer), got {size}")
     devs = list(devices) if devices is not None else jax.devices()
     world = len(devs)
+    if dp_replicate_size == -1:
+        if dp_shard_size == -1:
+            raise ValueError(
+                "at most one of dp_replicate_size/dp_shard_size may be -1"
+            )
+        known = pp_size * dp_shard_size * ulysses_size * cp_size * tp_size
+        if world % known:
+            raise ValueError(f"world size {world} not divisible by {known}")
+        dp_replicate_size = world // known
     known = pp_size * dp_replicate_size * ulysses_size * cp_size * tp_size
     if dp_shard_size == -1:
         if world % known:

@@ -28,7 +28,7 @@ def make_channel_loss_fn(model, num_channels: int) -> Callable:
     cfg = model.config
 
     def loss_fn(params, batch):
-        hidden, moe_aux = transformer.forward_hidden(
+        hidden, moe_aux, _ = transformer.forward_hidden(
             params, cfg, batch["input_ids"], batch["position_ids"],
             batch.get("segment_ids"),
         )

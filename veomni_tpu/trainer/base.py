@@ -92,11 +92,17 @@ class BaseTrainer:
             pass  # jax.distributed.initialize is the launcher's job (multihost)
         self.rng = set_seed(t.seed)
         dp_replicate = t.data_parallel_replicate_size
+        dp_shard = t.data_parallel_shard_size
         if t.data_parallel_mode == "ddp":
-            dp_replicate = -1  # all non-sp/tp devices replicate
+            # all non-sp/tp devices replicate; nothing is FSDP-sharded
+            dp_replicate, dp_shard = -1, 1
+        elif dp_replicate < 1:
+            # fsdp mode: the shard extent is what's inferred; replicate
+            # (HSDP) must be explicit, so -1/0 normalizes to "no replication"
+            dp_replicate = 1
         self.parallel_state = init_parallel_state(
-            dp_replicate_size=max(dp_replicate, 1),
-            dp_shard_size=t.data_parallel_shard_size,
+            dp_replicate_size=dp_replicate,
+            dp_shard_size=dp_shard,
             ep_size=t.expert_parallel_size,
             ulysses_size=t.ulysses_parallel_size,
             cp_size=t.context_parallel_size,
@@ -387,7 +393,9 @@ class BaseTrainer:
                     k: (float(v) if np.ndim(v) == 0 else np.asarray(v))
                     for k, v in metrics.items()
                 }
-                ctl.metrics["lr"] = float(self.lr_schedule(ctl.global_step))
+                # optax evaluated the schedule at count == step-1 for the
+                # update just applied; log that value, not the next step's
+                ctl.metrics["lr"] = float(self.lr_schedule(ctl.global_step - 1))
                 self._fire("on_step_end", ctl)
             self._fire("on_train_end", ctl)
         return ctl

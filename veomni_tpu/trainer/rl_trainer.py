@@ -132,7 +132,7 @@ class BaseRLTrainer(BaseTrainer):
         eps = float(self.args.train.ppo_clip_ratio)
 
         def rl_loss(params, batch):
-            hidden, _ = transformer.forward_hidden(
+            hidden, _, _ = transformer.forward_hidden(
                 params, cfg, batch["input_ids"], batch["position_ids"],
                 batch.get("segment_ids"),
             )
