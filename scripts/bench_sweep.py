@@ -1,0 +1,155 @@
+"""MFU ladder sweep on the live chip: one process, many configs.
+
+Runs the bench train step (qwen3-0.6B-class dense) across micro-batch /
+seq-len / remat / attention-impl combinations and prints one JSON line per
+config. Used to pick bench.py defaults; results recorded in BENCH_NOTES.md.
+
+Single process on purpose: the axon TPU chip claim is exclusive, and a
+killed TPU process can wedge it (memory notes) — never run this under
+`timeout`, never run two at once.
+"""
+
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_one(*, seq_len, micro_bs, steps, remat, remat_policy="nothing",
+            attn="xla", model_overrides=None):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from veomni_tpu.models import TransformerConfig, build_foundation_model
+    from veomni_tpu.optim import build_lr_scheduler, build_optimizer
+    from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY
+    from veomni_tpu.parallel import use_parallel_state
+    from veomni_tpu.parallel.parallel_state import get_parallel_state
+    from veomni_tpu.train import build_train_state, build_train_step
+    from veomni_tpu.train.train_step import resolve_state_shardings
+    from veomni_tpu.utils.count_flops import FlopsCounter
+    from veomni_tpu.utils.device import get_device_peak_flops
+
+    ps = get_parallel_state()
+    n_chips = jax.device_count()
+    KERNEL_REGISTRY.pin("attention", attn)
+
+    with use_parallel_state(ps):
+        cfg = TransformerConfig(**{
+            **dict(
+                model_type="qwen3",
+                vocab_size=151936,
+                hidden_size=1024,
+                intermediate_size=3072,
+                num_hidden_layers=28,
+                num_attention_heads=16,
+                num_key_value_heads=8,
+                head_dim=128,
+                qk_norm=True,
+                tie_word_embeddings=True,
+                max_position_embeddings=131072,
+                rope_theta=1e6,
+                dtype=jnp.bfloat16,
+                remat=remat,
+                remat_policy=remat_policy,
+            ),
+            **(model_overrides or {}),
+        })
+        model = build_foundation_model(config=cfg)
+        plan = model.get_parallel_plan()
+        opt = build_optimizer(model.abstract(), lr=build_lr_scheduler(lr=1e-4, train_steps=1000))
+
+        def make_state(rng):
+            return build_train_state(model.family.init_params(rng, cfg), opt)
+
+        abs_state = jax.eval_shape(make_state, jax.random.PRNGKey(0))
+        shardings = resolve_state_shardings(abs_state, plan, ps)
+        state = jax.jit(make_state, out_shardings=shardings)(jax.random.PRNGKey(0))
+
+        keys = ("input_ids", "labels", "position_ids", "segment_ids")
+        batch_shardings = {
+            k: NamedSharding(ps.mesh, P(None, ps.dp_axes, ps.sp_axes)) for k in keys
+        }
+        step = build_train_step(
+            model.loss_fn, opt, ps,
+            state_shardings=shardings, batch_shardings=batch_shardings,
+        )
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (1, micro_bs, seq_len))
+        batch = {
+            "input_ids": jnp.asarray(ids, jnp.int32),
+            "labels": jnp.asarray(ids, jnp.int32),
+            "position_ids": jnp.asarray(
+                np.broadcast_to(np.arange(seq_len), ids.shape).copy(), jnp.int32
+            ),
+            "segment_ids": jnp.ones(ids.shape, jnp.int32),
+        }
+        batch = {k: jax.device_put(v, batch_shardings[k]) for k, v in batch.items()}
+
+        state, metrics = step(state, batch)
+        _ = float(metrics["loss"])  # axon: host fetch is the only true sync
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        _ = float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+        tokens = micro_bs * seq_len * steps
+        tok_s = tokens / dt / n_chips
+        flops = FlopsCounter.from_config(cfg).batch_flops(
+            micro_bs * seq_len, seq_len
+        ) * steps
+        mfu = 100.0 * flops / dt / (get_device_peak_flops() * n_chips)
+        del state, step, batch
+        gc.collect()
+        return {"seq": seq_len, "mb": micro_bs, "remat": remat,
+                "policy": remat_policy, "attn": attn,
+                "tok_s_chip": round(tok_s, 1), "mfu": round(mfu, 2)}
+
+
+def main():
+    platform = os.environ.get("SWEEP_PLATFORM", "")
+    if platform:  # CPU smoke testing (axon overrides env vars; use config)
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    import jax
+
+    from veomni_tpu.parallel import init_parallel_state
+
+    init_parallel_state()
+    print(json.dumps({"devices": [str(d) for d in jax.devices()]}), flush=True)
+
+    configs = json.loads(os.environ.get("SWEEP_CONFIGS", "[]")) or [
+        # seq 2048 ladder: micro-batch x remat x attention impl
+        dict(seq_len=2048, micro_bs=2, steps=5, remat=True),
+        dict(seq_len=2048, micro_bs=4, steps=5, remat=True),
+        dict(seq_len=2048, micro_bs=8, steps=5, remat=True),
+        dict(seq_len=2048, micro_bs=4, steps=5, remat=False),
+        dict(seq_len=2048, micro_bs=8, steps=5, remat=False),
+        dict(seq_len=2048, micro_bs=8, steps=5, remat=True, remat_policy="dots"),
+        # seq 4096+: chunked attention
+        dict(seq_len=4096, micro_bs=4, steps=5, remat=True, attn="xla_chunked"),
+        dict(seq_len=4096, micro_bs=4, steps=5, remat=False, attn="xla_chunked"),
+        dict(seq_len=8192, micro_bs=2, steps=4, remat=True, attn="xla_chunked"),
+        dict(seq_len=16384, micro_bs=1, steps=4, remat=True, attn="xla_chunked"),
+        dict(seq_len=32768, micro_bs=1, steps=3, remat=True, attn="xla_chunked"),
+    ]
+    for c in configs:
+        try:
+            res = run_one(**c)
+            print(json.dumps(res), flush=True)
+        except Exception as e:
+            print(json.dumps({"config": c, "error": str(e)[:400]}), flush=True)
+            gc.collect()
+
+
+if __name__ == "__main__":
+    main()

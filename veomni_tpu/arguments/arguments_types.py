@@ -50,6 +50,9 @@ class DataArguments:
     # "channel" field (name or index). Empty = disabled.
     channel_list: List[str] = field(default_factory=list)
     samples_per_micro_batch: int = 8  # packing fill pool per micro-batch
+    # static packed vision-patch budget per micro-batch (qwen2_5_vl pipeline);
+    # also the per-sample cap in the transform
+    max_patches: int = 4096
 
 
 @dataclass

@@ -27,12 +27,14 @@ def load_image(source, image_size: int) -> np.ndarray:
     if isinstance(source, str):
         from PIL import Image
 
-        img = Image.open(source).convert("RGB").resize((image_size, image_size))
+        img = Image.open(source).convert("RGB")
+        if image_size:
+            img = img.resize((image_size, image_size))
         return np.asarray(img, np.float32) / 255.0
     arr = np.asarray(source, np.float32)
     if arr.max() > 1.5:
         arr = arr / 255.0
-    if arr.shape[:2] != (image_size, image_size):
+    if image_size and arr.shape[:2] != (image_size, image_size):
         # nearest-neighbor resize without PIL dependency
         ys = (np.linspace(0, arr.shape[0] - 1, image_size)).astype(np.int64)
         xs = (np.linspace(0, arr.shape[1] - 1, image_size)).astype(np.int64)
@@ -137,4 +139,171 @@ class VLMCollator:
                 k = min(len(patches), self.max_images)
                 out["pixel_patches"][i, :k] = patches[:k]
                 out["image_mask"][i, :k] = True
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Qwen2.5-VL native-architecture pipeline (real grids, window attention)
+# ---------------------------------------------------------------------------
+
+def image_to_qwen_patches(img: np.ndarray, vcfg) -> "tuple[np.ndarray, tuple]":
+    """[H, W, C] float in [0,1] -> (patches [gh*gw, patch_dim] in the
+    merge-block order the vision tower expects, grid (t, gh, gw)).
+
+    Matches the conv3d weight flattening (C, T, Ph, Pw) and HF's
+    merge-block patch ordering (Qwen2VLImageProcessor), so checkpoints and
+    our metadata plan agree. Temporal dim duplicates the still image
+    (temporal_patch_size frames, t=1 grid)."""
+    p, m, tp = vcfg.patch_size, vcfg.spatial_merge_size, vcfg.temporal_patch_size
+    unit = p * m
+    h = max(unit, (img.shape[0] // unit) * unit)
+    w = max(unit, (img.shape[1] // unit) * unit)
+    if img.shape[:2] != (h, w):
+        ys = np.linspace(0, img.shape[0] - 1, h).astype(np.int64)
+        xs = np.linspace(0, img.shape[1] - 1, w).astype(np.int64)
+        img = img[ys][:, xs]
+    x = (img.astype(np.float32) - 0.5) / 0.5          # [H, W, C]
+    gh, gw = h // p, w // p
+    x = np.stack([x] * tp)                             # [T, H, W, C]
+    x = x.transpose(3, 0, 1, 2)                        # [C, T, H, W]
+    x = x.reshape(vcfg.in_channels, tp, gh, p, gw, p)
+    x = x.transpose(2, 4, 0, 1, 3, 5).reshape(gh, gw, -1)  # [gh, gw, pdim]
+    x = x.reshape(gh // m, m, gw // m, m, -1).transpose(0, 2, 1, 3, 4)
+    return x.reshape(gh * gw, -1), (1, gh, gw)
+
+
+@DATA_TRANSFORM_REGISTRY.register("qwen2_5_vl")
+def build_qwen25_vl_transform(
+    tokenizer=None,
+    *,
+    vlm_config=None,   # Qwen25VLConfig
+    max_seq_len: int = 0,
+    max_patches_per_sample: int = 0,
+    text_keys: str = "text",
+    **_,
+):
+    """Rows: {"text" | "input_ids", "images": [HWC arrays or paths]}.
+    Each image becomes ``vision_start + n_merged placeholder tokens`` at the
+    head of the sequence (inline '<image>' markers are a chat-template
+    concern, handled by the conversation transform)."""
+    cfg = vlm_config
+    vcfg = cfg.vision
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        patches_list, grids = [], []
+        budget = max_patches_per_sample
+        for im in row.get("images", []):
+            arr = load_image(im, image_size=0) if isinstance(im, str) else np.asarray(im, np.float32)
+            if arr.max() > 1.5:
+                arr = arr / 255.0
+            px, grid = image_to_qwen_patches(arr, vcfg)
+            if budget and sum(p.shape[0] for p in patches_list) + px.shape[0] > budget:
+                break  # keep placeholders and patch budget consistent
+            patches_list.append(px)
+            grids.append(grid)
+        if "input_ids" in row:
+            text_ids: List[int] = list(row["input_ids"])
+        else:
+            text_ids = tokenizer(row[text_keys], add_special_tokens=True)["input_ids"]
+        # a literal placeholder string in document text would desync the
+        # grid <-> token walk (mrope + feature scatter key on these ids)
+        stray = {cfg.image_token_id, cfg.video_token_id}
+        text_ids = [t for t in text_ids if t not in stray]
+        # drop trailing images whose placeholder span wouldn't fit: a
+        # truncated placeholder run would desync the grid <-> token walk
+        def header_len(gs):
+            return sum(
+                1 + t * (gh // vcfg.spatial_merge_size) * (gw // vcfg.spatial_merge_size)
+                for t, gh, gw in gs
+            )
+
+        while max_seq_len and grids and header_len(grids) >= max_seq_len:
+            grids.pop()
+            patches_list.pop()
+        ids: List[int] = []
+        labels: List[int] = []
+        for (t, gh, gw) in grids:
+            n_merged = t * (gh // vcfg.spatial_merge_size) * (gw // vcfg.spatial_merge_size)
+            ids += [cfg.vision_start_token_id] + [cfg.image_token_id] * n_merged
+            labels += [IGNORE_INDEX] * (n_merged + 1)
+        ids += text_ids
+        labels += list(row.get("labels", text_ids))
+        if max_seq_len:
+            ids, labels = ids[:max_seq_len], labels[:max_seq_len]
+        return {
+            "input_ids": ids,
+            "labels": labels,
+            "vis_patches": np.concatenate(patches_list)
+            if patches_list else np.zeros((0, vcfg.patch_dim), np.float32),
+            "vis_grids": grids,
+        }
+
+    return transform
+
+
+class Qwen25VLCollator:
+    """Pads samples to [B, S] text + ONE packed, window-ordered patch
+    sequence per micro-batch (static ``max_patches`` budget) with the full
+    index plan (vision_metadata) and mrope position ids [B, 3, S].
+
+    Single-controller contract: the vision arrays are global per micro-batch
+    (replicated sharding); per-process assembly for multihost VLM uses a
+    per-row budget variant (follow-up)."""
+
+    def __init__(self, seq_len: int, micro_batch_size: int, vlm_config,
+                 max_patches: int, sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError(f"seq_len {seq_len} % sp_size {sp_size} != 0")
+        unit = vlm_config.vision.merge_unit
+        if max_patches % unit:
+            raise ValueError(f"max_patches {max_patches} % merge_unit {unit} != 0")
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+        self.cfg = vlm_config
+        self.max_patches = max_patches
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        from veomni_tpu.models.qwen2_5_vl import mrope_position_ids, vision_metadata
+
+        b, s = self.micro_batch_size, self.seq_len
+        cfg, vcfg = self.cfg, self.cfg.vision
+        out = {
+            "input_ids": np.zeros((b, s), np.int32),
+            "labels": np.full((b, s), IGNORE_INDEX, np.int32),
+            "segment_ids": np.zeros((b, s), np.int32),
+        }
+        all_patches, all_grids = [], []
+        total = 0
+        for i, sample in enumerate(samples[:b]):
+            ids = np.asarray(sample["input_ids"], np.int32)[:s]
+            lab = np.asarray(sample["labels"], np.int32)[: len(ids)]
+            px, grids = sample.get("vis_patches"), list(sample.get("vis_grids", []))
+            if px is not None and len(px):
+                if total + len(px) > self.max_patches:
+                    raise ValueError(
+                        f"micro-batch exceeds max_patches={self.max_patches}; "
+                        "raise data.max_patches or lower image resolution"
+                    )
+                total += len(px)
+                all_patches.append(np.asarray(px))
+                all_grids += grids
+            shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
+            n = len(ids)
+            out["input_ids"][i, :n] = ids
+            out["labels"][i, :n] = shifted
+            out["segment_ids"][i, :n] = 1
+        out["position_ids"] = mrope_position_ids(
+            out["input_ids"].astype(np.int64), all_grids, cfg
+        ).astype(np.int32)
+        meta = vision_metadata(all_grids, vcfg, self.max_patches)
+        px = np.zeros((self.max_patches, vcfg.patch_dim), np.float32)
+        if all_patches:
+            cat = np.concatenate(all_patches)
+            px[: len(cat)] = cat
+        out["pixel_values"] = px[meta["patch_gather"]]
+        out["vis_pos_hw"] = meta["pos_hw"]
+        out["vis_seg_window"] = meta["seg_window"]
+        out["vis_seg_full"] = meta["seg_full"]
+        out["vis_reverse"] = meta["reverse"]
+        out["vis_merged_mask"] = meta["merged_mask"]
         return out

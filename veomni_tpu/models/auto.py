@@ -83,7 +83,7 @@ def _register_vlm_families():
         save_file(flat, f"{out_dir}/model.safetensors")
         hf_io.save_hf_checkpoint(params["language_model"], cfg.text, f"{out_dir}/language_model")
 
-    for mt in ("qwen2_vl", "qwen2_5_vl", "qwen3_vl"):
+    for mt in ("qwen2_vl", "qwen3_vl"):
         MODEL_REGISTRY.register(
             mt,
             ModelFamily(
@@ -98,6 +98,23 @@ def _register_vlm_families():
             ),
         )
 
+    # qwen2_5_vl is the real architecture (window-attn ViT + mrope + merger)
+    from veomni_tpu.models import qwen2_5_vl as q25
+
+    MODEL_REGISTRY.register(
+        "qwen2_5_vl",
+        ModelFamily(
+            model_type="qwen2_5_vl",
+            config_cls=q25.Qwen25VLConfig,
+            init_params=q25.init_params,
+            abstract_params=q25.abstract_params,
+            loss_fn=q25.loss_fn,
+            forward_logits=None,
+            hf_to_params=q25.hf_to_params,
+            save_hf_checkpoint=q25.save_hf_checkpoint,
+        ),
+    )
+
 
 _register_vlm_families()
 
@@ -111,6 +128,19 @@ def build_config(model_type: str = "", **overrides):
     nested text config so the same override surface works for both.
     """
     overrides.pop("model_type", None)
+    if model_type == "qwen2_5_vl":
+        from veomni_tpu.models.qwen2_5_vl import Qwen25VLConfig
+
+        kw = {
+            k: overrides.pop(k)
+            for k in ("vision", "image_token_id", "video_token_id",
+                      "vision_start_token_id", "freeze_vision")
+            if k in overrides
+        }
+        text = dict(overrides.pop("text", {}) or {})
+        text.update(overrides)
+        text.setdefault("model_type", "qwen2")
+        return Qwen25VLConfig(text=text, **kw)
     if model_type in VLM_MODEL_TYPES:
         from veomni_tpu.models.vlm import VLMConfig
 
@@ -177,7 +207,17 @@ def build_foundation_model(
     if config is None:
         if config_path is None:
             raise ValueError("need config_path or config")
-        config = TransformerConfig.from_pretrained(config_path, **config_overrides)
+        import json as _json
+        import os as _os
+
+        with open(_os.path.join(config_path, "config.json")) as f:
+            hf_dict = _json.load(f)
+        if hf_dict.get("model_type") == "qwen2_5_vl":
+            from veomni_tpu.models.qwen2_5_vl import config_from_hf
+
+            config = config_from_hf(hf_dict, **config_overrides)
+        else:
+            config = TransformerConfig.from_hf_config(hf_dict, **config_overrides)
     if config.model_type not in MODEL_REGISTRY:
         logger.warning_rank0(
             "model_type %r not registered; using llama-family core", config.model_type

@@ -92,14 +92,20 @@ def _read_all_tensors(model_dir: str) -> Dict[str, np.ndarray]:
 
 
 def hf_to_params(
-    model_dir: str, cfg: TransformerConfig, target_shardings=None
+    model_dir: str, cfg: TransformerConfig, target_shardings=None,
+    tensors: Optional[Dict[str, np.ndarray]] = None,
 ) -> Dict[str, Any]:
     """Load an HF checkpoint dir into our stacked-param pytree.
 
     target_shardings: optional pytree of NamedSharding matching
     ``abstract_params(cfg)`` — tensors are placed shard-aligned at load.
+    ``tensors``: already-read {hf_name: array} mapping (composite models pass
+    their text subtree directly instead of re-reading from disk).
     """
-    raw = {re.sub(r"^model\.", "", k): v for k, v in _read_all_tensors(model_dir).items()}
+    raw = {
+        re.sub(r"^model\.", "", k): v
+        for k, v in (tensors if tensors is not None else _read_all_tensors(model_dir)).items()
+    }
     pd = cfg.param_dtype
     L = cfg.num_hidden_layers
     k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0

@@ -29,6 +29,129 @@ def _repeat_kv(k, n_rep: int):
     return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
+def _best_chunk(n: int, target: int) -> int:
+    """Largest divisor of n that is <= target (chunked attention block size)."""
+    best = 1
+    for c in range(1, min(n, target) + 1):
+        if n % c == 0:
+            best = c
+    return best
+
+
+@KERNEL_REGISTRY.register("attention", "xla_chunked")
+def _attention_xla_chunked(
+    q,
+    k,
+    v,
+    segment_ids: Optional[jax.Array] = None,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    sliding_window=None,
+    sinks: Optional[jax.Array] = None,
+    q_chunk: int = 1024,
+    k_chunk: int = 1024,
+):
+    """Blockwise online-softmax attention in pure XLA (flash-attention
+    algorithm, no Pallas): O(S * chunk) live memory instead of the dense
+    impl's [B, H, S, S] f32 score tensor, with ``lax.cond``-skipped
+    fully-non-causal blocks so the causal half costs no FLOPs. The TPU
+    answer to long-context varlen flash attention (reference
+    ``ops/kernels/attention/flash.py``) on platforms where the Pallas
+    kernel is gated off; each block body is remat'd so the backward
+    recomputes block scores exactly like a flash backward.
+    """
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    cq = _best_chunk(sq, q_chunk)
+    ck = _best_chunk(sk, k_chunk)
+    if cq < 128 or ck < 128:
+        # pathological (prime-ish) lengths: blockwise gains nothing
+        return _attention_dense(q, k, v, segment_ids, causal, softmax_scale,
+                                sliding_window, sinks)
+    n_rep = hq // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    nq, nk = sq // cq, sk // ck
+    # [B,H,n,C,D] block layout; compute in the input dtype, accumulate f32
+    qt = q.transpose(0, 2, 1, 3).reshape(b, hq, nq, cq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b, hq, nk, ck, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b, hq, nk, ck, d)
+    seg_q = seg_k = None
+    if segment_ids is not None:
+        seg_q = segment_ids.reshape(b, nq, cq)
+        seg_k = segment_ids.reshape(b, nk, ck)
+
+    neg = jnp.float32(-1e30)
+
+    def kv_block(carry, j, *, qi, i, sq_i):
+        acc, m, l = carry
+        kj = kt[:, :, j]
+        vj = vt[:, :, j]
+        s_blk = jnp.einsum("bhqd,bhkd->bhqk", qi, kj,
+                           preferred_element_type=jnp.float32) * scale
+        qpos = i * cq + jnp.arange(cq)[:, None]
+        kpos = j * ck + jnp.arange(ck)[None, :]
+        mask = jnp.ones((cq, ck), bool)
+        if causal:
+            mask = qpos >= kpos
+            if sliding_window is not None:
+                in_window = (qpos - kpos < sliding_window) | jnp.less_equal(
+                    sliding_window, 0
+                )
+                mask = mask & in_window
+        mask = jnp.broadcast_to(mask[None, None], (b, hq, cq, ck))
+        if seg_q is not None:
+            mask = mask & (sq_i[:, None, :, None] == seg_k[:, j][:, None, None, :])
+        s_blk = jnp.where(mask, s_blk, neg)
+        m_new = jnp.maximum(m, s_blk.max(-1))
+        p = jnp.where(mask, jnp.exp(s_blk - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(q.dtype), vj,
+            preferred_element_type=jnp.float32,
+        )
+        return (acc, m_new, l)
+
+    def q_block(_, i):
+        qi = qt[:, :, i]
+        sq_i = seg_q[:, i] if seg_q is not None else None
+        init = (
+            jnp.zeros((b, hq, cq, d), jnp.float32),
+            jnp.full((b, hq, cq), neg),
+            jnp.zeros((b, hq, cq), jnp.float32),
+        )
+
+        def inner(carry, j):
+            body = jax.checkpoint(
+                lambda c, jj: kv_block(c, jj, qi=qi, i=i, sq_i=sq_i)
+            )
+            if causal:
+                # whole block strictly above the diagonal: skip at runtime
+                needed = (j * ck) <= (i * cq + cq - 1)
+                carry = jax.lax.cond(
+                    needed, lambda c: body(c, j), lambda c: c, carry
+                )
+            else:
+                carry = body(carry, j)
+            return carry, None
+
+        (acc, m, l), _ = jax.lax.scan(inner, init, jnp.arange(nk))
+        if sinks is not None:
+            l = l + jnp.exp(
+                sinks.astype(jnp.float32)[None, :, None] - m
+            )
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return None, out.astype(q.dtype)
+
+    _, out_blocks = jax.lax.scan(q_block, None, jnp.arange(nq))
+    # out_blocks [nq, B, H, Cq, D] -> [B, S, H, D]
+    out = out_blocks.transpose(1, 0, 3, 2, 4).reshape(b, sq, hq, d)
+    return out
+
+
 @KERNEL_REGISTRY.register("attention", "xla")
 def _attention_xla(
     q,
@@ -39,6 +162,26 @@ def _attention_xla(
     softmax_scale: Optional[float] = None,
     sliding_window=None,  # python int OR traced int32 scalar (0/<=0 = full)
     sinks: Optional[jax.Array] = None,  # [Hq] learned sink logits (gpt_oss)
+):
+    from veomni_tpu.utils.env import get_env
+
+    threshold = int(get_env("VEOMNI_ATTN_CHUNK_THRESHOLD"))
+    if q.shape[1] > threshold:
+        return _attention_xla_chunked(q, k, v, segment_ids, causal,
+                                      softmax_scale, sliding_window, sinks)
+    return _attention_dense(q, k, v, segment_ids, causal, softmax_scale,
+                            sliding_window, sinks)
+
+
+def _attention_dense(
+    q,
+    k,
+    v,
+    segment_ids: Optional[jax.Array] = None,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    sliding_window=None,
+    sinks: Optional[jax.Array] = None,
 ):
     b, sq, hq, d = q.shape
     sk = k.shape[1]

@@ -60,7 +60,9 @@ def _scale_inv_freq(inv_freq, rope_scaling, head_dim: int, theta: float):
         att = yarn_get_mscale(factor, mscale_all_dim) ** 2 if mscale_all_dim else 1.0
         # HF also scales cos/sin by yarn_get_mscale(factor, mscale)/yarn_get_mscale(factor, mscale_all_dim)
         return inv, att
-    if rtype in ("default", "dynamic"):
+    if rtype in ("default", "dynamic", "mrope"):
+        # mrope keeps base frequencies; the section mixing happens in
+        # rotary_tables (positions [B,3,S])
         return inv_freq, 1.0
     raise ValueError(f"unsupported rope_scaling type {rtype!r}")
 
@@ -85,12 +87,37 @@ def rotary_tables(
 ):
     """positions [B,S] int -> (cos, sin) each [B,S,head_dim].
 
+    mrope (qwen-vl): positions [B,3,S] (temporal/height/width streams) with
+    ``rope_scaling["mrope_section"]`` — the frequency dim is split into
+    sections and section *i* reads stream ``i % 3`` (HF
+    ``apply_multimodal_rotary_pos_emb`` semantics).
+
     ``interleaved``: pairwise (deepseek) layout — each half-frequency entry
     is repeated twice adjacently instead of concatenated halves. Also scales
     cos/sin by the yarn mscale ratio when rope_scaling requests it (HF
     deepseek _compute_yarn_parameters attention_factor)."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     inv_freq, _ = _scale_inv_freq(inv_freq, rope_scaling, head_dim, theta)
+    msec = (rope_scaling or {}).get("mrope_section")
+    if msec and positions.ndim == 3:
+        import numpy as np
+
+        # [B,3,S] -> [3,B,S,D/2] per-stream angles, then pick each frequency
+        # chunk from its stream (static section map, no gather needed)
+        ang3 = positions.astype(jnp.float32).transpose(1, 0, 2)[..., None] * inv_freq
+        sec = np.concatenate(
+            [np.full(n, i % 3, np.int32) for i, n in enumerate(msec)]
+        )
+        if sec.shape[0] != head_dim // 2:
+            raise ValueError(
+                f"mrope_section {msec} must sum to head_dim/2 = {head_dim // 2}"
+            )
+        pick = jnp.asarray(sec[None, :] == jnp.arange(3)[:, None], jnp.float32)
+        ang = jnp.einsum("tbsd,td->bsd", ang3, pick)
+        ang = jnp.concatenate([ang, ang], axis=-1)
+        return jnp.cos(ang), jnp.sin(ang)
+    if msec and positions.ndim == 2:
+        pass  # text-only rows: all three streams equal -> plain 1D rope
     ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,S,D/2]
     if interleaved:
         ang = jnp.repeat(ang, 2, axis=-1)  # [B,S,D] pairwise

@@ -167,6 +167,20 @@ class ParallelState:
             rank = rank * shape[ax] + int(named[ax])
         return rank
 
+    def without_sp(self) -> "ParallelState":
+        """A scoped view that reports sp=1 over the same mesh — the
+        per-module heterogeneous-SP mechanism (reference
+        ``use_parallel_state`` scoping + ``sp_gather_seqs``,
+        sequence_parallel/data.py:149-298): modules whose activations are
+        replicated along the sequence (vision/audio towers) run under this
+        view so the Ulysses attention wrap and SP loss reduction disengage,
+        while the surrounding LM keeps the full SP layout."""
+        import dataclasses
+
+        return dataclasses.replace(
+            self, ulysses_size=1, cp_size=1, name=f"{self.name}:no_sp"
+        )
+
     def describe(self) -> str:
         return (
             f"ParallelState(name={self.name!r}, world={self.world_size}, "

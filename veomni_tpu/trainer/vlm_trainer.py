@@ -24,8 +24,32 @@ class VLMTrainer(BaseTrainer):
         "pixel_patches", "image_mask",
     )
 
+    @property
+    def _is_qwen25(self) -> bool:
+        return self.model.config.model_type == "qwen2_5_vl"
+
     def _build_data_transform(self):
         d = self.args.data
+        if self._is_qwen25:
+            import jax
+
+            ps = self.parallel_state
+            local_mb = max(
+                1, self.args.train.micro_batch_size * ps.dp_size // jax.process_count()
+            )
+            self.data_transform = build_data_transform(
+                "qwen2_5_vl",
+                tokenizer=self.tokenizer,
+                vlm_config=self.model.config,
+                max_seq_len=d.max_seq_len,
+                # the collator's budget is per MICRO-BATCH; cap each sample to
+                # its share so legitimate data can never blow the static shape
+                max_patches_per_sample=max(
+                    self.model.config.vision.merge_unit, d.max_patches // local_mb
+                ),
+                text_keys=d.text_keys,
+            )
+            return
         self.data_transform = build_data_transform(
             "vlm",
             tokenizer=self.tokenizer,
@@ -47,13 +71,29 @@ class VLMTrainer(BaseTrainer):
         self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
         nproc = jax.process_count()
         local_mb = t.micro_batch_size * ps.dp_size // nproc
-        collator = VLMCollator(
-            seq_len=d.max_seq_len,
-            micro_batch_size=local_mb,
-            vision_config=self.model_vision_config(),
-            max_images=self.model.config.max_images,
-            sp_size=ps.sp_size,
-        )
+        if self._is_qwen25:
+            if nproc > 1:
+                raise NotImplementedError(
+                    "qwen2_5_vl multihost data assembly needs the per-row "
+                    "patch budget variant"
+                )
+            from veomni_tpu.data.multimodal import Qwen25VLCollator
+
+            collator = Qwen25VLCollator(
+                seq_len=d.max_seq_len,
+                micro_batch_size=local_mb,
+                vlm_config=self.model.config,
+                max_patches=d.max_patches,
+                sp_size=ps.sp_size,
+            )
+        else:
+            collator = VLMCollator(
+                seq_len=d.max_seq_len,
+                micro_batch_size=local_mb,
+                vision_config=self.model_vision_config(),
+                max_images=self.model.config.max_images,
+                sp_size=ps.sp_size,
+            )
         self.dataloader = build_dataloader(
             d.dataloader_type,
             dataset=self.dataset,
@@ -70,6 +110,23 @@ class VLMTrainer(BaseTrainer):
 
     def _batch_sharding_map(self):
         ps = self.parallel_state
+        if self._is_qwen25:
+            return {
+                "input_ids": P(None, ps.dp_axes, ps.sp_axes),
+                "labels": P(None, ps.dp_axes, ps.sp_axes),
+                # mrope positions [A, B, 3, S]
+                "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
+                "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
+                # packed global patch sequence: replicated (vision tower runs
+                # data-parallel-replicated; batch-sharded variant follows the
+                # per-row budget collator)
+                "pixel_values": P(None, None, None),
+                "vis_pos_hw": P(None, None, None),
+                "vis_seg_window": P(None, None),
+                "vis_seg_full": P(None, None),
+                "vis_reverse": P(None, None),
+                "vis_merged_mask": P(None, None),
+            }
         return {
             "input_ids": P(None, ps.dp_axes, ps.sp_axes),
             "labels": P(None, ps.dp_axes, ps.sp_axes),

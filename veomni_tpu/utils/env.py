@@ -20,6 +20,10 @@ ENV_DEFAULTS: Dict[str, Any] = {
     "VEOMNI_COMPILE_CACHE": "",
     # Use donated buffers in the train step (disable when debugging).
     "VEOMNI_DONATE_STATE": "1",
+    # Seq length above which the default XLA attention switches to the
+    # blockwise online-softmax (flash-style) path instead of materializing
+    # the [B, H, S, S] score tensor.
+    "VEOMNI_ATTN_CHUNK_THRESHOLD": "2048",
 }
 
 
