@@ -16,10 +16,15 @@ from veomni_tpu.arguments import VeOmniArguments
 
 def _write_dummy_data(path, n=512, vocab=256, seed=0, channels=None):
     rng = np.random.default_rng(seed)
+    # zipf-skewed tokens: unigram stats are learnable, so the smoke test's
+    # "loss decreases" check measures optimization, not noise (uniform data
+    # has optimal loss == ln(vocab) == the init loss)
+    weights = 1.0 / (np.arange(vocab) + 5.0)
+    weights /= weights.sum()
     rows = []
     for _ in range(n):
         ln = int(rng.integers(16, 100))
-        row = {"input_ids": rng.integers(0, vocab, ln).tolist()}
+        row = {"input_ids": rng.choice(vocab, size=ln, p=weights).tolist()}
         if channels:
             row["channel"] = channels[int(rng.integers(0, len(channels)))]
         rows.append(row)
@@ -64,9 +69,8 @@ def test_e2e_training_fsdp_sp(tmp_path):
     from veomni_tpu.trainer import TextTrainer
 
     _write_dummy_data(tmp_path / "data.jsonl")
-    args = _make_args(tmp_path, ulysses_parallel_size=2)
+    args = _make_args(tmp_path, ulysses_parallel_size=2, train_steps=12, lr=5e-3)
     trainer = TextTrainer(args)
-    first_loss = None
     orig_step = trainer.train_step
 
     losses = []
@@ -78,8 +82,10 @@ def test_e2e_training_fsdp_sp(tmp_path):
 
     trainer.train_step = wrapped
     ctl = trainer.train()
-    assert ctl.global_step == 8
-    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    assert ctl.global_step == 12
+    head = np.mean(losses[:2])
+    tail = np.mean(losses[-4:])
+    assert tail < head, f"loss did not decrease: {losses}"
     trainer.checkpointer.close()
 
 

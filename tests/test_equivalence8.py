@@ -1,0 +1,37 @@
+"""8-device mesh-layout equivalence (subprocess driver: needs 8 virtual
+devices while the in-process suite runs on 4). Covers HSDP, DDP inference,
+ep=4, sp=4, combined replicate x ep x sp, and capacity-mode EP."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+DRIVER = os.path.join(os.path.dirname(__file__), "tools", "equivalence8.py")
+
+
+def test_eight_device_layouts():
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    proc = subprocess.run(
+        [sys.executable, DRIVER], env=env, capture_output=True, text=True,
+        timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+
+    for fam in ("dense", "moe"):
+        base_loss, base_gnorm, _ = out[f"{fam}/base"]
+        for key, (loss, gnorm, dropped) in out.items():
+            if not key.startswith(f"{fam}/") or key.endswith(("base", "capacity")):
+                continue
+            np.testing.assert_allclose(loss, base_loss, rtol=2e-5, err_msg=key)
+            np.testing.assert_allclose(gnorm, base_gnorm, rtol=2e-4, err_msg=key)
+
+    # capacity mode: drops visible, loss within a bounded delta of dropless
+    cap_loss, _, cap_dropped = out["moe/ep4_capacity"]
+    base_loss = out["moe/base"][0]
+    assert 0.0 <= cap_dropped < 0.5, f"implausible drop fraction {cap_dropped}"
+    assert abs(cap_loss - base_loss) < 0.05, (cap_loss, base_loss)

@@ -1,0 +1,114 @@
+"""Per-process driver for the multi-process CPU training test.
+
+Launched by tests/test_multihost.py with VEOMNI_COORDINATOR_ADDRESS /
+VEOMNI_NUM_PROCESSES / VEOMNI_PROCESS_ID set. Runs TextTrainer on a
+(4 local x nproc) virtual CPU mesh and prints one JSON line with the loss
+trajectory (the parent asserts cross-process agreement + exact resume).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main():
+    data_path = sys.argv[1]
+    out_dir = sys.argv[2]
+    train_steps = int(sys.argv[3])
+    stop_at = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+
+    from veomni_tpu.arguments import VeOmniArguments
+    from veomni_tpu.trainer import TextTrainer
+    from veomni_tpu.trainer.callbacks import Callback
+
+    args = VeOmniArguments()
+    args.model.config_overrides = {
+        "model_type": "qwen3", "vocab_size": 256, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+        "qk_norm": True,
+    }
+    args.data.train_path = data_path
+    args.data.data_type = "pretokenized"
+    args.data.max_seq_len = 128
+    args.train.platform = "cpu"
+    args.train.num_virtual_devices = 4  # per process
+    args.train.output_dir = out_dir
+    args.train.micro_batch_size = 2
+    args.train.train_steps = train_steps
+    args.train.save_steps = 4
+    args.train.lr = 1e-3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.log_steps = 100
+
+    losses = []
+    hashes = {}
+    batch_hashes = []
+
+    def _hash(trainer):
+        import hashlib
+
+        import jax
+        import numpy as np
+
+        md = hashlib.md5()
+        for leaf in jax.tree.leaves(trainer.train_state.params):
+            for sh in sorted(leaf.addressable_shards, key=lambda s: str(s.index)):
+                md.update(np.ascontiguousarray(np.asarray(sh.data)).tobytes())
+        return md.hexdigest()
+
+    class Capture(Callback):
+        def on_train_begin(self, trainer, state):
+            hashes["begin"] = _hash(trainer)
+            hashes["begin_step"] = state.global_step
+            dl = trainer.dataloader
+            hashes["loader"] = (
+                dl.state_dict() if hasattr(dl, "state_dict") else None
+            )
+            hashes["dp_rank"] = getattr(dl, "dp_rank", None)
+            hashes["dp_size"] = getattr(dl, "dp_size", None)
+            if hasattr(dl, "_epoch_indices"):
+                hashes["first_idxs"] = [int(i) for i in dl._epoch_indices()[:5]]
+
+        def on_train_end(self, trainer, state):
+            hashes["end"] = _hash(trainer)
+
+        def on_step_begin(self, trainer, state):
+            import hashlib
+
+            import numpy as np
+
+            md = hashlib.md5()
+            for k in sorted(trainer.current_batch):
+                md.update(np.ascontiguousarray(
+                    np.asarray(trainer.current_batch[k])).tobytes())
+            batch_hashes.append(md.hexdigest()[:12])
+
+        def on_step_end(self, trainer, state):
+            losses.append(round(float(state.metrics["loss"]), 8))
+            if stop_at and state.global_step >= stop_at:
+                state.should_stop = True
+
+    trainer = TextTrainer(args)
+    trainer.callbacks.append(Capture())
+    import jax
+
+    assert jax.process_count() == int(os.environ["VEOMNI_NUM_PROCESSES"])
+    assert jax.device_count() == 4 * jax.process_count()
+    ctl = trainer.train()
+    trainer.checkpointer.close()
+    print(json.dumps({
+        "process": jax.process_index(),
+        "global_step": ctl.global_step,
+        "losses": losses,
+        "devices": jax.device_count(),
+        "hashes": hashes,
+        "batch_hashes": batch_hashes,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,7 +61,8 @@ class Checkpointer:
                         shutil.rmtree(os.path.join(step_dir, sub), ignore_errors=True)
 
     # ------------------------------------------------------------------ save
-    def save(self, step: int, train_state, extra_state: Optional[Dict[str, Any]] = None):
+    def save(self, step: int, train_state, extra_state: Optional[Dict[str, Any]] = None,
+             rank_state: Optional[Dict[str, Any]] = None):
         path = os.path.join(self.ckpt_dir, f"global_step_{step}", "train_state")
         # in-memory dedupe: async saves only materialize the dir at commit, so
         # isdir alone would race an in-flight save of the same step
@@ -76,10 +77,18 @@ class Checkpointer:
         self._ckptr.save(path, args=ocp.args.StandardSave(train_state))
         if not self.async_save:
             self._ckptr.wait_until_finished()
+        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
         if extra_state is not None and jax.process_index() == 0:
-            extra_path = os.path.join(self.ckpt_dir, f"global_step_{step}", "extra_state.json")
-            with open(extra_path, "w") as f:
+            with open(os.path.join(step_dir, "extra_state.json"), "w") as f:
                 json.dump(extra_state, f)
+        if rank_state is not None:
+            # per-process state (dataloader cursor + packing carry-over is
+            # rank-local data!): every process writes its own file — restoring
+            # rank 0's buffer everywhere would feed all ranks rank-0's samples
+            os.makedirs(step_dir, exist_ok=True)
+            fname = f"extra_state_rank{jax.process_index()}.json"
+            with open(os.path.join(step_dir, fname), "w") as f:
+                json.dump(rank_state, f)
         logger.info_rank0("checkpoint save dispatched: step %d -> %s", step, path)
         self._prune()
 
@@ -142,13 +151,32 @@ class Checkpointer:
                 raise last_err
             return None, None
         self.wait()
-        path = os.path.join(self.ckpt_dir, f"global_step_{step}", "train_state")
+        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
+        path = os.path.join(step_dir, "train_state")
         restored = self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract_state))
-        extra_path = os.path.join(self.ckpt_dir, f"global_step_{step}", "extra_state.json")
         extra = None
+        extra_path = os.path.join(step_dir, "extra_state.json")
         if os.path.exists(extra_path):
             with open(extra_path) as f:
                 extra = json.load(f)
+        rank_path = os.path.join(
+            step_dir, f"extra_state_rank{jax.process_index()}.json"
+        )
+        if os.path.exists(rank_path):
+            with open(rank_path) as f:
+                rank_extra = json.load(f)
+            if extra is None:
+                extra = {}
+            extra.update(rank_extra)
+        elif any(f.startswith("extra_state_rank") for f in os.listdir(step_dir)):
+            # the checkpoint HAS per-rank files, just not for this rank
+            # (process count changed between save and resume)
+            logger.warning_rank0(
+                "no per-rank extra state for process %d of %d (topology "
+                "changed?); dataloader resume may repeat or skip rank-local "
+                "samples",
+                jax.process_index(), jax.process_count(),
+            )
         logger.info_rank0("checkpoint restored from step %d", step)
         return restored, extra
 

@@ -109,6 +109,11 @@ class TextPackingCollator:
         self._pending = list(state.get("pending", []))
         self.dropped_oversized = int(state.get("dropped_oversized", 0))
 
+    def carryover_len(self) -> int:
+        """Samples waiting in the carry-over buffer (the dataloader offers
+        only enough new samples to top the pool back up)."""
+        return len(self._pending)
+
     def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
         """samples: dicts with 'input_ids' (list[int]) and optional 'labels'
         (same length; -100 where loss is masked, e.g. prompt tokens)."""

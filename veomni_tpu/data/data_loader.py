@@ -81,8 +81,17 @@ class DistributedDataloader:
             while self._cursor + need <= len(idxs):
                 micro_batches = []
                 for a in range(self.grad_accum_steps):
-                    take = idxs[self._cursor: self._cursor + group]
-                    self._cursor += group
+                    # demand-driven offer: a packing collator carries unfitted
+                    # samples over; only top its pool back up to `group`, so
+                    # the carry-over buffer stays bounded instead of
+                    # snowballing (it would otherwise absorb the whole epoch
+                    # and dominate every batch)
+                    backlog = 0
+                    if hasattr(self.collate_fn, "carryover_len"):
+                        backlog = self.collate_fn.carryover_len()
+                    offer = max(0, group - backlog)
+                    take = idxs[self._cursor: self._cursor + offer]
+                    self._cursor += offer
                     samples = [self.dataset[int(i)] for i in take]
                     micro_batches.append(self.collate_fn(samples))
                 yield stack_micro_batches(micro_batches)

@@ -131,16 +131,23 @@ def lora_parallel_plan_rules() -> Dict[str, tuple]:
 
 # ------------------------------------------------------------------ save/load
 def save_adapter(lora_params, cfg: LoraConfig, out_dir: str) -> None:
-    """Adapter-only checkpoint (reference LoRA trainable_only save)."""
+    """Adapter-only checkpoint (reference LoRA trainable_only save).
+    Collective in multiprocess runs (sharded adapters are gathered); only
+    process 0 writes files."""
     from safetensors.flax import save_file
 
+    from veomni_tpu.models.hf_io import gather_to_host
+
+    host = gather_to_host(lora_params)
+    if jax.process_index() != 0:
+        return
     os.makedirs(out_dir, exist_ok=True)
     flat = {}
 
     def _flatten(path, leaf):
-        flat[param_path_str(path)] = jax.device_get(leaf)
+        flat[param_path_str(path)] = leaf
 
-    jax.tree_util.tree_map_with_path(_flatten, lora_params)
+    jax.tree_util.tree_map_with_path(_flatten, host)
     save_file({k: jnp.asarray(v) for k, v in flat.items()},
               os.path.join(out_dir, "adapter_model.safetensors"))
     with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:

@@ -75,13 +75,19 @@ def _register_vlm_families():
 
         from veomni_tpu.parallel.parallel_plan import param_path_str
 
-        os.makedirs(out_dir, exist_ok=True)
-        flat = {}
-        jax.tree_util.tree_map_with_path(
-            lambda p, x: flat.__setitem__(param_path_str(p), jax.device_get(x)), params
+        host = hf_io.gather_to_host(params)  # collective in multiprocess
+        if jax.process_index() == 0:
+            os.makedirs(out_dir, exist_ok=True)
+            flat = {}
+            jax.tree_util.tree_map_with_path(
+                lambda p, x: flat.__setitem__(param_path_str(p), x), host
+            )
+            save_file(flat, f"{out_dir}/model.safetensors")
+        # reuse the gathered host copy: gather_to_host inside is a no-op on
+        # numpy leaves, so the LM isn't allgathered a second time
+        hf_io.save_hf_checkpoint(
+            host["language_model"], cfg.text, f"{out_dir}/language_model"
         )
-        save_file(flat, f"{out_dir}/model.safetensors")
-        hf_io.save_hf_checkpoint(params["language_model"], cfg.text, f"{out_dir}/language_model")
 
     for mt in ("qwen2_vl", "qwen3_vl"):
         MODEL_REGISTRY.register(

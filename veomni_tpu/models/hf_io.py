@@ -91,32 +91,191 @@ def _read_all_tensors(model_dir: str) -> Dict[str, np.ndarray]:
     return out
 
 
+class LazyHFTensors:
+    """Lazy view over a sharded safetensors checkpoint: per-tensor and
+    per-slice reads instead of materializing the model in host RAM
+    (reference streamed loading, ``module_utils.py:348,530,867``). Backed by
+    mmap'd ``safe_open`` handles, so repeated slice reads ride the page
+    cache."""
+
+    def __init__(self, model_dir: Optional[str], tensors: Optional[Dict[str, Any]] = None):
+        self._mem = tensors
+        self._handles: Dict[str, Any] = {}
+        self._where: Dict[str, str] = {}
+        self._consumed: set = set()
+        if tensors is None:
+            import safetensors
+
+            files = sorted(
+                f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+            )
+            if not files:
+                raise FileNotFoundError(f"no .safetensors under {model_dir}")
+            for fname in files:
+                h = safetensors.safe_open(
+                    os.path.join(model_dir, fname), framework="numpy"
+                )
+                self._handles[fname] = h
+                for key in h.keys():
+                    self._where[key] = fname
+
+    def keys(self):
+        if self._mem is not None:
+            return [k for k in self._mem if k not in self._consumed]
+        return [k for k in self._where if k not in self._consumed]
+
+    def __contains__(self, name: str) -> bool:
+        if name in self._consumed:
+            return False
+        return name in (self._mem if self._mem is not None else self._where)
+
+    def mark_consumed(self, name: str) -> None:
+        self._consumed.add(name)
+
+    def read(self, name: str) -> np.ndarray:
+        """Full tensor (marks consumed)."""
+        if name not in self:
+            raise KeyError(f"missing tensor {name!r}")
+        self.mark_consumed(name)
+        if self._mem is not None:
+            return np.asarray(self._mem[name])
+        return self._handles[self._where[name]].get_tensor(name)
+
+    def read_slice(self, name: str, idx) -> np.ndarray:
+        """Slice read WITHOUT marking consumed (callbacks re-read per shard)."""
+        if self._mem is not None:
+            return np.asarray(self._mem[name])[idx]
+        return np.asarray(self._handles[self._where[name]].get_slice(name)[idx])
+
+    def shape(self, name: str):
+        if self._mem is not None:
+            return tuple(np.asarray(self._mem[name]).shape)
+        return tuple(self._handles[self._where[name]].get_slice(name).get_shape())
+
+
 def hf_to_params(
     model_dir: str, cfg: TransformerConfig, target_shardings=None,
     tensors: Optional[Dict[str, np.ndarray]] = None,
 ) -> Dict[str, Any]:
-    """Load an HF checkpoint dir into our stacked-param pytree.
+    """Stream an HF checkpoint dir into our stacked-param pytree.
 
-    target_shardings: optional pytree of NamedSharding matching
-    ``abstract_params(cfg)`` — tensors are placed shard-aligned at load.
+    Streamed + shard-aligned (reference ``module_utils.py:348,530,867``):
+    with ``target_shardings``, every param is built via
+    ``jax.make_array_from_callback`` whose callback reads ONLY the slices the
+    local shards need straight from the mmap'd safetensors (per-layer /
+    per-expert tensors for stacked params) — peak host RAM is
+    O(one shard slice), never O(model), and multihost EP processes read only
+    their expert slice. Without shardings (tests/CPU), full tensors stream
+    one param at a time.
+
     ``tensors``: already-read {hf_name: array} mapping (composite models pass
     their text subtree directly instead of re-reading from disk).
     """
-    raw = {
-        re.sub(r"^model\.", "", k): v
-        for k, v in (tensors if tensors is not None else _read_all_tensors(model_dir)).items()
-    }
+    lazy = LazyHFTensors(None if tensors is not None else model_dir, tensors)
+    alias = {re.sub(r"^model\.", "", k): k for k in lazy.keys()}
     pd = cfg.param_dtype
+    pd_np = np.dtype(jnp.zeros((), pd).dtype)
     L = cfg.num_hidden_layers
     k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
 
-    def grab(name: str) -> np.ndarray:
-        if name not in raw:
-            raise KeyError(f"missing tensor {name!r} in {model_dir}")
-        return np.asarray(raw.pop(name))
+    shardings: Dict[str, Any] = {}
+    if target_shardings is not None:
+        from veomni_tpu.parallel.parallel_plan import param_path_str
 
-    def maybe_t(x, transpose):
-        return x.T if transpose else x
+        jax.tree_util.tree_map_with_path(
+            lambda p, s: shardings.__setitem__(param_path_str(p), s),
+            target_shardings,
+        )
+
+    def has(name: str) -> bool:
+        return name in alias and alias[name] in lazy
+
+    def place(dotted: str, shape, read_block):
+        """read_block(idx: tuple[slice]) -> np array of that sub-shape."""
+        sh = shardings.get(dotted)
+        if shardings and sh is None:
+            # a silent miss would materialize the tensor fully replicated on
+            # every host — exactly the OOM this loader exists to avoid
+            raise KeyError(
+                f"param {dotted!r} missing from target_shardings "
+                f"(have e.g. {sorted(shardings)[:4]})"
+            )
+        if sh is not None:
+            return jax.make_array_from_callback(
+                tuple(shape), sh,
+                lambda idx: np.ascontiguousarray(read_block(idx)).astype(pd_np),
+            )
+        full = read_block(tuple(slice(None) for _ in shape))
+        return jnp.asarray(np.ascontiguousarray(full), pd)
+
+    def single(dotted: str, name: str, transpose: bool):
+        real = alias[name]
+        hf_shape = lazy.shape(real)
+        shape = tuple(reversed(hf_shape)) if transpose else hf_shape
+        lazy.mark_consumed(real)
+
+        def read(idx):
+            if transpose:
+                return lazy.read_slice(real, tuple(reversed(idx))).T
+            return lazy.read_slice(real, idx)
+
+        return place(dotted, shape, read)
+
+    def stacked(dotted: str, hf_suffix: str, offset: int, count: int,
+                transpose: bool, postprocess=None):
+        names = []
+        for i in range(count):
+            real = alias[f"layers.{offset + i}.{hf_suffix}"]
+            lazy.mark_consumed(real)
+            names.append(real)
+        one = lazy.shape(names[0])
+        one_ours = tuple(reversed(one)) if transpose else one
+        if postprocess is not None:
+            one_ours = postprocess.shape(one_ours)
+
+        def read(idx):
+            lsl, rest = idx[0], tuple(idx[1:])
+            parts = []
+            for i in range(*lsl.indices(count)):
+                if postprocess is not None:
+                    # interleaved layouts: read the layer tensor, slice host-side
+                    part = postprocess.extract(lazy.read_slice(
+                        names[i], tuple(slice(None) for _ in one)))[rest]
+                elif transpose:
+                    part = lazy.read_slice(names[i], tuple(reversed(rest))).T
+                else:
+                    part = lazy.read_slice(names[i], rest)
+                parts.append(part)
+            return np.stack(parts)
+
+        return place(dotted, (count,) + tuple(one_ours), read)
+
+    def experts_stacked(dotted: str, hf_tmpl: str, offset: int, count: int):
+        """[count, E, in, out] from per-expert HF [out, in] tensors — the
+        EP-sliced read path: a callback for an ep-sharded target touches only
+        its (layer, expert) block."""
+        e_total = cfg.num_experts
+        names = [[alias[f"layers.{offset + i}.{hf_tmpl.format(e=e)}"]
+                  for e in range(e_total)] for i in range(count)]
+        for row in names:
+            for real in row:
+                lazy.mark_consumed(real)
+        o_dim, i_dim = lazy.shape(names[0][0])
+
+        def read(idx):
+            lsl, esl, isl, osl = idx
+            ls = range(*lsl.indices(count))
+            es = range(*esl.indices(e_total))
+            out = None
+            for a, i in enumerate(ls):
+                for b, e in enumerate(es):
+                    part = lazy.read_slice(names[i][e], (osl, isl)).T
+                    if out is None:
+                        out = np.empty((len(ls), len(es)) + part.shape, part.dtype)
+                    out[a, b] = part
+            return out
+
+        return place(dotted, (count, e_total, i_dim, o_dim), read)
 
     def set_nested(tree, dotted, value):
         parts = dotted.split(".")
@@ -124,74 +283,86 @@ def hf_to_params(
             tree = tree.setdefault(p, {})
         tree[parts[-1]] = value
 
-    def load_segment(offset: int, count: int, moe_seg: bool) -> Dict[str, Any]:
+    class _Interleave:
+        """gpt_oss fused gate_up [..., 2I] -> every-other-column extract."""
+
+        def __init__(self, start):
+            self.start = start
+
+        def shape(self, s):
+            return s[:-1] + (s[-1] // 2,)
+
+        def extract(self, arr):
+            return arr[..., self.start::2]
+
+    def load_segment(prefix: str, offset: int, count: int, moe_seg: bool):
         layers: Dict[str, Any] = {}
         for ours, hf_suffix, transpose in _LAYER_MAP:
-            if f"layers.{offset}.{hf_suffix}" not in raw:
+            if not has(f"layers.{offset}.{hf_suffix}"):
                 continue
-            stacked = np.stack(
-                [maybe_t(grab(f"layers.{offset + i}.{hf_suffix}"), transpose)
-                 for i in range(count)]
-            )
-            set_nested(layers, ours, jnp.asarray(stacked, pd))
+            set_nested(layers, ours, stacked(
+                f"{prefix}.{ours}", hf_suffix, offset, count, transpose))
         if moe_seg and cfg.is_moe:
-            if f"layers.{offset}.mlp.experts.gate_up_proj" in raw:
+            if has(f"layers.{offset}.mlp.experts.gate_up_proj"):
                 # gpt_oss fused experts: [E, H, 2I] gate/up interleaved
-                gu = np.stack([grab(f"layers.{offset + i}.mlp.experts.gate_up_proj")
-                               for i in range(count)])
-                experts = {
-                    "gate_proj": jnp.asarray(gu[..., ::2], pd),
-                    "up_proj": jnp.asarray(gu[..., 1::2], pd),
-                    "down_proj": jnp.asarray(
-                        np.stack([grab(f"layers.{offset + i}.mlp.experts.down_proj")
-                                  for i in range(count)]), pd),
+                layers["experts"] = {
+                    "gate_proj": stacked(
+                        f"{prefix}.experts.gate_proj", "mlp.experts.gate_up_proj",
+                        offset, count, False, postprocess=_Interleave(0)),
+                    "up_proj": stacked(
+                        f"{prefix}.experts.up_proj", "mlp.experts.gate_up_proj",
+                        offset, count, False, postprocess=_Interleave(1)),
+                    "down_proj": stacked(
+                        f"{prefix}.experts.down_proj", "mlp.experts.down_proj",
+                        offset, count, False),
                 }
-                if f"layers.{offset}.mlp.experts.gate_up_proj_bias" in raw:
-                    gub = np.stack([grab(f"layers.{offset + i}.mlp.experts.gate_up_proj_bias")
-                                    for i in range(count)])
-                    experts["gate_bias"] = jnp.asarray(gub[..., ::2], pd)
-                    experts["up_bias"] = jnp.asarray(gub[..., 1::2], pd)
-                    experts["down_bias"] = jnp.asarray(
-                        np.stack([grab(f"layers.{offset + i}.mlp.experts.down_proj_bias")
-                                  for i in range(count)]), pd)
-                layers["experts"] = experts
-                layers["router"] = jnp.asarray(
-                    np.stack([grab(f"layers.{offset + i}.mlp.router.weight").T
-                              for i in range(count)]), pd)
-                if f"layers.{offset}.mlp.router.bias" in raw:
-                    layers["router_bias"] = jnp.asarray(
-                        np.stack([grab(f"layers.{offset + i}.mlp.router.bias")
-                                  for i in range(count)]), pd)
+                if has(f"layers.{offset}.mlp.experts.gate_up_proj_bias"):
+                    layers["experts"]["gate_bias"] = stacked(
+                        f"{prefix}.experts.gate_bias",
+                        "mlp.experts.gate_up_proj_bias", offset, count, False,
+                        postprocess=_Interleave(0))
+                    layers["experts"]["up_bias"] = stacked(
+                        f"{prefix}.experts.up_bias",
+                        "mlp.experts.gate_up_proj_bias", offset, count, False,
+                        postprocess=_Interleave(1))
+                    layers["experts"]["down_bias"] = stacked(
+                        f"{prefix}.experts.down_bias",
+                        "mlp.experts.down_proj_bias", offset, count, False)
+                layers["router"] = stacked(
+                    f"{prefix}.router", "mlp.router.weight", offset, count, True)
+                if has(f"layers.{offset}.mlp.router.bias"):
+                    layers["router_bias"] = stacked(
+                        f"{prefix}.router_bias", "mlp.router.bias",
+                        offset, count, False)
             else:
                 for ours, hf_tmpl in _EXPERT_MAP:
-                    per_layer = []
-                    for i in range(count):
-                        per_expert = [
-                            grab(f"layers.{offset + i}.{hf_tmpl.format(e=e)}").T
-                            for e in range(cfg.num_experts)
-                        ]
-                        per_layer.append(np.stack(per_expert))
-                    set_nested(layers, ours, jnp.asarray(np.stack(per_layer), pd))
+                    set_nested(layers, ours, experts_stacked(
+                        f"{prefix}.{ours}", hf_tmpl, offset, count))
         return layers
 
+    # NOTE: gate_up_proj appears twice above (gate + up extracts); only mark
+    # consumed once is fine — mark_consumed is idempotent.
     params: Dict[str, Any] = {
-        "embed_tokens": jnp.asarray(grab("embed_tokens.weight"), pd),
-        "norm": jnp.asarray(grab("norm.weight"), pd),
+        "embed_tokens": single("embed_tokens", "embed_tokens.weight", False),
+        "norm": single("norm", "norm.weight", False),
     }
     if k_dense:
-        params["dense_layers"] = load_segment(0, k_dense, False)
-    params["layers"] = load_segment(k_dense, L - k_dense, True)
+        params["dense_layers"] = load_segment("dense_layers", 0, k_dense, False)
+    params["layers"] = load_segment("layers", k_dense, L - k_dense, True)
     if not cfg.tie_word_embeddings:
-        if "lm_head.weight" in raw:
-            params["lm_head"] = jnp.asarray(np.asarray(raw.pop("lm_head.weight")).T, pd)
+        if has("lm_head.weight"):
+            params["lm_head"] = single("lm_head", "lm_head.weight", True)
         else:
-            params["lm_head"] = jnp.asarray(np.asarray(params["embed_tokens"]).T, pd)
-    if raw:
-        logger.warning_rank0("unconsumed HF tensors: %s", sorted(raw)[:8])
-    if target_shardings is not None:
-        params = jax.tree.map(
-            lambda x, s: jax.device_put(x, s), params, target_shardings
-        )
+            # untied head missing in the checkpoint: fall back to embed^T
+            real = alias["embed_tokens.weight"]
+            v, h = lazy.shape(real)
+            params["lm_head"] = place(
+                "lm_head", (h, v),
+                lambda idx: lazy.read_slice(real, tuple(reversed(idx))).T,
+            )
+    remaining = sorted(lazy.keys())
+    if remaining:
+        logger.warning_rank0("unconsumed HF tensors: %s", remaining[:8])
     return params
 
 
@@ -203,10 +374,25 @@ def _get_nested(tree, dotted):
     return tree
 
 
+def gather_to_host(params):
+    """Pytree of (possibly multihost-sharded) arrays -> host numpy. In
+    multiprocess runs this is COLLECTIVE (process_allgather) — every process
+    must call it, even if only process 0 writes files."""
+    def one(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(one, params)
+
+
 def params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, np.ndarray]:
-    """Inverse mapping, for HF-format export (gathers to host)."""
+    """Inverse mapping, for HF-format export (gathers to host; collective in
+    multiprocess runs)."""
     out: Dict[str, np.ndarray] = {}
-    host = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params)
+    host = gather_to_host(params)
     out["model.embed_tokens.weight"] = host["embed_tokens"]
     out["model.norm.weight"] = host["norm"]
     if "lm_head" in host:
@@ -276,8 +462,10 @@ def save_hf_checkpoint(
     ``module_utils.py:1445``)."""
     from safetensors.flax import save_file
 
+    tensors = params_to_hf(params, cfg)  # collective gather (all processes)
+    if jax.process_index() != 0:
+        return
     os.makedirs(out_dir, exist_ok=True)
-    tensors = params_to_hf(params, cfg)
     shards: List[Dict[str, np.ndarray]] = [{}]
     sizes = [0]
     for k in sorted(tensors):

@@ -535,7 +535,7 @@ def params_to_hf(params, cfg: Qwen25VLConfig) -> Dict[str, np.ndarray]:
             out[k] = v
         else:
             out[k.replace("model.", "model.language_model.", 1)] = v
-    vt = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), params["vision_tower"])
+    vt = hf_io.gather_to_host(params["vision_tower"])
     vcfg = cfg.vision
     pfx = "model.visual"
     out[f"{pfx}.patch_embed.proj.weight"] = vt["patch_embed"].T.reshape(
@@ -560,8 +560,10 @@ def save_hf_checkpoint(params, cfg: Qwen25VLConfig, out_dir: str) -> None:
 
     from safetensors.flax import save_file
 
+    tensors = params_to_hf(params, cfg)  # collective gather
+    if jax.process_index() != 0:
+        return
     os.makedirs(out_dir, exist_ok=True)
-    tensors = params_to_hf(params, cfg)
     save_file({k: jnp.asarray(v) for k, v in tensors.items()},
               os.path.join(out_dir, "model.safetensors"))
     hf_cfg = {

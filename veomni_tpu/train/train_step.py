@@ -125,10 +125,13 @@ def build_train_step(
 
     donate = (0,) if env_bool("VEOMNI_DONATE_STATE") else ()
     if state_shardings is not None:
+        # metrics must be explicitly replicated: fully-replicated globals are
+        # host-fetchable on every process (multihost float(metrics[...]))
+        replicated = NamedSharding(pstate.mesh, P())
         return jax.jit(
             step_fn,
             in_shardings=(state_shardings, batch_shardings),
-            out_shardings=(state_shardings, None),
+            out_shardings=(state_shardings, replicated),
             donate_argnums=donate,
         )
     return jax.jit(step_fn, donate_argnums=donate)

@@ -48,6 +48,40 @@ logger = get_logger(__name__)
 BATCH_KEYS = ("input_ids", "labels", "position_ids", "segment_ids")
 
 
+def maybe_initialize_distributed() -> None:
+    """Join the cluster when launcher env vars say so (reference
+    ``dist.init_process_group``, trainer/base.py:355-356; here
+    ``jax.distributed.initialize`` — ICI/DCN wiring is the runtime's job).
+
+    Explicit: VEOMNI_COORDINATOR_ADDRESS + VEOMNI_NUM_PROCESSES +
+    VEOMNI_PROCESS_ID (works on any backend incl. multi-process CPU tests).
+    Auto: VEOMNI_AUTO_DISTRIBUTED=1 calls bare initialize() for platforms
+    with cluster auto-detection (TPU pods, SLURM, GKE).
+
+    Must run BEFORE the first backend touch; no-op if already initialized.
+    """
+    try:
+        if jax.distributed.global_state.client is not None:
+            return
+    except AttributeError:
+        pass
+    coord = os.environ.get("VEOMNI_COORDINATOR_ADDRESS")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["VEOMNI_NUM_PROCESSES"]),
+            process_id=int(os.environ["VEOMNI_PROCESS_ID"]),
+        )
+        logger.info_rank0(
+            "jax.distributed initialized: %d processes", jax.process_count()
+        )
+    elif os.environ.get("VEOMNI_AUTO_DISTRIBUTED") == "1":
+        jax.distributed.initialize()
+        logger.info_rank0(
+            "jax.distributed auto-initialized: %d processes", jax.process_count()
+        )
+
+
 class BaseTrainer:
     def __init__(self, args: VeOmniArguments):
         self.args = args
@@ -88,8 +122,7 @@ class BaseTrainer:
                         "could not apply %s=%r (backends already initialized?): %s",
                         key, val, e,
                     )
-        if jax.process_count() > 1:
-            pass  # jax.distributed.initialize is the launcher's job (multihost)
+        maybe_initialize_distributed()
         self.rng = set_seed(t.seed)
         dp_replicate = t.data_parallel_replicate_size
         dp_shard = t.data_parallel_shard_size
@@ -355,6 +388,14 @@ class BaseTrainer:
             )
         )
         if restored is not None:
+            # normalize on-device layouts to what a fresh jit would produce:
+            # restored buffers can carry different layouts, and XLA (notably
+            # CPU/oneDNN) specializes kernels per layout — without this, a
+            # resumed run is deterministic but not bit-identical to the
+            # uninterrupted one
+            restored = jax.jit(
+                lambda s: s, out_shardings=self.state_shardings
+            )(restored)
             self.train_state = restored
             logger.info_rank0("resumed from checkpoint")
         return restored is not None, extra

@@ -91,9 +91,6 @@ class CheckpointCallback(Callback):
         return {
             "global_step": state.global_step,
             "epoch": state.epoch,
-            "dataloader": trainer.dataloader.state_dict()
-            if hasattr(trainer.dataloader, "state_dict")
-            else None,
             "meter": trainer.meter.state_dict() if trainer.meter else None,
             # any stateful callback (e.g. ChannelLossCallback) rides along
             "callbacks": {
@@ -101,6 +98,15 @@ class CheckpointCallback(Callback):
                 for cb in trainer.callbacks
                 if hasattr(cb, "state_dict")
             },
+        }
+
+    def _rank_state(self, trainer) -> Dict[str, Any]:
+        # rank-LOCAL: the dataloader cursor + packing carry-over buffer hold
+        # this process's data shard; each rank saves/restores its own
+        return {
+            "dataloader": trainer.dataloader.state_dict()
+            if hasattr(trainer.dataloader, "state_dict")
+            else None,
         }
 
     def on_train_begin(self, trainer, state):
@@ -122,12 +128,16 @@ class CheckpointCallback(Callback):
     def on_step_end(self, trainer, state):
         if self.save_steps and state.global_step % self.save_steps == 0:
             self.checkpointer.save(
-                state.global_step, trainer.train_state, self._extra_state(trainer, state)
+                state.global_step, trainer.train_state,
+                self._extra_state(trainer, state),
+                rank_state=self._rank_state(trainer),
             )
 
     def on_train_end(self, trainer, state):
         self.checkpointer.save(
-            state.global_step, trainer.train_state, self._extra_state(trainer, state)
+            state.global_step, trainer.train_state,
+            self._extra_state(trainer, state),
+            rank_state=self._rank_state(trainer),
         )
         self.checkpointer.wait()
 
@@ -138,8 +148,8 @@ class HFCheckpointCallback(Callback):
     both a merged full model and the adapter-only checkpoint)."""
 
     def on_train_end(self, trainer, state):
-        if jax.process_index() != 0:
-            return
+        # NOTE: every process must enter — the export gathers sharded params
+        # collectively; the save functions gate file writes on process 0
         out = os.path.join(trainer.args.train.output_dir, "hf_ckpt")
         params = trainer.train_state.params
         if getattr(trainer, "base_params", None) is not None:
