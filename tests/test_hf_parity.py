@@ -78,6 +78,33 @@ def _hf_model(tmp_path, kind):
             router_aux_loss_coef=0.0, output_router_logits=False,
         )
         m = transformers.GptOssForCausalLM(cfg)
+    elif kind == "seed_oss":
+        cfg = transformers.SeedOssConfig(
+            **DIMS, head_dim=16, attention_bias=True, attention_out_bias=True,
+            attention_dropout=0.0, residual_dropout=0.0,
+        )
+        m = transformers.SeedOssForCausalLM(cfg)
+    elif kind == "glm4_moe":
+        cfg = transformers.Glm4MoeConfig(
+            **DIMS, head_dim=16, partial_rotary_factor=0.5, use_qk_norm=True,
+            n_routed_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+            n_shared_experts=1, n_group=2, topk_group=1,
+            routed_scaling_factor=1.5, norm_topk_prob=True,
+            first_k_dense_replace=1,
+        )
+        m = transformers.Glm4MoeForCausalLM(cfg)
+    elif kind == "deepseek_v2":
+        cfg = transformers.DeepseekV2Config(
+            **{k: v for k, v in DIMS.items() if k != "num_key_value_heads"},
+            num_key_value_heads=DIMS["num_attention_heads"],
+            q_lora_rank=24, kv_lora_rank=16,
+            qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+            n_routed_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+            n_shared_experts=1, topk_method="greedy", norm_topk_prob=False,
+            routed_scaling_factor=1.0, aux_loss_alpha=0.0,
+            first_k_dense_replace=1,
+        )
+        m = transformers.DeepseekV2ForCausalLM(cfg)
     else:
         raise ValueError(kind)
     d = tmp_path / kind
@@ -115,11 +142,12 @@ def _our_loss(model_dir, ids):
     return float(loss_sum / metrics["ntokens"])
 
 
-@pytest.mark.parametrize(
-    "kind",
-    ["llama", "llama31", "qwen2", "qwen3", "qwen3_moe",
-     "gemma3", "deepseek_v3", "gpt_oss"],
-)
+ALL_KINDS = ["llama", "llama31", "qwen2", "qwen3", "qwen3_moe",
+             "gemma3", "deepseek_v3", "gpt_oss",
+             "seed_oss", "glm4_moe", "deepseek_v2"]
+
+
+@pytest.mark.parametrize("kind", ALL_KINDS)
 def test_loss_parity_vs_hf(tmp_path, kind):
     hf, model_dir = _hf_model(tmp_path, kind)
     ids = _batch()
@@ -127,6 +155,79 @@ def test_loss_parity_vs_hf(tmp_path, kind):
     got = _our_loss(model_dir, ids)
     np.testing.assert_allclose(got, expected, rtol=2e-4,
                                err_msg=f"{kind}: ours {got} vs HF {expected}")
+
+
+def _hf_grads(model, ids):
+    """(grad_norm, embed_grad, final_norm_grad) of the token-mean loss."""
+    model.zero_grad()
+    out = model(input_ids=torch.tensor(ids), labels=torch.tensor(ids))
+    out.loss.backward()
+    sq = 0.0
+    for p in model.parameters():
+        if p.grad is not None:
+            sq += float((p.grad.double() ** 2).sum())
+    base = model.model if hasattr(model, "model") else model
+    return (
+        sq ** 0.5,
+        base.embed_tokens.weight.grad.numpy().copy(),
+        base.norm.weight.grad.numpy().copy(),
+    )
+
+
+def _our_grads(model_dir, ids):
+    import optax
+
+    from veomni_tpu.models import build_foundation_model
+
+    model = build_foundation_model(model_dir, dtype=jnp.float32)
+    params = model.load_hf(model_dir)
+    b, s = ids.shape
+    labels = np.concatenate(
+        [ids[:, 1:], np.full((b, 1), -100)], axis=1
+    ).astype(np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(labels),
+        "position_ids": jnp.broadcast_to(jnp.arange(s), (b, s)),
+        "segment_ids": jnp.ones((b, s), jnp.int32),
+    }
+
+    def norm_loss(p, x):
+        loss_sum, metrics = model.loss_fn(p, x)
+        return loss_sum / jnp.maximum(metrics["ntokens"], 1)
+
+    grads = jax.jit(jax.grad(norm_loss))(params, batch)
+    return (
+        float(jax.jit(optax.global_norm)(grads)),
+        np.asarray(grads["embed_tokens"]),
+        np.asarray(grads["norm"]),
+    )
+
+
+# a representative spread: dense, GQA+qk-norm, stacked-expert MoE, MLA+
+# sigmoid routing, fused-expert + sinks, partial-rotary MoE. The backward of
+# every custom-VJP op (chunked CE, grouped GEMM, chunked attention) is on
+# these paths — a wrong-but-loss-preserving backward fails here.
+@pytest.mark.parametrize(
+    "kind", ["llama31", "qwen3", "qwen3_moe", "deepseek_v3", "gpt_oss", "glm4_moe"],
+)
+def test_grad_parity_vs_hf(tmp_path, kind):
+    hf, model_dir = _hf_model(tmp_path, kind)
+    ids = _batch()
+    ref_gnorm, ref_embed, ref_norm = _hf_grads(hf, ids)
+    got_gnorm, got_embed, got_norm = _our_grads(model_dir, ids)
+    np.testing.assert_allclose(got_gnorm, ref_gnorm, rtol=1e-3,
+                               err_msg=f"{kind} grad_norm")
+    # per-tensor check on relative Frobenius error: a wrong backward shows up
+    # as an O(1) relative error. Bound measured against an f64 gold: OUR f32
+    # grads sit at ~2e-7 from it while HF's own f32 deepseek grads carry
+    # ~3.2e-3 of cast-churn noise (routing verified identical) — the bound
+    # accommodates the reference's noise, not ours.
+    tol = 5e-3 if kind.startswith("deepseek") else 2e-3
+    for name, got, ref in (("embed", got_embed, ref_embed),
+                           ("final-norm", got_norm, ref_norm)):
+        rel = np.linalg.norm(got - ref) / max(np.linalg.norm(ref), 1e-12)
+        assert rel < tol, f"{kind} {name} grad relative error {rel:.2e}"
 
 
 def test_streamed_shard_aligned_load(tmp_path):
@@ -172,3 +273,33 @@ def test_streamed_shard_aligned_load(tmp_path):
             )
     finally:
         destroy_parallel_state()
+
+
+def test_bf16_loss_parity_vs_hf(tmp_path):
+    """bf16 compute path vs HF bf16 (loose tolerance: bf16 has ~3 decimal
+    digits; catches dtype-handling breaks, not ulp noise)."""
+    hf, model_dir = _hf_model(tmp_path, "qwen3")
+    hf = hf.to(torch.bfloat16)
+    ids = _batch()
+    with torch.no_grad():
+        expected = float(hf(input_ids=torch.tensor(ids),
+                            labels=torch.tensor(ids)).loss)
+
+    from veomni_tpu.models import build_foundation_model
+
+    model = build_foundation_model(model_dir, dtype=jnp.bfloat16)
+    params = model.load_hf(model_dir)
+    b, s = ids.shape
+    labels = np.concatenate(
+        [ids[:, 1:], np.full((b, 1), -100)], axis=1
+    ).astype(np.int32)
+    batch = {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(labels),
+        "position_ids": jnp.broadcast_to(jnp.arange(s), (b, s)),
+        "segment_ids": jnp.ones((b, s), jnp.int32),
+    }
+    loss_sum, metrics = jax.jit(model.loss_fn)(params, batch)
+    got = float(loss_sum / metrics["ntokens"])
+    np.testing.assert_allclose(got, expected, rtol=2e-2,
+                               err_msg=f"bf16: ours {got} vs HF {expected}")

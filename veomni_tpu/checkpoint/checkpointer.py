@@ -170,8 +170,11 @@ class Checkpointer:
             extra.update(rank_extra)
         elif any(f.startswith("extra_state_rank") for f in os.listdir(step_dir)):
             # the checkpoint HAS per-rank files, just not for this rank
-            # (process count changed between save and resume)
-            logger.warning_rank0(
+            # (process count changed between save and resume). Plain
+            # per-process warning: this condition only occurs on ranks > 0
+            # when the process count GREW, so rank0-gated logging would
+            # never print.
+            logger.warning(
                 "no per-rank extra state for process %d of %d (topology "
                 "changed?); dataloader resume may repeat or skip rank-local "
                 "samples",

@@ -102,6 +102,24 @@ class DistributedDataloader:
 
     def __len__(self) -> int:
         per_epoch = len(self._epoch_indices())
+        if hasattr(self.collate_fn, "carryover_len") and hasattr(
+            self.collate_fn, "seq_len"
+        ):
+            # demand-driven offering consumes ~tokens-per-batch worth of
+            # samples per micro-batch, not `group`; estimate via a probe of
+            # average sample length (cf. DynamicBatchDataloader.__len__)
+            n = len(self.dataset)
+            stride = max(1, n // 100)
+            lens = [
+                len(self.dataset[i]["input_ids"]) for i in range(0, n, stride)
+            ][:100]
+            avg = max(1.0, float(np.mean(lens)))
+            per_batch = max(
+                1.0,
+                self.collate_fn.seq_len
+                * getattr(self.collate_fn, "micro_batch_size", 1) / avg,
+            )
+            return max(1, int(per_epoch / per_batch / self.grad_accum_steps))
         return per_epoch // (self.samples_per_micro_batch * self.grad_accum_steps)
 
     # ----------------------------------------------------------------- state

@@ -206,9 +206,15 @@ def build_qwen25_vl_transform(
         else:
             text_ids = tokenizer(row[text_keys], add_special_tokens=True)["input_ids"]
         # a literal placeholder string in document text would desync the
-        # grid <-> token walk (mrope + feature scatter key on these ids)
+        # grid <-> token walk (mrope + feature scatter key on these ids);
+        # filter labels in lockstep so supervision stays aligned
         stray = {cfg.image_token_id, cfg.video_token_id}
-        text_ids = [t for t in text_ids if t not in stray]
+        text_labels: List[int] = list(row.get("labels", text_ids))
+        kept = [
+            (t, l) for t, l in zip(text_ids, text_labels) if t not in stray
+        ]
+        text_ids = [t for t, _ in kept]
+        text_labels = [l for _, l in kept]
         # drop trailing images whose placeholder span wouldn't fit: a
         # truncated placeholder run would desync the grid <-> token walk
         def header_len(gs):
@@ -227,7 +233,7 @@ def build_qwen25_vl_transform(
             ids += [cfg.vision_start_token_id] + [cfg.image_token_id] * n_merged
             labels += [IGNORE_INDEX] * (n_merged + 1)
         ids += text_ids
-        labels += list(row.get("labels", text_ids))
+        labels += text_labels
         if max_seq_len:
             ids, labels = ids[:max_seq_len], labels[:max_seq_len]
         return {
@@ -262,6 +268,38 @@ class Qwen25VLCollator:
         self.cfg = vlm_config
         self.max_patches = max_patches
 
+    def _sync_grids(self, ids, lab, grids):
+        """Keep grids <-> placeholder runs consistent after seq_len
+        truncation: a run cut mid-image (transform max_seq_len > collator
+        seq_len, or no transform cap) would desync the shared grid iterator
+        in mrope_position_ids and shift every later image's features in the
+        cross-batch scatter. Truncated/absent runs are cut from ids and
+        their grids+patches dropped."""
+        cfg, vcfg = self.cfg, self.cfg.vision
+        m = vcfg.spatial_merge_size
+        expected = [t * (gh // m) * (gw // m) for (t, gh, gw) in grids]
+        patch_counts = [t * gh * gw for (t, gh, gw) in grids]
+        vis = (ids == cfg.image_token_id) | (ids == cfg.video_token_id)
+        kept = 0
+        i = 0
+        n = len(ids)
+        while i < n and kept < len(expected):
+            if not vis[i]:
+                i += 1
+                continue
+            j = i
+            while j < n and vis[j]:
+                j += 1
+            if j - i == expected[kept]:
+                kept += 1
+                i = j
+            else:
+                # truncated run: cut it (and its vision_start marker) off
+                cut = i - 1 if i > 0 and ids[i - 1] == cfg.vision_start_token_id else i
+                ids, lab = ids[:cut], lab[:cut]
+                break
+        return ids, lab, grids[:kept], sum(patch_counts[:kept])
+
     def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
         from veomni_tpu.models.qwen2_5_vl import mrope_position_ids, vision_metadata
 
@@ -278,14 +316,16 @@ class Qwen25VLCollator:
             ids = np.asarray(sample["input_ids"], np.int32)[:s]
             lab = np.asarray(sample["labels"], np.int32)[: len(ids)]
             px, grids = sample.get("vis_patches"), list(sample.get("vis_grids", []))
-            if px is not None and len(px):
+            ids, lab, grids, n_keep_patches = self._sync_grids(ids, lab, grids)
+            if px is not None and n_keep_patches:
+                px = np.asarray(px)[:n_keep_patches]
                 if total + len(px) > self.max_patches:
                     raise ValueError(
                         f"micro-batch exceeds max_patches={self.max_patches}; "
                         "raise data.max_patches or lower image resolution"
                     )
                 total += len(px)
-                all_patches.append(np.asarray(px))
+                all_patches.append(px)
                 all_grids += grids
             shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
             n = len(ids)

@@ -56,7 +56,7 @@ for _mt in (
     "llama", "qwen2", "qwen3", "qwen3_moe",
     "gemma3", "gemma3_text",
     "deepseek_v2", "deepseek_v3",
-    "gpt_oss", "seed_oss", "glm_moe",
+    "gpt_oss", "seed_oss", "glm_moe", "glm4_moe",
 ):
     MODEL_REGISTRY.register(_mt, ModelFamily(model_type=_mt))
 

@@ -40,6 +40,8 @@ class TransformerConfig:
     tie_word_embeddings: bool = False
     attention_bias: bool = False
     o_bias: bool = False  # bias on o_proj too (gpt_oss; qwen2 has qkv only)
+    # rope covers only the first head_dim*factor dims (glm4_moe: 0.5)
+    partial_rotary_factor: float = 1.0
     mlp_bias: bool = False
     qk_norm: bool = False
     sliding_window: Optional[int] = None
@@ -136,7 +138,7 @@ class TransformerConfig:
         "rope_local_base_freq q_lora_rank kv_lora_rank qk_nope_head_dim "
         "qk_rope_head_dim v_head_dim routed_scaling_factor n_group "
         "topk_group n_shared_experts first_k_dense_replace scoring_func "
-        "mlp_bias attention_bias"
+        "mlp_bias attention_bias partial_rotary_factor"
     ).split()
 
     @classmethod
@@ -184,11 +186,26 @@ class TransformerConfig:
                       mlp_bias=True, hidden_act="gpt_oss_glu", router_bias=True,
                       num_experts=hf.get("num_local_experts", 0))
         if mt in ("deepseek_v3", "deepseek_v2"):
-            kw["scoring_func"] = hf.get("scoring_func", "sigmoid")
+            # v3 routes on sigmoid scores + correction bias (noaux-tc); v2
+            # uses plain softmax scores with greedy / max-per-group topk
+            kw["scoring_func"] = hf.get(
+                "scoring_func", "softmax" if mt == "deepseek_v2" else "sigmoid"
+            )
             kw["norm_topk_prob"] = hf.get("norm_topk_prob", True)
             # deepseek trains bias-update (noaux-tc), not an aux loss term
             kw["router_aux_loss_coef"] = hf.get("aux_loss_alpha", 0.0)
             kw["rope_interleave"] = hf.get("rope_interleave", True)
+        if mt == "seed_oss":
+            kw["attention_bias"] = hf.get("attention_bias", True)
+            kw["o_bias"] = hf.get("attention_out_bias", False)
+        if mt in ("glm4_moe", "glm_moe"):
+            kw.update(
+                model_type="glm4_moe",
+                qk_norm=hf.get("use_qk_norm", False),
+                scoring_func="sigmoid",       # Glm4MoeTopkRouter: sigmoid + bias
+                router_aux_loss_coef=0.0,     # bias-update balancing, no aux term
+                norm_topk_prob=hf.get("norm_topk_prob", True),
+            )
         if not hf.get("use_sliding_window", True) and mt.startswith("qwen"):
             kw["sliding_window"] = None
         kw.update(overrides)

@@ -156,6 +156,7 @@ class LazyHFTensors:
 def hf_to_params(
     model_dir: str, cfg: TransformerConfig, target_shardings=None,
     tensors: Optional[Dict[str, np.ndarray]] = None,
+    key_map: Optional[Callable[[str], Optional[str]]] = None,
 ) -> Dict[str, Any]:
     """Stream an HF checkpoint dir into our stacked-param pytree.
 
@@ -168,11 +169,19 @@ def hf_to_params(
     their expert slice. Without shardings (tests/CPU), full tensors stream
     one param at a time.
 
-    ``tensors``: already-read {hf_name: array} mapping (composite models pass
-    their text subtree directly instead of re-reading from disk).
+    ``tensors``: already-read {hf_name: array} mapping (small composite
+    subtrees). ``key_map``: rename/filter checkpoint keys before matching
+    (composite models map e.g. ``model.language_model.*`` -> ``model.*`` and
+    drop other modalities' tensors by returning None) — keeps the text
+    subtree of a VLM on the streamed path instead of materializing it.
     """
     lazy = LazyHFTensors(None if tensors is not None else model_dir, tensors)
-    alias = {re.sub(r"^model\.", "", k): k for k in lazy.keys()}
+    alias = {}
+    for k in lazy.keys():
+        nk = key_map(k) if key_map else k
+        if nk is None:
+            continue
+        alias[re.sub(r"^model\.", "", nk)] = k
     pd = cfg.param_dtype
     pd_np = np.dtype(jnp.zeros((), pd).dtype)
     L = cfg.num_hidden_layers
@@ -360,7 +369,9 @@ def hf_to_params(
                 "lm_head", (h, v),
                 lambda idx: lazy.read_slice(real, tuple(reversed(idx))).T,
             )
-    remaining = sorted(lazy.keys())
+    remaining = sorted(
+        k for k in lazy.keys() if (key_map(k) if key_map else k) is not None
+    )
     if remaining:
         logger.warning_rank0("unconsumed HF tensors: %s", remaining[:8])
     return params

@@ -472,57 +472,75 @@ _VIS_BLOCK_MAP = [
 ]
 
 
+def _is_visual_key(k: str) -> bool:
+    return ".visual." in k or k.startswith("visual.")
+
+
+def _text_key_map(k: str) -> Optional[str]:
+    if _is_visual_key(k):
+        return None
+    return k.replace("model.language_model.", "model.").replace(
+        "language_model.model.", "model."
+    )
+
+
 def hf_to_params(model_dir: str, cfg: Qwen25VLConfig, target_shardings=None):
     """Load an HF Qwen2.5-VL checkpoint (visual.* + model.language_model.* /
-    model.* text tree) into our composite pytree."""
+    model.* text tree) into our composite pytree. The text subtree (the
+    dominant share of a 7B/72B checkpoint) stays on hf_io's streamed
+    shard-aligned path; vision tensors stream one at a time."""
     from veomni_tpu.models import hf_io
 
-    raw = hf_io._read_all_tensors(model_dir)
     pd = cfg.text.param_dtype
-    vis = {k: v for k, v in raw.items() if ".visual." in k or k.startswith("visual.")}
-    vis = {k[k.index("visual.") + len("visual."):]: np.asarray(v) for k, v in vis.items()}
+    ts_lm = target_shardings["language_model"] if target_shardings else None
+    ts_vis = target_shardings["vision_tower"] if target_shardings else None
+
+    language_model = hf_io.hf_to_params(
+        model_dir, cfg.text, target_shardings=ts_lm, key_map=_text_key_map
+    )
+
+    lazy = hf_io.LazyHFTensors(model_dir)
+    vis_alias = {}
+    for k in lazy.keys():
+        if _is_visual_key(k):
+            vis_alias[k[k.index("visual.") + len("visual."):]] = k
+
+    def read(name: str) -> np.ndarray:
+        return np.asarray(lazy.read(vis_alias[name]))
+
+    def place(path_in_vis, arr):
+        arr = jnp.asarray(np.ascontiguousarray(arr), pd)
+        if ts_vis is None:
+            return arr
+        sh = ts_vis
+        for p in path_in_vis:
+            sh = sh[p]
+        return jax.device_put(arr, sh)
 
     vcfg = cfg.vision
     blocks: Dict[str, Any] = {}
     for ours, suffix, transpose in _VIS_BLOCK_MAP:
         stacked = np.stack([
-            np.asarray(vis[f"blocks.{i}.{suffix}"]).T if transpose
-            else np.asarray(vis[f"blocks.{i}.{suffix}"])
+            read(f"blocks.{i}.{suffix}").T if transpose
+            else read(f"blocks.{i}.{suffix}")
             for i in range(vcfg.depth)
         ])
-        blocks[ours] = jnp.asarray(stacked, pd)
+        blocks[ours] = place(("blocks", ours), stacked)
     vision_tower = {
-        "patch_embed": jnp.asarray(
-            np.asarray(vis["patch_embed.proj.weight"]).reshape(
-                vcfg.hidden_size, -1
-            ).T, pd,
+        "patch_embed": place(
+            ("patch_embed",),
+            read("patch_embed.proj.weight").reshape(vcfg.hidden_size, -1).T,
         ),
         "blocks": blocks,
         "merger": {
-            "ln_q": jnp.asarray(vis["merger.ln_q.weight"], pd),
-            "fc1_w": jnp.asarray(np.asarray(vis["merger.mlp.0.weight"]).T, pd),
-            "fc1_b": jnp.asarray(vis["merger.mlp.0.bias"], pd),
-            "fc2_w": jnp.asarray(np.asarray(vis["merger.mlp.2.weight"]).T, pd),
-            "fc2_b": jnp.asarray(vis["merger.mlp.2.bias"], pd),
+            "ln_q": place(("merger", "ln_q"), read("merger.ln_q.weight")),
+            "fc1_w": place(("merger", "fc1_w"), read("merger.mlp.0.weight").T),
+            "fc1_b": place(("merger", "fc1_b"), read("merger.mlp.0.bias")),
+            "fc2_w": place(("merger", "fc2_w"), read("merger.mlp.2.weight").T),
+            "fc2_b": place(("merger", "fc2_b"), read("merger.mlp.2.bias")),
         },
     }
-
-    # text subtree: rename to the canonical model.* layout and convert in
-    # memory (no disk round-trip)
-    text_raw = {}
-    for k, v in raw.items():
-        if ".visual." in k or k.startswith("visual."):
-            continue
-        nk = k.replace("model.language_model.", "model.").replace(
-            "language_model.model.", "model."
-        )
-        text_raw[nk] = v
-    language_model = hf_io.hf_to_params(model_dir, cfg.text, tensors=text_raw)
-
-    params = {"language_model": language_model, "vision_tower": vision_tower}
-    if target_shardings is not None:
-        params = jax.tree.map(lambda x, s: jax.device_put(x, s), params, target_shardings)
-    return params
+    return {"language_model": language_model, "vision_tower": vision_tower}
 
 
 def params_to_hf(params, cfg: Qwen25VLConfig) -> Dict[str, np.ndarray]:

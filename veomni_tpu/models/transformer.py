@@ -347,7 +347,14 @@ def _standard_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, wi
     if cfg.qk_norm:
         q = _norm(q, lp["q_norm"], cfg)
         kk = _norm(kk, lp["k_norm"], cfg)
-    q, kk = ops.apply_rotary(q, kk, cos, sin)
+    rot_dim = cos.shape[-1]
+    if rot_dim < cfg.head_dim:
+        # partial rotary (glm4_moe): rope covers the leading dims only
+        q_rot, kk_rot = ops.apply_rotary(q[..., :rot_dim], kk[..., :rot_dim], cos, sin)
+        q = jnp.concatenate([q_rot, q[..., rot_dim:]], axis=-1)
+        kk = jnp.concatenate([kk_rot, kk[..., rot_dim:]], axis=-1)
+    else:
+        q, kk = ops.apply_rotary(q, kk, cos, sin)
     scale = (
         cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar
         else cfg.head_dim ** -0.5
@@ -472,7 +479,10 @@ def forward_hidden(
         if cfg.embed_scale:
             hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
 
-    rope_dim = cfg.qk_rope_head_dim if cfg.use_mla else cfg.head_dim
+    rope_dim = (
+        cfg.qk_rope_head_dim if cfg.use_mla
+        else int(cfg.head_dim * cfg.partial_rotary_factor)
+    )
     cos_g, sin_g = ops.rotary_tables(
         position_ids, rope_dim, cfg.rope_theta, rope_scaling=cfg.rope_scaling
     )

@@ -152,7 +152,7 @@ def _attention_xla_chunked(
     return out
 
 
-@KERNEL_REGISTRY.register("attention", "xla")
+@KERNEL_REGISTRY.register("attention", "xla", priority=1)
 def _attention_xla(
     q,
     k,

@@ -45,7 +45,10 @@ def capture_router_stats(model, params, batch) -> Dict[str, np.ndarray]:
         hidden = compute["embed_tokens"][batch["input_ids"]]
         if cfg.embed_scale:
             hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
-        rope_dim = cfg.qk_rope_head_dim if cfg.use_mla else cfg.head_dim
+        rope_dim = (
+            cfg.qk_rope_head_dim if cfg.use_mla
+            else int(cfg.head_dim * cfg.partial_rotary_factor)
+        )
         cos, sin = transformer.ops.rotary_tables(
             batch["position_ids"], rope_dim, cfg.rope_theta, cfg.rope_scaling
         )
