@@ -93,6 +93,20 @@ def _hf_model(tmp_path, kind):
             first_k_dense_replace=1,
         )
         m = transformers.Glm4MoeForCausalLM(cfg)
+    elif kind == "qwen3_next":
+        cfg = transformers.Qwen3NextConfig(
+            **{k: v for k, v in DIMS.items() if k != "num_hidden_layers"},
+            head_dim=16, partial_rotary_factor=0.25,
+            linear_num_value_heads=4, linear_num_key_heads=2,
+            linear_key_head_dim=16, linear_value_head_dim=16,
+            linear_conv_kernel_dim=4, full_attention_interval=4,
+            num_hidden_layers=4,
+            num_experts=4, num_experts_per_tok=2, moe_intermediate_size=48,
+            shared_expert_intermediate_size=32, decoder_sparse_step=1,
+            norm_topk_prob=True, mlp_only_layers=[],
+            router_aux_loss_coef=0.0, output_router_logits=False,
+        )
+        m = transformers.Qwen3NextForCausalLM(cfg)
     elif kind == "deepseek_v2":
         cfg = transformers.DeepseekV2Config(
             **{k: v for k, v in DIMS.items() if k != "num_key_value_heads"},
@@ -144,7 +158,7 @@ def _our_loss(model_dir, ids):
 
 ALL_KINDS = ["llama", "llama31", "qwen2", "qwen3", "qwen3_moe",
              "gemma3", "deepseek_v3", "gpt_oss",
-             "seed_oss", "glm4_moe", "deepseek_v2"]
+             "seed_oss", "glm4_moe", "deepseek_v2", "qwen3_next"]
 
 
 @pytest.mark.parametrize("kind", ALL_KINDS)
@@ -209,7 +223,8 @@ def _our_grads(model_dir, ids):
 # every custom-VJP op (chunked CE, grouped GEMM, chunked attention) is on
 # these paths — a wrong-but-loss-preserving backward fails here.
 @pytest.mark.parametrize(
-    "kind", ["llama31", "qwen3", "qwen3_moe", "deepseek_v3", "gpt_oss", "glm4_moe"],
+    "kind", ["llama31", "qwen3", "qwen3_moe", "deepseek_v3", "gpt_oss",
+             "glm4_moe", "qwen3_next"],
 )
 def test_grad_parity_vs_hf(tmp_path, kind):
     hf, model_dir = _hf_model(tmp_path, kind)

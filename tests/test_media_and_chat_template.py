@@ -1,0 +1,134 @@
+"""Multimodal media utils + chat template (reference
+multimodal_chat_template.py / {video,audio}_utils.py behaviors)."""
+
+import numpy as np
+import pytest
+
+
+def test_smart_resize_budget():
+    from veomni_tpu.data.media import smart_resize
+
+    h, w = smart_resize(1000, 700, factor=28, max_pixels=28 * 28 * 100)
+    assert h % 28 == 0 and w % 28 == 0
+    assert h * w <= 28 * 28 * 100
+    h2, w2 = smart_resize(10, 10, factor=28, min_pixels=56 * 56)
+    assert h2 % 28 == 0 and w2 >= 28 and h2 * w2 >= 56 * 56
+
+
+def test_smart_nframes_and_indices():
+    from veomni_tpu.data.media import frame_indices, smart_nframes
+
+    n = smart_nframes(300, 30.0, target_fps=2.0, frame_factor=2)
+    assert n % 2 == 0 and 4 <= n <= 300  # 10s * 2fps = 20
+    assert n == 20
+    idx = frame_indices(300, n)
+    assert idx[0] == 0 and idx[-1] == 299 and len(idx) == n
+
+
+def test_load_video_from_frames():
+    from veomni_tpu.data.media import load_video
+
+    frames = (np.random.default_rng(0).random((12, 64, 48, 3)) * 255).astype(np.uint8)
+    out, fps = load_video(frames, target_fps=2.0, min_frames=4, resize_factor=28)
+    assert out.ndim == 4 and out.shape[3] == 3
+    assert out.shape[1] % 28 == 0 and out.shape[2] % 28 == 0
+    assert 0.0 <= out.min() and out.max() <= 1.0
+
+
+def test_load_audio_resample_and_mel():
+    from veomni_tpu.data.media import load_audio, log_mel_spectrogram
+
+    t = np.linspace(0, 1.0, 16000, endpoint=False)
+    tone = np.sin(2 * np.pi * 440 * t).astype(np.float32)
+    wav = load_audio(tone, sample_rate=16000)  # passthrough (array = target)
+    mel = log_mel_spectrogram(wav, n_mels=128)
+    assert mel.shape[1] == 128 and mel.shape[0] == 101  # 1s @ hop 160: 16000/160+1
+    assert np.isfinite(mel).all()
+    # 440 Hz tone: energy concentrated in low mel bins
+    assert mel[:, :32].mean() > mel[:, 64:].mean()
+
+
+def test_load_audio_wav_file(tmp_path):
+    from scipy.io import wavfile
+
+    from veomni_tpu.data.media import load_audio
+
+    sr = 22050
+    t = np.linspace(0, 0.5, sr // 2, endpoint=False)
+    wav = (np.sin(2 * np.pi * 220 * t) * 32767).astype(np.int16)
+    p = str(tmp_path / "a.wav")
+    wavfile.write(p, sr, wav)
+    out = load_audio(p, sample_rate=16000)
+    assert out.dtype == np.float32
+    assert abs(len(out) - 8000) < 10
+    assert np.abs(out).max() <= 1.001
+
+
+class _StubTok:
+    """Maps each character to an id (tiny deterministic tokenizer)."""
+
+    def __call__(self, text, add_special_tokens=False):
+        return {"input_ids": [ord(c) % 997 for c in text]}
+
+
+def _vlm_cfg():
+    from veomni_tpu.models.qwen2_5_vl import Qwen25VLConfig
+
+    return Qwen25VLConfig(
+        text=dict(model_type="qwen2", vocab_size=1024, hidden_size=32,
+                  intermediate_size=64, num_hidden_layers=1,
+                  num_attention_heads=2, num_key_value_heads=1, head_dim=16),
+        vision=dict(depth=1, hidden_size=32, intermediate_size=64,
+                    num_heads=2, patch_size=14, spatial_merge_size=2,
+                    temporal_patch_size=2, window_size=28,
+                    out_hidden_size=32),
+    )
+
+
+def test_chat_template_masks_and_media():
+    from veomni_tpu.data.chat_template import IGNORE_INDEX, qwen_vl_chat_template
+
+    cfg = _vlm_cfg()
+    template = qwen_vl_chat_template(_StubTok(), cfg)
+    img = np.random.default_rng(0).random((56, 56, 3)).astype(np.float32)
+    enc = template.encode_messages([
+        {"role": "user", "content": [
+            {"type": "text", "text": "look:"},
+            {"type": "image", "image": img},
+        ]},
+        {"role": "assistant", "content": "a cat"},
+    ])
+    ids = np.array(enc["input_ids"])
+    labels = np.array(enc["labels"])
+    assert len(ids) == len(labels)
+    # image run present with the right merged count: 56/14=4 -> 4x4 patches
+    # -> merge 2 -> 2*2 = 4 merged tokens
+    n_img = int((ids == cfg.image_token_id).sum())
+    assert n_img == 4
+    assert (ids == cfg.vision_start_token_id).sum() == 1
+    # all image placeholders unsupervised
+    assert (labels[ids == cfg.image_token_id] == IGNORE_INDEX).all()
+    # assistant text supervised, user text not
+    assert (labels != IGNORE_INDEX).sum() > 0
+    assert enc["vis_grids"] == [(1, 4, 4)]
+    assert enc["vis_patches"][0].shape[0] == 16
+
+
+def test_conversation_transform_contract():
+    from veomni_tpu.data.data_transform import build_data_transform
+
+    cfg = _vlm_cfg()
+    tf = build_data_transform(
+        "qwen2_5_vl_conversation", tokenizer=_StubTok(), vlm_config=cfg,
+        max_seq_len=128,
+    )
+    img = np.random.default_rng(1).random((56, 84, 3)).astype(np.float32)
+    out = tf({"messages": [
+        {"role": "user", "content": [{"type": "image", "image": img},
+                                     {"type": "text", "text": "hi"}]},
+        {"role": "assistant", "content": "ok"},
+    ]})
+    assert set(out) >= {"input_ids", "labels", "vis_patches", "vis_grids"}
+    assert out["vis_patches"].shape[0] == 4 * 6  # (56/14)x(84/14)
+    assert out["vis_grids"] == [(1, 4, 6)]
+    assert len(out["input_ids"]) == len(out["labels"]) <= 128

@@ -26,6 +26,9 @@ class ModelArguments:
     lora: Dict[str, Any] = field(default_factory=dict)
     # resume adapter-only checkpoint from this dir ("" = fresh adapters)
     lora_adapter_path: str = ""
+    # param-path regexes whose updates are zeroed (reference freeze toggles,
+    # e.g. ["^vision_tower"] to freeze a ViT); composes with LoRA
+    freeze_modules: List[str] = field(default_factory=list)
 
     def __post_init__(self):
         if not self.tokenizer_path:
@@ -83,14 +86,23 @@ class TrainingArguments:
     weight_decay: float = 0.0
     betas: List[float] = field(default_factory=lambda: [0.9, 0.999])
     max_grad_norm: float = 1.0
+    # per-module LR multipliers: {param-path regex: scale} (reference
+    # per-group LR, vlm_trainer.py vit_lr etc.)
+    module_lr_scales: Dict[str, float] = field(default_factory=dict)
     dpo_beta: float = 0.1
     ppo_clip_ratio: float = 0.2
     # schedule/steps
     train_steps: int = 0              # 0 -> derive from epochs * len(dataloader)
     num_train_epochs: int = 1
-    # numerics
+    # numerics (reference MixedPrecisionConfig: compute bf16, master f32)
     bf16: bool = True
+    param_dtype: str = "float32"   # master/optimizer param dtype
     enable_gradient_checkpointing: bool = True
+    # remat policy: nothing|dots|offload (reference GradientCheckpointing +
+    # activation-offload configs; offload saves matmul outputs to host RAM)
+    gradient_checkpointing_policy: str = "nothing"
+    # ChunkMBS sequence-chunked MLP length, 0 = off (reference ChunkMBS config)
+    chunk_mbs: int = 0
     enable_full_determinism: bool = False
     seed: int = 42
     # checkpoint

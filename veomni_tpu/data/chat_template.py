@@ -1,0 +1,197 @@
+"""Multimodal chat templates: messages-with-media -> token ids + labels +
+processed media payloads.
+
+Reference: ``veomni/data/multimodal/multimodal_chat_template.py`` (995 LoC:
+Qwen2VL/Qwen3VL/Qwen25Omni templates expanding <image>/<video>/<audio>
+content parts into placeholder-token runs, masking non-assistant tokens) and
+``data/chat_template.py``. Design here: one template class parameterized by
+*media expanders* — callables that turn a media item into (placeholder ids,
+payload) — so VLM and omni variants differ only in their expander set, not
+in the message-walk logic.
+
+Message format (HF-conversations style):
+  {"role": "user", "content": [
+      {"type": "text", "text": "what is this?"},
+      {"type": "image", "image": "/path/or/array"},
+  ]}
+Content may also be a plain string. Labels: only assistant-message tokens
+are supervised (IGNORE_INDEX elsewhere); the assistant's closing tag is
+supervised so the model learns to stop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+# expander(item) -> (placeholder_ids, payload_dict_merged_into_sample)
+MediaExpander = Callable[[Any], Tuple[List[int], Dict[str, Any]]]
+
+
+@dataclass
+class MultimodalChatTemplate:
+    tokenizer: Any
+    expanders: Dict[str, MediaExpander] = field(default_factory=dict)
+    system_prompt: Optional[str] = None
+    im_start: str = "<|im_start|>"
+    im_end: str = "<|im_end|>"
+
+    def _tok(self, text: str) -> List[int]:
+        return self.tokenizer(text, add_special_tokens=False)["input_ids"]
+
+    def _render_part(self, part, ids, labels, media, supervised):
+        if isinstance(part, str):
+            t = self._tok(part)
+            ids += t
+            labels += t if supervised else [IGNORE_INDEX] * len(t)
+            return
+        kind = part.get("type", "text")
+        if kind == "text":
+            self._render_part(part.get("text", ""), ids, labels, media, supervised)
+            return
+        if kind not in self.expanders:
+            raise ValueError(f"no expander for media type {kind!r}")
+        item = part.get(kind, part.get("url", part.get("path")))
+        placeholder_ids, payload = self.expanders[kind](item)
+        ids += placeholder_ids
+        labels += [IGNORE_INDEX] * len(placeholder_ids)  # media never supervised
+        for key, value in payload.items():
+            media.setdefault(key, []).append(value)
+
+    def encode_messages(
+        self, messages: Sequence[Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        ids: List[int] = []
+        labels: List[int] = []
+        media: Dict[str, List[Any]] = {}
+        msgs = list(messages)
+        if self.system_prompt and not (msgs and msgs[0].get("role") == "system"):
+            msgs = [{"role": "system", "content": self.system_prompt}] + msgs
+        for msg in msgs:
+            role = msg["role"]
+            supervised = role == "assistant"
+            head = self._tok(f"{self.im_start}{role}\n")
+            ids += head
+            labels += [IGNORE_INDEX] * len(head)
+            content = msg.get("content", "")
+            parts = content if isinstance(content, list) else [content]
+            for part in parts:
+                self._render_part(part, ids, labels, media, supervised)
+            tail = self._tok(f"{self.im_end}\n")
+            ids += tail
+            # the closing tag of assistant turns is supervised (stop signal)
+            labels += tail if supervised else [IGNORE_INDEX] * len(tail)
+        return {"input_ids": ids, "labels": labels, **media}
+
+
+def qwen_vl_chat_template(
+    tokenizer,
+    vlm_config,
+    *,
+    video_kwargs: Optional[Dict[str, Any]] = None,
+) -> MultimodalChatTemplate:
+    """Qwen2.5-VL template: images/videos become
+    ``vision_start + image_pad * n_merged (+ vision_end)`` runs whose length
+    matches the vision tower's merged-token output for the real grid
+    (reference Qwen2VLTemplate.image_pattern/video_pattern)."""
+    from veomni_tpu.data.media import load_video
+    from veomni_tpu.data.multimodal import image_to_qwen_patches, load_image
+
+    cfg = vlm_config
+    vcfg = cfg.vision
+    m = vcfg.spatial_merge_size
+    vision_end = getattr(cfg, "vision_end_token_id", None)
+
+    def _wrap(core_ids: List[int]) -> List[int]:
+        out = [cfg.vision_start_token_id] + core_ids
+        if vision_end is not None:
+            out.append(vision_end)
+        return out
+
+    def expand_image(item) -> Tuple[List[int], Dict[str, Any]]:
+        arr = load_image(item, image_size=0) if isinstance(item, str) else np.asarray(item, np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        patches, grid = image_to_qwen_patches(arr, vcfg)
+        t, gh, gw = grid
+        n_merged = t * (gh // m) * (gw // m)
+        return _wrap([cfg.image_token_id] * n_merged), {
+            "vis_patches": patches, "vis_grids": grid,
+        }
+
+    def expand_video(item) -> Tuple[List[int], Dict[str, Any]]:
+        frames, _fps = load_video(item, **(video_kwargs or {}))
+        # temporal patching groups tp consecutive DISTINCT frames (HF
+        # Qwen2VLImageProcessor contract — no frame duplication)
+        from veomni_tpu.data.multimodal import frames_to_qwen_patches
+
+        tp = vcfg.temporal_patch_size
+        usable = (len(frames) // tp) * tp
+        if not usable:
+            frames = np.concatenate([frames] * tp)[:tp]
+            usable = tp
+        patches, (t, gh, gw) = frames_to_qwen_patches(frames[:usable], vcfg)
+        n_merged = t * (gh // m) * (gw // m)
+        return _wrap([cfg.video_token_id] * n_merged), {
+            "vis_patches": patches, "vis_grids": (t, gh, gw),
+        }
+
+    return MultimodalChatTemplate(
+        tokenizer=tokenizer,
+        expanders={"image": expand_image, "video": expand_video},
+    )
+
+
+def omni_chat_template(
+    tokenizer,
+    omni_config,
+    *,
+    sample_rate: int = 16000,
+) -> MultimodalChatTemplate:
+    """Omni (vision+audio+text) template (reference Qwen25OmniChatTemplate).
+
+    Unlike the qwen-vl template, the omni model's towers consume *static
+    slots*: images are square-resized to ``vision.image_size`` (fixed
+    ``tokens_per_image`` placeholders, ``models/vision.py`` contract) and
+    audio becomes ``max_frames`` log-mel frames -> ``tokens_per_audio``
+    placeholders (``models/omni.py`` AudioEncoderConfig contract)."""
+    from veomni_tpu.data.media import load_audio, log_mel_spectrogram
+
+    cfg = omni_config
+    template = MultimodalChatTemplate(tokenizer=tokenizer)
+
+    if getattr(cfg, "vision", None) is not None:
+        from veomni_tpu.data.multimodal import images_to_patches_np, load_image
+
+        vcfg = cfg.vision
+
+        def expand_image(item) -> Tuple[List[int], Dict[str, Any]]:
+            # load_image handles paths AND arrays, resizing to the square slot
+            arr = load_image(item, image_size=vcfg.image_size)
+            patches = images_to_patches_np(arr[None], vcfg)[0]
+            run = [cfg.image_token_id] * vcfg.tokens_per_image
+            return run, {"pixel_patches": patches}
+
+        template.expanders["image"] = expand_image
+
+    if getattr(cfg, "audio", None) is not None:
+        acfg = cfg.audio
+
+        def expand_audio(item) -> Tuple[List[int], Dict[str, Any]]:
+            wav = load_audio(item, sample_rate=sample_rate)
+            mel = log_mel_spectrogram(
+                wav, n_mels=acfg.n_mels, sample_rate=sample_rate
+            )
+            frames = np.zeros((acfg.max_frames, acfg.n_mels), np.float32)
+            n = min(len(mel), acfg.max_frames)
+            frames[:n] = mel[:n]
+            run = [cfg.audio_token_id] * acfg.tokens_per_audio
+            return run, {"audio_features": frames}
+
+        template.expanders["audio"] = expand_audio
+
+    return template

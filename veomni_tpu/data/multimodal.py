@@ -146,30 +146,38 @@ class VLMCollator:
 # Qwen2.5-VL native-architecture pipeline (real grids, window attention)
 # ---------------------------------------------------------------------------
 
-def image_to_qwen_patches(img: np.ndarray, vcfg) -> "tuple[np.ndarray, tuple]":
-    """[H, W, C] float in [0,1] -> (patches [gh*gw, patch_dim] in the
-    merge-block order the vision tower expects, grid (t, gh, gw)).
+def frames_to_qwen_patches(frames: np.ndarray, vcfg) -> "tuple[np.ndarray, tuple]":
+    """[T*tp, H, W, C] float in [0,1] (tp consecutive DISTINCT frames per
+    temporal patch, HF Qwen2VLImageProcessor contract) -> (patches
+    [t*gh*gw, patch_dim] in merge-block order, grid (t, gh, gw)).
 
-    Matches the conv3d weight flattening (C, T, Ph, Pw) and HF's
-    merge-block patch ordering (Qwen2VLImageProcessor), so checkpoints and
-    our metadata plan agree. Temporal dim duplicates the still image
-    (temporal_patch_size frames, t=1 grid)."""
+    Matches the conv3d weight flattening (C, T, Ph, Pw) and HF's merge-block
+    patch ordering, so checkpoints and our metadata plan agree."""
     p, m, tp = vcfg.patch_size, vcfg.spatial_merge_size, vcfg.temporal_patch_size
+    nt, ih, iw = frames.shape[0], frames.shape[1], frames.shape[2]
+    t = nt // tp
     unit = p * m
-    h = max(unit, (img.shape[0] // unit) * unit)
-    w = max(unit, (img.shape[1] // unit) * unit)
-    if img.shape[:2] != (h, w):
-        ys = np.linspace(0, img.shape[0] - 1, h).astype(np.int64)
-        xs = np.linspace(0, img.shape[1] - 1, w).astype(np.int64)
-        img = img[ys][:, xs]
-    x = (img.astype(np.float32) - 0.5) / 0.5          # [H, W, C]
+    h = max(unit, (ih // unit) * unit)
+    w = max(unit, (iw // unit) * unit)
+    if (ih, iw) != (h, w):
+        ys = np.linspace(0, ih - 1, h).astype(np.int64)
+        xs = np.linspace(0, iw - 1, w).astype(np.int64)
+        frames = frames[:, ys][:, :, xs]
+    x = (frames.astype(np.float32) - 0.5) / 0.5       # [nt, H, W, C]
     gh, gw = h // p, w // p
-    x = np.stack([x] * tp)                             # [T, H, W, C]
-    x = x.transpose(3, 0, 1, 2)                        # [C, T, H, W]
-    x = x.reshape(vcfg.in_channels, tp, gh, p, gw, p)
-    x = x.transpose(2, 4, 0, 1, 3, 5).reshape(gh, gw, -1)  # [gh, gw, pdim]
-    x = x.reshape(gh // m, m, gw // m, m, -1).transpose(0, 2, 1, 3, 4)
-    return x.reshape(gh * gw, -1), (1, gh, gw)
+    x = x.reshape(t, tp, h, w, vcfg.in_channels)
+    x = x.transpose(0, 4, 1, 2, 3)                     # [t, C, tp, H, W]
+    x = x.reshape(t, vcfg.in_channels, tp, gh, p, gw, p)
+    x = x.transpose(0, 3, 5, 1, 2, 4, 6).reshape(t, gh, gw, -1)
+    x = x.reshape(t, gh // m, m, gw // m, m, -1).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(t * gh * gw, -1), (t, gh, gw)
+
+
+def image_to_qwen_patches(img: np.ndarray, vcfg) -> "tuple[np.ndarray, tuple]":
+    """[H, W, C] still image: temporal dim duplicates the frame
+    (temporal_patch_size copies, t=1 grid) per the HF processor."""
+    frames = np.stack([img] * vcfg.temporal_patch_size)
+    return frames_to_qwen_patches(frames, vcfg)
 
 
 @DATA_TRANSFORM_REGISTRY.register("qwen2_5_vl")
@@ -242,6 +250,78 @@ def build_qwen25_vl_transform(
             "vis_patches": np.concatenate(patches_list)
             if patches_list else np.zeros((0, vcfg.patch_dim), np.float32),
             "vis_grids": grids,
+        }
+
+    return transform
+
+
+@DATA_TRANSFORM_REGISTRY.register("qwen2_5_vl_conversation")
+def build_qwen25_vl_conversation_transform(
+    tokenizer=None,
+    *,
+    vlm_config=None,
+    max_seq_len: int = 0,
+    messages_key: str = "messages",
+    video_kwargs=None,
+    **_,
+):
+    """Conversation rows with inline media parts (HF-conversations format)
+    through the multimodal chat template (reference
+    multimodal_chat_template.py Qwen2VLChatTemplate): placeholders land at
+    their in-dialog positions, labels supervise assistant turns only."""
+    from veomni_tpu.data.chat_template import qwen_vl_chat_template
+
+    template = qwen_vl_chat_template(
+        tokenizer, vlm_config, video_kwargs=video_kwargs
+    )
+    vcfg = vlm_config.vision
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        enc = template.encode_messages(row[messages_key])
+        ids, labels = enc["input_ids"], enc["labels"]
+        patches_list = enc.get("vis_patches", [])
+        grids = enc.get("vis_grids", [])
+        if max_seq_len and len(ids) > max_seq_len:
+            # truncation may orphan media: re-sync grids/patches with the
+            # placeholder runs that actually survive, cutting any partial
+            # trailing run (a truncated run would desync the grid<->token walk)
+            ids = ids[:max_seq_len]
+            labels = labels[:max_seq_len]
+            image_like = (vlm_config.image_token_id, vlm_config.video_token_id)
+            m = vcfg.spatial_merge_size
+            runs = []  # (start, length) of contiguous placeholder runs
+            i = 0
+            while i < len(ids):
+                if ids[i] in image_like:
+                    j = i
+                    while j < len(ids) and ids[j] in image_like:
+                        j += 1
+                    runs.append((i, j - i))
+                    i = j
+                else:
+                    i += 1
+            expected = [t * (gh // m) * (gw // m) for (t, gh, gw) in grids]
+            keep = 0
+            for (start, length), exp in zip(runs, expected):
+                if length == exp:
+                    keep += 1
+                else:  # partial trailing run: cut before its vision_start
+                    cut = (
+                        start - 1
+                        if start and ids[start - 1] == vlm_config.vision_start_token_id
+                        else start
+                    )
+                    ids = ids[:cut]
+                    labels = labels[:cut]
+                    break
+            grids = grids[:keep]
+            patches_list = patches_list[:keep]
+        return {
+            "input_ids": ids,
+            "labels": labels,
+            "vis_patches": np.concatenate(patches_list)
+            if patches_list else np.zeros((0, vcfg.patch_dim), np.float32),
+            "vis_grids": [tuple(g) for g in grids],
         }
 
     return transform

@@ -61,6 +61,27 @@ for _mt in (
     MODEL_REGISTRY.register(_mt, ModelFamily(model_type=_mt))
 
 
+def _register_qwen3_next():
+    from veomni_tpu.models import qwen3_next as q3n
+
+    MODEL_REGISTRY.register(
+        "qwen3_next",
+        ModelFamily(
+            model_type="qwen3_next",
+            init_params=q3n.init_params,
+            abstract_params=q3n.abstract_params,
+            loss_fn=q3n.loss_fn,
+            forward_logits=q3n.forward_logits,
+            hf_to_params=q3n.hf_to_params,
+            save_hf_checkpoint=q3n.save_hf_checkpoint,
+            parallel_plan_fn=q3n.parallel_plan,
+        ),
+    )
+
+
+_register_qwen3_next()
+
+
 def _register_vlm_families():
     from veomni_tpu.models import vlm as vlm_mod
     from veomni_tpu.models.vlm import VLMConfig

@@ -77,7 +77,21 @@ class TransformerConfig:
     n_group: int = 0                    # group-limited routing (noaux-tc)
     topk_group: int = 0
     n_shared_experts: int = 0
+    # qwen2-moe / qwen3_next style shared expert: explicit intermediate size
+    # (overrides moe_intermediate_size * n_shared_experts) + sigmoid gate
+    shared_expert_intermediate_size: int = 0
+    shared_expert_gated: bool = False
     first_k_dense_replace: int = 0      # leading dense layers (deepseek)
+    # qwen3_next hybrid GatedDeltaNet (reference models/transformers/qwen3_5/,
+    # ops/kernels/gated_delta_rule/): periodic linear-attention layers with a
+    # full-attention layer every `full_attention_interval` layers
+    linear_num_value_heads: int = 0     # 0 -> no linear-attention layers
+    linear_num_key_heads: int = 0
+    linear_key_head_dim: int = 0
+    linear_value_head_dim: int = 0
+    linear_conv_kernel_dim: int = 4
+    full_attention_interval: int = 4
+    attn_output_gate: bool = False      # full-attn layers: out *= sigmoid(gate)
     # EP dispatch capacity factor; <= 0 means dropless (see parallel/moe.py)
     moe_capacity_factor: float = 0.0
     # numerics
@@ -88,6 +102,12 @@ class TransformerConfig:
     # "offload" (save dots to host memory — the TPU analogue of the
     # reference's CPU activation offload, distributed/offloading.py:74)
     remat_policy: str = "nothing"
+    # ChunkMBS analogue (reference distributed/chunk_mbs.py:145): sequence
+    # chunk length for the per-layer MLP compute. The [B, S, intermediate]
+    # activation — the largest per-layer tensor at long context — is bounded
+    # to [B, chunk_mbs, intermediate] by a lax.map over sequence chunks
+    # (fwd AND the remat'd bwd recompute). 0 disables.
+    chunk_mbs: int = 0
     initializer_range: float = 0.02
 
     def __post_init__(self):
@@ -206,6 +226,32 @@ class TransformerConfig:
                 router_aux_loss_coef=0.0,     # bias-update balancing, no aux term
                 norm_topk_prob=hf.get("norm_topk_prob", True),
             )
+        if mt in ("qwen3_next", "qwen3_5", "qwen3_5_moe"):
+            # hybrid GatedDeltaNet (models/qwen3_next.py); layer pattern comes
+            # from full_attention_interval, not HF layer_types
+            kw.pop("layer_types", None)
+            kw.update(
+                model_type="qwen3_next",
+                # Qwen3NextRMSNorm is zero-centered ((1 + w), zeros init);
+                # the GATED delta-net norm is standard and handled separately
+                norm_zero_centered=True,
+                linear_num_value_heads=hf.get("linear_num_value_heads", 0),
+                linear_num_key_heads=hf.get("linear_num_key_heads", 0),
+                linear_key_head_dim=hf.get("linear_key_head_dim", 0),
+                linear_value_head_dim=hf.get("linear_value_head_dim", 0),
+                linear_conv_kernel_dim=hf.get("linear_conv_kernel_dim", 4),
+                full_attention_interval=hf.get("full_attention_interval", 4) or 4,
+                attn_output_gate=True,
+                partial_rotary_factor=hf.get("partial_rotary_factor", 0.25),
+                shared_expert_intermediate_size=hf.get(
+                    "shared_expert_intermediate_size", 0
+                ),
+                shared_expert_gated=bool(
+                    hf.get("shared_expert_intermediate_size", 0)
+                ),
+                router_aux_loss_coef=hf.get("router_aux_loss_coef", 0.0)
+                if hf.get("output_router_logits") else 0.0,
+            )
         if not hf.get("use_sliding_window", True) and mt.startswith("qwen"):
             kw["sliding_window"] = None
         kw.update(overrides)
@@ -245,4 +291,15 @@ class TransformerConfig:
                 hf["final_logit_softcapping"] = self.final_logit_softcap
         if self.model_type in ("deepseek_v2", "deepseek_v3"):
             hf["aux_loss_alpha"] = hf.pop("router_aux_loss_coef")
+        if self.model_type == "qwen3_next":
+            hf.update(
+                linear_num_value_heads=self.linear_num_value_heads,
+                linear_num_key_heads=self.linear_num_key_heads,
+                linear_key_head_dim=self.linear_key_head_dim,
+                linear_value_head_dim=self.linear_value_head_dim,
+                linear_conv_kernel_dim=self.linear_conv_kernel_dim,
+                full_attention_interval=self.full_attention_interval,
+                shared_expert_intermediate_size=self.shared_expert_intermediate_size,
+                partial_rotary_factor=self.partial_rotary_factor,
+            )
         return hf

@@ -102,7 +102,18 @@ def init_audio_params(rng: jax.Array, cfg: AudioEncoderConfig, dtype=jnp.float32
 
 
 def audio_forward(params, cfg: AudioEncoderConfig, features: jax.Array) -> jax.Array:
-    """features [N, max_frames, n_mels] -> [N, tokens_per_audio, out_hidden]."""
+    """features [N, max_frames, n_mels] -> [N, tokens_per_audio, out_hidden].
+
+    Scoped to sp=1 like vit_forward (per-module heterogeneous SP): audio
+    slots are replicated along the sequence axes."""
+    from veomni_tpu.parallel.parallel_state import (
+        get_parallel_state_or_none, use_parallel_state,
+    )
+
+    ps = get_parallel_state_or_none()
+    if ps is not None and ps.sp_enabled:
+        with use_parallel_state(ps.without_sp()):
+            return audio_forward(params, cfg, features)
     n, frames, mels = features.shape
     t = cfg.tokens_per_audio
     x = features.astype(params["subsample_proj"].dtype)

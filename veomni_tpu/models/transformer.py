@@ -134,13 +134,16 @@ def _moe_params(keys, cfg: TransformerConfig, L: int, pd) -> Params:
         p["experts"]["gate_bias"] = jnp.zeros((L, e, im), pd)
         p["experts"]["up_bias"] = jnp.zeros((L, e, im), pd)
         p["experts"]["down_bias"] = jnp.zeros((L, e, h), pd)
-    if cfg.n_shared_experts:
-        si = im * cfg.n_shared_experts
+    if cfg.n_shared_experts or cfg.shared_expert_intermediate_size:
+        si = cfg.shared_expert_intermediate_size or im * cfg.n_shared_experts
         p["shared_experts"] = {
             "gate_proj": _dense_init(next(keys), (L, h, si), pd, s),
             "up_proj": _dense_init(next(keys), (L, h, si), pd, s),
             "down_proj": _dense_init(next(keys), (L, si, h), pd, s),
         }
+        if cfg.shared_expert_gated:
+            # qwen2-moe/qwen3_next: scalar sigmoid gate on the shared expert
+            p["shared_expert_gate"] = _dense_init(next(keys), (L, h, 1), pd, s)
     return p
 
 
@@ -282,8 +285,11 @@ def experts_apply_sorted(xs, experts: Params, group_sizes, expert_of_row, cfg):
 
 def _shared_experts_out(x, lp, cfg):
     se = lp["shared_experts"]
-    return jnp.dot(gated_act(jnp.dot(x, se["gate_proj"]), jnp.dot(x, se["up_proj"]), cfg),
-                   se["down_proj"])
+    out = jnp.dot(gated_act(jnp.dot(x, se["gate_proj"]), jnp.dot(x, se["up_proj"]), cfg),
+                  se["down_proj"])
+    if "shared_expert_gate" in lp:
+        out = out * jax.nn.sigmoid(jnp.dot(x, lp["shared_expert_gate"]))
+    return out
 
 
 # set by utils/moe_monitor.capture_routing to collect per-layer expert
@@ -310,7 +316,7 @@ def _moe_mlp(x, lp, cfg: TransformerConfig):
 
     weight = topk_w.reshape(-1)[sort_idx][:, None]
     combined = jnp.zeros((t, h), out.dtype).at[token_idx].add(out * weight)
-    if cfg.n_shared_experts:
+    if cfg.n_shared_experts or cfg.shared_expert_intermediate_size:
         combined = combined + _shared_experts_out(x, lp, cfg)
     return combined, aux
 
@@ -444,14 +450,28 @@ def _decoder_layer(
             out, aux = _moe_mlp(x.reshape(b * s, h), lp, cfg)
             out = out.reshape(b, s, h)
     else:
-        gate = jnp.dot(x, lp["gate_proj"])
-        up = jnp.dot(x, lp["up_proj"])
-        if cfg.mlp_bias:
-            gate = gate + lp["gate_bias"]
-            up = up + lp["up_bias"]
-        out = jnp.dot(gated_act(gate, up, cfg), lp["down_proj"])
-        if cfg.mlp_bias:
-            out = out + lp["down_bias"]
+
+        def dense_mlp(xc):
+            gate = jnp.dot(xc, lp["gate_proj"])
+            up = jnp.dot(xc, lp["up_proj"])
+            if cfg.mlp_bias:
+                gate = gate + lp["gate_bias"]
+                up = up + lp["up_bias"]
+            o = jnp.dot(gated_act(gate, up, cfg), lp["down_proj"])
+            if cfg.mlp_bias:
+                o = o + lp["down_bias"]
+            return o
+
+        c = cfg.chunk_mbs
+        if c and s > c and s % c == 0:
+            # ChunkMBS (reference chunk_mbs.py:145): bound the [B,S,inter]
+            # intermediate to [B,c,inter]; lax.map serializes the chunks and
+            # jax.checkpoint keeps the bwd recompute chunked too.
+            xs = jnp.moveaxis(x.reshape(b, s // c, c, h), 1, 0)
+            out = jax.lax.map(jax.checkpoint(dense_mlp), xs)
+            out = jnp.moveaxis(out, 0, 1).reshape(b, s, h)
+        else:
+            out = dense_mlp(x)
         aux = jnp.float32(0.0)
     if cfg.sandwich_norms:
         out = _norm(out, lp["post_feedforward_layernorm"], cfg)

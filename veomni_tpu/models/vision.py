@@ -112,7 +112,21 @@ def _vit_layer(x, lp, cfg: ViTConfig):
 
 def vit_forward(params, cfg: ViTConfig, pixel_patches: jax.Array) -> jax.Array:
     """pixel_patches [N_img, grid*grid, patch_dim] -> [N_img, tokens_per_image,
-    out_hidden_size]."""
+    out_hidden_size].
+
+    Runs under a no-SP scoped ParallelState (per-module heterogeneous SP,
+    reference ``use_parallel_state`` scoping + sp_gather_seqs,
+    sequence_parallel/data.py:149-298): image-slot tensors are replicated
+    along the sequence axes, so the tower computes at sp=1 while the
+    surrounding LM keeps its ulysses/cp layout."""
+    from veomni_tpu.parallel.parallel_state import (
+        get_parallel_state_or_none, use_parallel_state,
+    )
+
+    ps = get_parallel_state_or_none()
+    if ps is not None and ps.sp_enabled:
+        with use_parallel_state(ps.without_sp()):
+            return vit_forward(params, cfg, pixel_patches)
     x = jnp.dot(pixel_patches.astype(params["patch_embed"].dtype), params["patch_embed"])
     x = x + params["pos_embed"]
 

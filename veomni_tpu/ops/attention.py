@@ -238,8 +238,8 @@ def attention(
     from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
 
     pstate = get_parallel_state_or_none()
-    if pstate is not None and pstate.ulysses_size > 1:
-        from veomni_tpu.parallel.sequence_parallel import ulysses_attention
+    if pstate is not None and (pstate.ulysses_size > 1 or pstate.cp_size > 1):
+        from veomni_tpu.parallel.sequence_parallel import sp_attention
 
-        return ulysses_attention(inner, q, k, v, segment_ids, pstate, **kwargs)
+        return sp_attention(inner, q, k, v, segment_ids, pstate, **kwargs)
     return inner(q, k, v, segment_ids=segment_ids, **kwargs)

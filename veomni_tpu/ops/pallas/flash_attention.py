@@ -72,8 +72,8 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
 
-        seg_q = seg_q_ref[:]  # [bq]
-        seg_k = seg_k_ref[:]  # [bk]
+        seg_q = seg_q_ref[0, :]  # [bq]
+        seg_k = seg_k_ref[0, :]  # [bk]
         mask = seg_q[:, None] == seg_k[None, :]
         if causal:
             rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -117,8 +117,12 @@ def _fwd(q, k, v, segment_ids, scale, causal, bq, bk):
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, bq), lambda bi, hi, iq, jk: (bi, iq)),
-            pl.BlockSpec((None, bk), lambda bi, hi, iq, jk: (bi, jk)),
+            # segment ids ride as [B, 1, S]: a squeezed-batch rank-2 block
+            # (1, bq) would violate Mosaic's (8, 128) tiling rule; with the
+            # unit middle dim the block's last-two dims are (1, bq) where
+            # 1 == the array dim, which Mosaic accepts.
+            pl.BlockSpec((None, 1, bq), lambda bi, hi, iq, jk: (bi, 0, iq)),
+            pl.BlockSpec((None, 1, bk), lambda bi, hi, iq, jk: (bi, 0, jk)),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, iq, jk: (bi, hi, iq, 0)),
             kv_spec,
             kv_spec,
@@ -140,7 +144,7 @@ def _fwd(q, k, v, segment_ids, scale, causal, bq, bk):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(segment_ids, segment_ids, q, k, v)
+    )(segment_ids[:, None, :], segment_ids[:, None, :], q, k, v)
     return out, lse
 
 
@@ -175,7 +179,7 @@ def _bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
-        mask = seg_q_ref[:][:, None] == seg_k_ref[:][None, :]
+        mask = seg_q_ref[0, :][:, None] == seg_k_ref[0, :][None, :]
         if causal:
             rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -227,7 +231,7 @@ def _bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        mask = seg_q_ref[:][:, None] == seg_k_ref[:][None, :]
+        mask = seg_q_ref[0, :][:, None] == seg_k_ref[0, :][None, :]
         if causal:
             rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -258,9 +262,10 @@ def _bwd(scale, causal, bq, bk, residuals, g):
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], delta.shape + (_ROWS,))  # [B,H,S,_ROWS]
 
+    seg3 = segment_ids[:, None, :]  # [B, 1, S] — see fwd in_specs comment
     seg_specs = [
-        pl.BlockSpec((None, bq), lambda bi, hi, jk, iq: (bi, iq)),
-        pl.BlockSpec((None, bk), lambda bi, hi, jk, iq: (bi, jk)),
+        pl.BlockSpec((None, 1, bq), lambda bi, hi, jk, iq: (bi, 0, iq)),
+        pl.BlockSpec((None, 1, bk), lambda bi, hi, jk, iq: (bi, 0, jk)),
     ]
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, jk, iq: (bi, hi, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d), lambda bi, hi, jk, iq: (bi, hi // group, jk, 0))
@@ -286,7 +291,7 @@ def _bwd(scale, causal, bq, bk, residuals, g):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(segment_ids, segment_ids, q, k, v, do, lse, delta)
+    )(seg3, seg3, q, k, v, do, lse, delta)
 
     # GQA: fold the q-head group into the kv head grad
     dk = dk_per_head.reshape(b, hkv, group, s, d).sum(axis=2).astype(k.dtype)
@@ -296,8 +301,8 @@ def _bwd(scale, causal, bq, bk, residuals, g):
     kv_spec2 = pl.BlockSpec((1, 1, bk, d), lambda bi, hi, iq, jk: (bi, hi // group, jk, 0))
     row_spec2 = pl.BlockSpec((1, 1, bq, _ROWS), lambda bi, hi, iq, jk: (bi, hi, iq, 0))
     seg_specs2 = [
-        pl.BlockSpec((None, bq), lambda bi, hi, iq, jk: (bi, iq)),
-        pl.BlockSpec((None, bk), lambda bi, hi, iq, jk: (bi, jk)),
+        pl.BlockSpec((None, 1, bq), lambda bi, hi, iq, jk: (bi, 0, iq)),
+        pl.BlockSpec((None, 1, bk), lambda bi, hi, iq, jk: (bi, 0, jk)),
     ]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
@@ -310,7 +315,7 @@ def _bwd(scale, causal, bq, bk, residuals, g):
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(segment_ids, segment_ids, q, k, v, do, lse, delta)
+    )(seg3, seg3, q, k, v, do, lse, delta)
 
     return dq, dk, dv, None
 

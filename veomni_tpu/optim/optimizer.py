@@ -54,3 +54,45 @@ def build_optimizer(
 
         return build_muon(params_or_abstract, lr=lr, weight_decay=weight_decay)
     raise ValueError(f"unknown optimizer {optimizer!r}")
+
+
+def with_param_groups(
+    base: optax.GradientTransformation,
+    abstract_params,
+    *,
+    freeze_patterns=(),
+    lr_scales: Optional[dict] = None,
+) -> optax.GradientTransformation:
+    """Per-module freeze + LR scaling over param-path regexes (reference
+    per-group LR / freeze machinery: ``veomni/trainer/base.py:411-457``,
+    ``vlm_trainer.py`` freeze toggles; here a pure update transform).
+
+    freeze_patterns: updates zeroed (first match wins over lr_scales).
+    lr_scales: {regex: multiplier} applied to matching params' updates.
+    """
+    import re
+
+    from veomni_tpu.parallel.parallel_plan import param_path_str
+
+    def scale_of(path: str) -> float:
+        for pat in freeze_patterns:
+            if re.search(pat, path):
+                return 0.0
+        for pat, s in (lr_scales or {}).items():
+            if re.search(pat, path):
+                return float(s)
+        return 1.0
+
+    scales = jax.tree_util.tree_map_with_path(
+        lambda p, _: scale_of(param_path_str(p)), abstract_params
+    )
+
+    def init(params):
+        return base.init(params)
+
+    def update(updates, state, params=None):
+        updates, state = base.update(updates, state, params)
+        updates = jax.tree.map(lambda u, s: u * s, updates, scales)
+        return updates, state
+
+    return optax.GradientTransformation(init, update)

@@ -158,7 +158,7 @@ def ep_moe_mlp(x, lp, cfg, pstate: ParallelState):
         check_vma=False,
     )
     out, dropped = fn(x, topk_idx, topk_probs, experts)
-    if cfg.n_shared_experts:
+    if cfg.n_shared_experts or cfg.shared_expert_intermediate_size:
         from veomni_tpu.models.transformer import _shared_experts_out
 
         out = out + _shared_experts_out(x, lp, cfg)

@@ -86,13 +86,16 @@ class ParallelPlan:
 
     rules: ordered {regex: spec template}; first match wins.
     default_fsdp: apply auto-FSDP to unmatched params.
-    stacked_layer_prefixes: param paths under these prefixes carry a leading
-      scan-over-layers dim that must never be sharded (specs are shifted).
+    stacked_layer_prefixes: param paths under these prefixes carry leading
+      scan-over-layers dim(s) that must never be sharded (specs are shifted).
+      Entries are either a prefix string (one stacked dim) or a
+      ``(prefix, ndims)`` tuple (e.g. qwen3_next's [groups, per_group] double
+      stack).
     """
 
     rules: Dict[str, SpecTemplate] = field(default_factory=dict)
     default_fsdp: bool = True
-    stacked_layer_prefixes: Tuple[str, ...] = ("layers", "dense_layers")
+    stacked_layer_prefixes: Tuple = ("layers", "dense_layers")
 
     def _default_spec(self, shape, state: ParallelState) -> SpecTemplate:
         if not self.default_fsdp or not shape:
@@ -108,11 +111,14 @@ class ParallelPlan:
     def spec_for(self, path: str, shape, state: ParallelState) -> P:
         # Stacked-layer detection matches the prefix as a path *component* so
         # optimizer-state paths ('mu.layers.q_proj') inherit the layer shift.
-        stacked = any(
-            re.search(rf"(^|\.){re.escape(pfx)}\.", path + ".")
-            for pfx in self.stacked_layer_prefixes
-        )
-        logical_shape = shape[1:] if stacked and len(shape) >= 1 else shape
+        shift = 0
+        for entry in self.stacked_layer_prefixes:
+            pfx, nd = entry if isinstance(entry, tuple) else (entry, 1)
+            if re.search(rf"(^|\.){re.escape(pfx)}\.", path + "."):
+                shift = max(shift, nd)
+        shift = min(shift, max(len(shape) - 1, 0))
+        stacked = shift > 0
+        logical_shape = shape[shift:] if stacked else shape
         template: Optional[SpecTemplate] = None
         for pattern, tmpl in self.rules.items():
             if re.search(pattern, path):
@@ -133,8 +139,8 @@ class ParallelPlan:
                     )
                     axes = None
             resolved.append(axes)
-        if stacked and len(shape) >= 1:
-            resolved = [None] + resolved
+        if stacked:
+            resolved = [None] * shift + resolved
         return P(*resolved[: len(shape)])
 
     def resolve(self, params, state: ParallelState):

@@ -122,8 +122,14 @@ class ParallelState:
 
     @property
     def sp_axes(self) -> Tuple[str, ...]:
-        """Sequence-parallel axes (reference flattened 'sp' = ulysses x cp)."""
-        return (AXIS_ULYSSES, AXIS_CP)
+        """Sequence-parallel axes (reference flattened 'sp' = ulysses x cp).
+
+        ``cp`` is the *outer* axis on purpose: each cp rank then owns one
+        contiguous chunk of the global sequence, which is what the ring
+        schedule's chunk-level causal skip assumes; the ulysses all-to-all
+        (tiled concat over the inner axis) reassembles each cp chunk
+        contiguously."""
+        return (AXIS_CP, AXIS_ULYSSES)
 
     @property
     def fsdp_axes(self) -> Tuple[str, ...]:
@@ -216,10 +222,6 @@ def init_parallel_state(
     instead (the DDP mapping: all non-shard/sp/tp devices replicate).
     ``ep_size`` must divide the (inferred) dp_shard.
     """
-    if cp_size != 1:
-        raise NotImplementedError(
-            "Ring attention (cp) is not supported yet."  # parity: parallel_state.py:81-82
-        )
     for label, size in (("dp_replicate_size", dp_replicate_size),
                         ("dp_shard_size", dp_shard_size)):
         if size < 1 and size != -1:

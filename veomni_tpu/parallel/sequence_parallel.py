@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from veomni_tpu.parallel.parallel_state import AXIS_ULYSSES, ParallelState
+from veomni_tpu.parallel.parallel_state import AXIS_CP, AXIS_ULYSSES, ParallelState
+from veomni_tpu.parallel.ring_attention import ring_attention_local
 
 
 def _repeat_heads(x, factor: int):
@@ -39,7 +40,7 @@ def _repeat_heads(x, factor: int):
     )
 
 
-def ulysses_attention(
+def sp_attention(
     inner_attention: Callable,
     q: jax.Array,
     k: jax.Array,
@@ -49,42 +50,59 @@ def ulysses_attention(
     **attn_kwargs,
 ):
     """q [B, S, Hq, D] / k,v [B, S, Hkv, D] globally shaped, sequence-sharded
-    over the sp axes. Inside the shard_map each rank trades its sequence
-    slice for a head slice (a2a), runs full-sequence attention on Hq/sp
-    heads, and trades back. Returns [B, S, Hq, D] with the same sharding.
+    over the sp axes. Inside one shard_map region:
+
+    * ``ulysses`` a2a trades this rank's sequence slice for a head slice,
+      reassembling each cp rank's contiguous sequence chunk;
+    * if ``cp > 1``, ring attention (``ring_attention_local``) rotates KV
+      chunks over the ``cp`` axis — total sequence parallelism is then
+      ``ulysses * cp`` with the ulysses degree bounded by the head count and
+      the ring degree unbounded (the reference has no CP at all);
+    * otherwise the resolved inner attention runs on the full sequence.
+
+    Returns [B, S, Hq, D] with the input sharding.
     """
-    sp = pstate.ulysses_size
-    if sp == 1:
+    u, cp = pstate.ulysses_size, pstate.cp_size
+    if u == 1 and cp == 1:
         return inner_attention(q, k, v, segment_ids=segment_ids, **attn_kwargs)
 
     hq, hkv = q.shape[2], k.shape[2]
-    if hq % sp:
-        raise ValueError(f"num_attention_heads {hq} must be divisible by ulysses {sp}")
-    # GQA: repeat kv heads up to a multiple of sp (reference ulysses.py:42-48)
-    kv_rep = sp // math.gcd(hkv, sp)
+    if hq % u:
+        raise ValueError(f"num_attention_heads {hq} must be divisible by ulysses {u}")
+    # GQA: repeat kv heads up to a multiple of ulysses (reference ulysses.py:42-48)
+    kv_rep = u // math.gcd(hkv, u)
 
     sinks = attn_kwargs.pop("sinks", None)
     dp, spx = pstate.dp_axes, pstate.sp_axes
     qkv_spec = P(dp, spx, None, None)
-    seg_spec = P(dp, spx) if segment_ids is not None else None
-    sinks_spec = P(AXIS_ULYSSES) if sinks is not None else None
+    seg_spec = P(dp, spx)
+    sinks_spec = P(AXIS_ULYSSES) if (sinks is not None and u > 1) else (
+        P() if sinks is not None else None
+    )
+    if segment_ids is None:
+        segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
 
     def body(q, k, v, seg, snk):
-        # local shapes: [b, s/sp, h, d]; snk holds this rank's head slice
-        k = _repeat_heads(k, kv_rep)
-        v = _repeat_heads(v, kv_rep)
-        # heads -> scattered, seq -> gathered
-        a2a = partial(
-            jax.lax.all_to_all, axis_name=AXIS_ULYSSES, tiled=True
-        )
-        q_g = a2a(q, split_axis=2, concat_axis=1)   # [b, s, hq/sp, d]
-        k_g = a2a(k, split_axis=2, concat_axis=1)
-        v_g = a2a(v, split_axis=2, concat_axis=1)
-        seg_g = None
-        if seg is not None:
-            seg_g = jax.lax.all_gather(seg, AXIS_ULYSSES, axis=1, tiled=True)  # [b, s]
-        out = inner_attention(q_g, k_g, v_g, segment_ids=seg_g, sinks=snk, **attn_kwargs)
-        return a2a(out, split_axis=1, concat_axis=2)  # [b, s/sp, hq, d]
+        # local shapes: [b, s/(u*cp), h, d]; snk holds this rank's head slice
+        if u > 1:
+            k = _repeat_heads(k, kv_rep)
+            v = _repeat_heads(v, kv_rep)
+            # heads -> scattered, seq -> gathered over ulysses only; what
+            # remains sharded on dim 1 is the cp chunk
+            a2a = partial(jax.lax.all_to_all, axis_name=AXIS_ULYSSES, tiled=True)
+            q = a2a(q, split_axis=2, concat_axis=1)   # [b, s/cp, hq/u, d]
+            k = a2a(k, split_axis=2, concat_axis=1)
+            v = a2a(v, split_axis=2, concat_axis=1)
+            seg = jax.lax.all_gather(seg, AXIS_ULYSSES, axis=1, tiled=True)
+        if cp > 1:
+            out = ring_attention_local(
+                q, k, v, seg, axis_name=AXIS_CP, sinks=snk, **attn_kwargs
+            )
+        else:
+            out = inner_attention(q, k, v, segment_ids=seg, sinks=snk, **attn_kwargs)
+        if u > 1:
+            out = a2a(out, split_axis=1, concat_axis=2)  # [b, s/sp, hq, d]
+        return out
 
     in_specs = (qkv_spec, qkv_spec, qkv_spec, seg_spec, sinks_spec)
     fn = shard_map(
@@ -95,6 +113,10 @@ def ulysses_attention(
         check_vma=False,
     )
     return fn(q, k, v, segment_ids, sinks)
+
+
+# Backwards-compatible name (ulysses-only callers)
+ulysses_attention = sp_attention
 
 
 def sp_pad_length(seq_len: int, sp_size: int) -> int:

@@ -68,12 +68,18 @@ def build_train_step(
     state_shardings: Optional[TrainState] = None,
     batch_shardings: Optional[Any] = None,
     max_grad_norm: float = 1.0,
+    grad_mask: Optional[Any] = None,
 ) -> Callable:
     """Returns jitted ``train_step(state, batch) -> (state, metrics)``.
 
     ``loss_fn(params, micro_batch) -> (token_sum_loss, metrics_dict)`` where
     metrics include 'ntokens'. ``batch`` leaves have a leading micro-batch
     (grad-accum) dim A: [A, B, S].
+
+    ``grad_mask``: optional 0/1 pytree matching params — frozen modules'
+    grads are zeroed BEFORE the global-norm clip, so they neither shrink the
+    trainable params' clip budget nor pollute the grad_norm metric
+    (reference freeze semantics exclude params from optimization entirely).
     """
 
     def grads_one_micro(params, micro):
@@ -106,6 +112,8 @@ def build_train_step(
         }
         denom = jnp.maximum(ntokens, 1).astype(jnp.float32)
         grads = jax.tree.map(lambda g: g / denom, grads)
+        if grad_mask is not None:
+            grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
         grad_norm = optax.global_norm(grads)
         if max_grad_norm:
             scale = jnp.minimum(1.0, max_grad_norm / (grad_norm + 1e-6))

@@ -143,12 +143,20 @@ class BaseTrainer:
             pp_size=t.pipeline_parallel_size,
         )
         os.makedirs(t.output_dir, exist_ok=True)
+        if jax.process_index() == 0:
+            from veomni_tpu.arguments import save_args
+
+            save_args(self.args, t.output_dir)
 
     def _build_model(self):
         m = self.args.model
         overrides = dict(m.config_overrides)
         overrides.setdefault("dtype", self.args.train.compute_dtype)
+        overrides.setdefault("param_dtype", self.args.train.param_dtype)
         overrides["remat"] = self.args.train.enable_gradient_checkpointing
+        overrides.setdefault("remat_policy", self.args.train.gradient_checkpointing_policy)
+        if self.args.train.chunk_mbs:
+            overrides.setdefault("chunk_mbs", self.args.train.chunk_mbs)
         if m.model_type:
             overrides["model_type"] = m.model_type
         ops_pins = dict(m.ops_implementation)
@@ -251,10 +259,19 @@ class BaseTrainer:
             lr_warmup_ratio=t.lr_warmup_ratio, lr_min=t.lr_min,
         )
         def _make_optimizer(abstract_trainable):
-            return build_optimizer(
+            tx = build_optimizer(
                 abstract_trainable, optimizer=t.optimizer, lr=self.lr_schedule,
                 betas=tuple(t.betas), weight_decay=t.weight_decay,
             )
+            if self.args.model.freeze_modules or t.module_lr_scales:
+                from veomni_tpu.optim.optimizer import with_param_groups
+
+                tx = with_param_groups(
+                    tx, abstract_trainable,
+                    freeze_patterns=tuple(self.args.model.freeze_modules),
+                    lr_scales=dict(t.module_lr_scales),
+                )
+            return tx
 
         from veomni_tpu.lora import LoraConfig
         from veomni_tpu.train.train_step import TrainState
@@ -293,7 +310,12 @@ class BaseTrainer:
             self.abstract_state = abs_state
             lora = jax.jit(lambda l: l, out_shardings=self.state_shardings.params)(lora)
             self.train_state = TrainState(
-                params=lora, opt_state=self.optimizer.init(lora), step=jnp.int32(0)
+                params=lora, opt_state=self.optimizer.init(lora),
+                # committed to the declared sharding: an uncommitted scalar
+                # has a different jit type signature than the step outputs,
+                # forcing a retrace (and a stale-executable buffer mismatch
+                # on XLA:CPU) at step 2+
+                step=jax.device_put(jnp.int32(0), self.state_shardings.step),
             )
             loss_fn = apply_lora_to_loss_fn(
                 lambda p, b: model.loss_fn(p, b), base_params
@@ -310,7 +332,9 @@ class BaseTrainer:
                 self.optimizer.init, out_shardings=self.state_shardings.opt_state
             )(base_params)
             self.train_state = TrainState(
-                params=base_params, opt_state=opt_state, step=jnp.int32(0)
+                params=base_params, opt_state=opt_state,
+                # committed: see the LoRA branch note on jit signature drift
+                step=jax.device_put(jnp.int32(0), self.state_shardings.step),
             )
             if self.args.data.channel_list:
                 from veomni_tpu.train.channel_loss import make_channel_loss_fn
@@ -328,11 +352,26 @@ class BaseTrainer:
             k: NamedSharding(ps.mesh, spec)
             for k, spec in self._batch_sharding_map().items()
         }
+        grad_mask = None
+        if self.args.model.freeze_modules:
+            import re
+
+            from veomni_tpu.parallel.parallel_plan import param_path_str
+
+            patterns = tuple(self.args.model.freeze_modules)
+            grad_mask = jax.tree_util.tree_map_with_path(
+                lambda p, leaf: (
+                    0.0 if any(re.search(pt, param_path_str(p)) for pt in patterns)
+                    else 1.0
+                ),
+                self.abstract_state.params,
+            )
         self.train_step = build_train_step(
             loss_fn, self.optimizer, ps,
             state_shardings=self.state_shardings,
             batch_shardings=self.batch_shardings,
             max_grad_norm=t.max_grad_norm,
+            grad_mask=grad_mask,
         )
         self.meter = EnvironMeter(
             flops_counter=FlopsCounter.from_config(model.config),

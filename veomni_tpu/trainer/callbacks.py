@@ -68,12 +68,66 @@ class EnvironMeterCallback(Callback):
         batch = trainer.current_batch
         if batch is None:
             return
+        extra = self._tower_flops(trainer, batch)
         if "labels" in batch:
             labels = batch["labels"]
-            self.meter.add(int((labels != -100).sum()), seq_len=labels.shape[-1])
-        else:  # diffusion batches: count samples
+            self.meter.add(
+                int((labels != -100).sum()), seq_len=labels.shape[-1],
+                extra_flops=extra,
+            )
+        else:  # diffusion batches: latent tokens through the DiT
             first = next(iter(batch.values()))
-            self.meter.add(int(np.prod(first.shape[:2])), seq_len=1)
+            n_samples = int(np.prod(first.shape[:2]))
+            self.meter.add(n_samples, seq_len=1, extra_flops=extra)
+
+    @staticmethod
+    def _tower_flops(trainer, batch) -> float:
+        """Promised fwd FLOPs outside the LM formula (reference
+        count_flops.py per-arch ViT/DiT terms): ViT patches for VLM batches,
+        DiT blocks for diffusion batches."""
+        cfg = getattr(trainer.model, "config", None)
+        vision = getattr(cfg, "vision", None)
+        extra = 0.0
+        if vision is not None:
+            from veomni_tpu.utils.count_flops import vit_flops_fwd
+
+            patches = 0
+            if "pixel_patches" in batch:
+                # pixel_patches [.., n_media, patches_per_media, patch_dim];
+                # image_mask [.., n_media] counts real media
+                per_media = batch["pixel_patches"].shape[-2]
+                mask = batch.get("image_mask")
+                n_media = (
+                    int(np.asarray(mask).sum())
+                    if mask is not None
+                    else int(np.prod(batch["pixel_patches"].shape[:-2]))
+                )
+                patches = n_media * per_media
+            elif "pixel_values" in batch:
+                # qwen25 packed stream is padded to a static budget; count
+                # real patches via the merged-token mask (merge_unit patches
+                # per merged token), matching the omni branch's semantics
+                mmask = batch.get("vis_merged_mask")
+                if mmask is not None:
+                    merge_unit = getattr(vision, "merge_unit", 4)
+                    patches = int(np.asarray(mmask).sum()) * merge_unit
+                else:
+                    patches = int(np.prod(batch["pixel_values"].shape[:-1]))
+            if patches:
+                # window_size is in pixels; the attention span is patches
+                window = getattr(vision, "window_size", 0)
+                psize = getattr(vision, "patch_size", 14)
+                extra += vit_flops_fwd(
+                    vision, patches,
+                    window_seq=(window // psize) ** 2 if window else None,
+                )
+        if "latents" in batch and cfg is not None and vision is None:
+            from veomni_tpu.utils.count_flops import dit_flops_fwd
+
+            lat = batch["latents"]
+            n_tokens = int(np.prod(lat.shape[1:-1])) if lat.ndim > 2 else lat.shape[1]
+            extra += dit_flops_fwd(cfg, n_tokens) * lat.shape[0]
+        return extra
 
     def on_step_end(self, trainer, state):
         state.metrics.update(self.meter.step())

@@ -1,8 +1,19 @@
 """Analytic per-step FLOPs counter.
 
 Reference: ``veomni/utils/count_flops.py:60-988`` (``VeomniFlopsCounter``) —
-per-architecture formulas used by the MFU meter. We implement the dense
-transformer, GQA attention, MoE, and ViT terms from model config fields.
+per-architecture formulas used by the MFU meter. Implemented terms:
+
+* dense GQA transformer (llama/qwen lineage), incl. partial-rotary and the
+  qwen3_next gated-attention q_proj doubling;
+* MLA (deepseek q/kv low-rank compression — NOT approximated as plain
+  ``nh * head_dim`` projections);
+* MoE (top-k routed + shared experts + router);
+* qwen3_next GatedDeltaNet linear-attention layers (chunkwise cost model);
+* ViT towers (per-patch, window or full attention) and DiT blocks via the
+  dedicated helpers, fed to the meter as ``extra_flops``.
+
+Counts follow the standard factorization: matmul fwd = 2*M*N*K, backward =
+2x forward (dgrad + wgrad), so total = 3x forward.
 """
 
 from __future__ import annotations
@@ -13,12 +24,7 @@ from typing import Optional
 
 @dataclass
 class FlopsCounter:
-    """Computes promised forward+backward FLOPs for one batch.
-
-    Counts follow the standard 6*N*T approximation refined per-term:
-      - matmul fwd = 2*M*N*K; bwd = 2x fwd (dgrad+wgrad) => total 6*M*N*K
-      - attention scores/context scale with seq_len^2 (causal halves it)
-    """
+    """Promised forward FLOPs per token for the language model."""
 
     hidden_size: int
     intermediate_size: int
@@ -32,28 +38,99 @@ class FlopsCounter:
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
     num_shared_experts: int = 0
-    # ViT tower (VLM); counted per image token externally
+    shared_expert_intermediate_size: int = 0
     tie_word_embeddings: bool = False
+    # MLA (deepseek); kv_lora_rank > 0 switches the attention-projection term
+    q_lora_rank: int = 0
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    # qwen3_next hybrid: every `full_attention_interval`-th layer is full
+    # attention, the rest are GatedDeltaNet linear attention
+    linear_num_value_heads: int = 0
+    linear_num_key_heads: int = 0
+    linear_key_head_dim: int = 0
+    linear_value_head_dim: int = 0
+    linear_conv_kernel_dim: int = 4
+    full_attention_interval: int = 0
+    attn_output_gate: bool = False
+    delta_chunk: int = 64
 
-    def flops_per_token_fwd(self, seq_len: int) -> float:
+    # ------------------------------------------------------------- per-term
+    def _attn_proj_flops(self) -> float:
+        """q/k/v/o projections per token (fwd)."""
         h = self.hidden_size
+        if self.kv_lora_rank:
+            qk = self.qk_nope_head_dim + self.qk_rope_head_dim
+            nh, vd = self.num_heads, self.v_head_dim
+            q = (
+                2 * h * self.q_lora_rank + 2 * self.q_lora_rank * nh * qk
+                if self.q_lora_rank
+                else 2 * h * nh * qk
+            )
+            kv_a = 2 * h * (self.kv_lora_rank + self.qk_rope_head_dim)
+            kv_b = 2 * self.kv_lora_rank * nh * (self.qk_nope_head_dim + vd)
+            o = 2 * nh * vd * h
+            return q + kv_a + kv_b + o
         q_dim = self.num_heads * self.head_dim
         kv_dim = self.num_kv_heads * self.head_dim
-        # attention projections (q,k,v,o)
-        proj = 2 * h * (q_dim + 2 * kv_dim + q_dim)
-        # scores + context (causal => T/2 effective)
-        attn = 2 * 2 * q_dim * (seq_len / 2)
-        # MLP
+        q_mult = 2 if self.attn_output_gate else 1
+        return 2 * h * (q_mult * q_dim + 2 * kv_dim + q_dim)
+
+    def _attn_score_flops(self, seq_len: int) -> float:
+        """scores + context per token (fwd); causal halves the window."""
+        if self.kv_lora_rank:
+            qk = self.qk_nope_head_dim + self.qk_rope_head_dim
+            per_head = 2 * (qk + self.v_head_dim) * (seq_len / 2)
+            return self.num_heads * per_head
+        return 2 * 2 * self.num_heads * self.head_dim * (seq_len / 2)
+
+    def _mlp_flops(self) -> float:
+        h = self.hidden_size
         if self.num_experts and self.num_experts_per_tok:
             inter = self.moe_intermediate_size or self.intermediate_size
             mlp = 2 * 3 * h * inter * self.num_experts_per_tok
-            mlp += 2 * 3 * h * inter * self.num_shared_experts
+            shared = self.shared_expert_intermediate_size or (
+                inter * self.num_shared_experts
+            )
+            if shared:
+                mlp += 2 * 3 * h * shared + (2 * h if self.shared_expert_intermediate_size else 0)
             mlp += 2 * h * self.num_experts  # router
+            return mlp
+        return 2 * 3 * h * self.intermediate_size
+
+    def _linear_attn_flops(self) -> float:
+        """GatedDeltaNet per-token fwd cost: projections + conv + chunkwise
+        delta rule (in-chunk attn/UT-transform + state update)."""
+        h = self.hidden_size
+        nk, nv = self.linear_num_key_heads, self.linear_num_value_heads
+        dk, dv = self.linear_key_head_dim, self.linear_value_head_dim
+        key_dim, value_dim = nk * dk, nv * dv
+        conv_dim = 2 * key_dim + value_dim
+        proj = 2 * h * (2 * key_dim + 2 * value_dim)      # in_proj_qkvz
+        proj += 2 * h * 2 * nv                             # in_proj_ba
+        proj += 2 * value_dim * h                          # out_proj
+        conv = 2 * conv_dim * self.linear_conv_kernel_dim
+        c = self.delta_chunk
+        # per token, per v-head: in-chunk score/attn matrices ~ 4*C*dk +
+        # 2*C*dv (kk^T, T-solve amortized, attn@v), state ops ~ 6*dk*dv
+        delta = nv * (4 * c * dk + 2 * c * dv + 6 * dk * dv)
+        return proj + conv + delta
+
+    # ------------------------------------------------------------ aggregate
+    def flops_per_token_fwd(self, seq_len: int) -> float:
+        mlp = self._mlp_flops()
+        full_layer = self._attn_proj_flops() + self._attn_score_flops(seq_len) + mlp
+        if self.full_attention_interval and self.linear_num_value_heads:
+            n_full = self.num_layers // self.full_attention_interval
+            n_lin = self.num_layers - n_full
+            lin_layer = self._linear_attn_flops() + mlp
+            body = n_full * full_layer + n_lin * lin_layer
         else:
-            mlp = 2 * 3 * h * self.intermediate_size
-        per_layer = proj + attn + mlp
-        lm_head = 2 * h * self.vocab_size
-        return self.num_layers * per_layer + lm_head
+            body = self.num_layers * full_layer
+        lm_head = 2 * self.hidden_size * self.vocab_size
+        return body + lm_head
 
     def batch_flops(self, total_tokens: int, seq_len: int, include_backward: bool = True) -> float:
         fwd = total_tokens * self.flops_per_token_fwd(seq_len)
@@ -61,7 +138,11 @@ class FlopsCounter:
 
     @classmethod
     def from_config(cls, cfg) -> "FlopsCounter":
-        """Build from any model config exposing llama-family field names."""
+        """Build from any model config exposing llama-family field names.
+        Composite (VLM/omni) configs contribute their LM via ``cfg.text``;
+        tower FLOPs are fed separately (``vit_flops_fwd``)."""
+        if hasattr(cfg, "text") and hasattr(cfg.text, "hidden_size"):
+            cfg = cfg.text
         g = lambda n, d=0: getattr(cfg, n, d)
         head_dim = g("head_dim") or (g("hidden_size") // max(1, g("num_attention_heads", 1)))
         return cls(
@@ -76,5 +157,56 @@ class FlopsCounter:
             num_experts_per_tok=g("num_experts_per_tok", 0),
             moe_intermediate_size=g("moe_intermediate_size", 0),
             num_shared_experts=g("n_shared_experts", 0),
+            shared_expert_intermediate_size=g("shared_expert_intermediate_size", 0),
             tie_word_embeddings=g("tie_word_embeddings", False),
+            q_lora_rank=g("q_lora_rank", 0),
+            kv_lora_rank=g("kv_lora_rank", 0),
+            qk_nope_head_dim=g("qk_nope_head_dim", 0),
+            qk_rope_head_dim=g("qk_rope_head_dim", 0),
+            v_head_dim=g("v_head_dim", 0),
+            linear_num_value_heads=g("linear_num_value_heads", 0),
+            linear_num_key_heads=g("linear_num_key_heads", 0),
+            linear_key_head_dim=g("linear_key_head_dim", 0),
+            linear_value_head_dim=g("linear_value_head_dim", 0),
+            linear_conv_kernel_dim=g("linear_conv_kernel_dim", 4),
+            full_attention_interval=(
+                g("full_attention_interval", 0) if g("linear_num_value_heads", 0) else 0
+            ),
+            attn_output_gate=g("attn_output_gate", False),
         )
+
+
+def vit_flops_fwd(vision_cfg, n_patches: int, window_seq: Optional[int] = None) -> float:
+    """Forward FLOPs of a ViT tower on ``n_patches`` patches (reference
+    ``count_flops.py`` ViT terms for the qwen-vl families).
+
+    window_seq: attention span per patch (window attention); defaults to
+    n_patches (full attention among all patches — an upper bound when
+    multiple images are packed)."""
+    g = lambda n, d=0: getattr(vision_cfg, n, d)
+    h = g("hidden_size")
+    inter = g("intermediate_size") or 4 * h
+    layers = g("depth", 0) or g("num_hidden_layers", 0)
+    span = window_seq if window_seq else n_patches
+    per_patch = 2 * h * 4 * h                 # qkv + o projections
+    per_patch += 2 * 2 * h * span             # scores + context
+    per_patch += 2 * 3 * h * inter if g("gated_mlp", True) else 2 * 2 * h * inter
+    body = layers * per_patch * n_patches
+    # patch embed + merger
+    in_dim = g("in_channels", 3) * g("temporal_patch_size", 1) * g("patch_size", 14) ** 2
+    embed = 2 * in_dim * h * n_patches
+    merge = g("merge_unit", 4)
+    out_h = g("out_hidden_size", h)
+    merger = 2 * (h * merge) * out_h * (n_patches // max(merge, 1))
+    return body + embed + merger
+
+
+def dit_flops_fwd(cfg, n_tokens: int) -> float:
+    """Forward FLOPs of a DiT on ``n_tokens`` latent tokens per sample."""
+    g = lambda n, d=0: getattr(cfg, n, d)
+    h = g("hidden_size")
+    inter = g("intermediate_size") or 4 * h
+    layers = g("num_hidden_layers", 0) or g("depth", 0)
+    per_tok = 2 * h * 4 * h + 2 * 2 * h * n_tokens + 2 * 2 * h * inter
+    per_tok += 2 * h * 6 * h  # adaLN modulation
+    return layers * per_tok * n_tokens

@@ -35,11 +35,15 @@ class EnvironMeter:
     consumed_tokens: int = 0
     _step_tokens: int = 0
     _step_seq_len: int = 0
+    _step_extra_flops: float = 0.0
     _t_start: float = field(default_factory=time.perf_counter)
 
-    def add(self, ntokens: int, seq_len: int) -> None:
+    def add(self, ntokens: int, seq_len: int, extra_flops: float = 0.0) -> None:
+        """extra_flops: promised FORWARD flops outside the LM formula (ViT /
+        audio towers, DiT) for this batch; backward-scaled with the rest."""
         self._step_tokens += int(ntokens)
         self._step_seq_len = max(self._step_seq_len, int(seq_len))
+        self._step_extra_flops += float(extra_flops)
 
     def step(self) -> Dict[str, float]:
         now = time.perf_counter()
@@ -52,13 +56,15 @@ class EnvironMeter:
             "step_time_s": dt,
             "consumed_tokens": float(self.consumed_tokens),
         }
-        if self.flops_counter is not None and tokens:
+        if self.flops_counter is not None and (tokens or self._step_extra_flops):
             achieved = self.flops_counter.batch_flops(tokens, self._step_seq_len or tokens)
+            achieved += 3.0 * self._step_extra_flops
             peak = get_device_peak_flops() * max(1, self.world_size)
             metrics["tflops"] = achieved / dt / 1e12
             metrics["mfu"] = 100.0 * achieved / dt / peak
         self._step_tokens = 0
         self._step_seq_len = 0
+        self._step_extra_flops = 0.0
         self._t_start = time.perf_counter()
         return metrics
 
