@@ -97,3 +97,25 @@ def test_hf_export_roundtrip(tmp_path):
     l2, m2 = jax.jit(model2.loss_fn)(params2, batch)
     np.testing.assert_allclose(
         float(l1 / m1["ntokens"]), float(l2 / m2["ntokens"]), rtol=1e-6)
+
+    # streamed shard-aligned load (EP-sliced expert reads) == plain load
+    from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+
+    destroy_parallel_state()
+    try:
+        ps = init_parallel_state(ep_size=2, dp_shard_size=4)
+        with use_parallel_state(ps):
+            shardings = model2.get_parallel_plan().resolve(
+                jax.eval_shape(lambda: params2), ps
+            )
+            sharded = model2.family.hf_to_params(out, model2.config, shardings)
+        for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params2),
+            jax.tree_util.tree_leaves_with_path(sharded),
+        ):
+            assert pa == pb
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=str(pa))
+    finally:
+        destroy_parallel_state()

@@ -142,6 +142,24 @@ def _register_vlm_families():
         ),
     )
 
+    # qwen2_5_omni thinker: real audio tower + qwen2_5_vl vision/LM
+    from veomni_tpu.models import qwen2_5_omni as q25o
+
+    MODEL_REGISTRY.register(
+        "qwen2_5_omni",
+        ModelFamily(
+            model_type="qwen2_5_omni",
+            config_cls=q25o.Qwen25OmniConfig,
+            init_params=q25o.init_params,
+            abstract_params=q25o.abstract_params,
+            loss_fn=q25o.loss_fn,
+            forward_logits=None,
+            hf_to_params=q25o.hf_to_params,
+            save_hf_checkpoint=q25o.save_hf_checkpoint,
+            parallel_plan_fn=q25o.parallel_plan,
+        ),
+    )
+
 
 _register_vlm_families()
 
@@ -243,6 +261,10 @@ def build_foundation_model(
             from veomni_tpu.models.qwen2_5_vl import config_from_hf
 
             config = config_from_hf(hf_dict, **config_overrides)
+        elif hf_dict.get("model_type") in ("qwen2_5_omni", "qwen2_5_omni_thinker"):
+            from veomni_tpu.models.qwen2_5_omni import config_from_hf as omni_from_hf
+
+            config = omni_from_hf(hf_dict, **config_overrides)
         else:
             config = TransformerConfig.from_hf_config(hf_dict, **config_overrides)
     if config.model_type not in MODEL_REGISTRY:

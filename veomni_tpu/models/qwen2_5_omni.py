@@ -1,0 +1,577 @@
+"""Qwen2.5-Omni *thinker*: audio encoder + vision tower + LM with TMRoPE.
+
+Reference capability: ``veomni/models/transformers/qwen2_5_omni/`` (5,004
+LoC generated modeling). The thinker is the trainable core (audio + vision
+encoders feeding a qwen2.5 LM); the talker/token2wav generation stack is
+out of training scope (as in the reference recipes).
+
+Composition here: the vision tower, mrope, window metadata, and LM forward
+are the qwen2_5_vl implementations (``models/qwen2_5_vl.py``) — the HF omni
+vision config is identical — plus the omni audio encoder:
+
+* whisper-style conv frontend (k3 conv, then k3/stride-2), GELU, applied
+  **per window chunk** of ``2 * n_window`` mel frames (zero-padded chunk
+  edges, matching HF's chunked conv);
+* sinusoidal positions restart per chunk; self-attention is block-diagonal
+  over chunks — expressed with segment ids on our attention facade (no
+  cu_seqlens mask materialization);
+* pair-average pooling over each audio's full post-conv sequence, LayerNorm,
+  projection to the LM width.
+
+Static-slot contract (TPU): every audio occupies ``audio.max_frames`` mel
+frames (pad/truncate in the data pipeline), so shapes are jit-stable; the
+HF parity oracle feeds full-length features so both sides see the same math.
+
+Audio tokens take sequential 1-D positions in the rope walk (HF
+``get_rope_index`` with use_audio_in_video=False assigns text-like positions
+to audio runs), so qwen2_5_vl's ``mrope_position_ids`` applies unchanged
+with audio placeholders treated as text.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.models import qwen2_5_vl as q25
+from veomni_tpu.models import transformer
+from veomni_tpu.models.config import TransformerConfig
+
+Params = Dict[str, Any]
+
+
+@dataclass
+class OmniAudioEncoderConfig:
+    """HF ``Qwen2_5OmniAudioEncoderConfig`` surface."""
+
+    num_mel_bins: int = 128
+    d_model: int = 1280
+    encoder_layers: int = 32
+    encoder_attention_heads: int = 20
+    encoder_ffn_dim: int = 5120
+    n_window: int = 100
+    max_source_positions: int = 1500
+    output_dim: int = 3584
+    initializer_range: float = 0.02
+    # static slot length in mel frames; must be a multiple of 2*n_window
+    max_frames: int = 400
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.encoder_attention_heads
+
+    @property
+    def chunks(self) -> int:
+        return self.max_frames // (2 * self.n_window)
+
+    @property
+    def tokens_per_audio(self) -> int:
+        # conv2 stride-2 then pair pooling: T/4
+        return self.max_frames // 4
+
+    def __post_init__(self):
+        if self.max_frames % (2 * self.n_window):
+            raise ValueError(
+                f"audio max_frames ({self.max_frames}) must be a multiple of "
+                f"2*n_window ({2 * self.n_window})"
+            )
+
+
+@dataclass
+class Qwen25OmniConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    vision: Optional[q25.Qwen25VisionConfig] = None
+    audio: Optional[OmniAudioEncoderConfig] = None
+    image_token_id: int = 151655
+    video_token_id: int = 151656
+    vision_start_token_id: int = 151652
+    audio_token_id: int = 151646
+    audio_start_token_id: int = 151647
+    audio_end_token_id: int = 151648
+    position_id_per_seconds: float = 25.0
+    freeze_vision: bool = False
+    freeze_audio: bool = False
+    model_type: str = "qwen2_5_omni"
+
+    def __post_init__(self):
+        if isinstance(self.text, dict):
+            self.text = TransformerConfig(**self.text)
+        if isinstance(self.vision, dict):
+            self.vision = q25.Qwen25VisionConfig(**self.vision)
+        if isinstance(self.audio, dict):
+            self.audio = OmniAudioEncoderConfig(**self.audio)
+
+    def __getattr__(self, name):  # FlopsCounter / trainer surface
+        return getattr(object.__getattribute__(self, "text"), name)
+
+
+# ---------------------------------------------------------------------------
+# Audio encoder
+# ---------------------------------------------------------------------------
+def init_audio_params(rng: jax.Array, cfg: OmniAudioEncoderConfig, dtype=jnp.float32):
+    d, mel, ffn, L = cfg.d_model, cfg.num_mel_bins, cfg.encoder_ffn_dim, cfg.encoder_layers
+    s = cfg.initializer_range
+    keys = iter(jax.random.split(rng, 16))
+
+    def init(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
+
+    return {
+        "conv1_w": init(next(keys), (3, mel, d)),
+        "conv1_b": jnp.zeros((d,), dtype),
+        "conv2_w": init(next(keys), (3, d, d)),
+        "conv2_b": jnp.zeros((d,), dtype),
+        "layers": {
+            "attn_ln_w": jnp.ones((L, d), dtype), "attn_ln_b": jnp.zeros((L, d), dtype),
+            "q_w": init(next(keys), (L, d, d)), "q_b": jnp.zeros((L, d), dtype),
+            "k_w": init(next(keys), (L, d, d)),
+            "v_w": init(next(keys), (L, d, d)), "v_b": jnp.zeros((L, d), dtype),
+            "o_w": init(next(keys), (L, d, d)), "o_b": jnp.zeros((L, d), dtype),
+            "final_ln_w": jnp.ones((L, d), dtype), "final_ln_b": jnp.zeros((L, d), dtype),
+            "fc1_w": init(next(keys), (L, d, ffn)), "fc1_b": jnp.zeros((L, ffn), dtype),
+            "fc2_w": init(next(keys), (L, ffn, d)), "fc2_b": jnp.zeros((L, d), dtype),
+        },
+        "ln_post_w": jnp.ones((d,), dtype), "ln_post_b": jnp.zeros((d,), dtype),
+        "proj_w": init(next(keys), (d, cfg.output_dim)),
+        "proj_b": jnp.zeros((cfg.output_dim,), dtype),
+    }
+
+
+def _layer_norm(x, w, b, eps=1e-5):
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return ((x - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(dt)
+
+
+def _sinusoid_table(length: int, channels: int) -> np.ndarray:
+    """Whisper SinusoidsPositionEmbedding: log-spaced timescales."""
+    log_timescale = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(channels // 2))
+    scaled = np.arange(length)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1).astype(np.float32)
+
+
+def _k3_conv(x, w, b, stride: int = 1):
+    """k=3 conv with padding=1 as shifted matmuls (exact on every backend,
+    unlike XLA:CPU's oneDNN conv path): x [N, T, Cin], w [3, Cin, Cout].
+    Output position j reads padded positions stride*j + k."""
+    n, t, _ = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (0, 0)))
+    t_out = t if stride == 1 else (t + 1) // 2
+    idx = stride * jnp.arange(t_out)
+    return sum(jnp.dot(xp[:, idx + k, :], w[k]) for k in range(3)) + b
+
+
+def audio_encoder_forward(params, cfg: OmniAudioEncoderConfig, features, dtype=jnp.bfloat16):
+    """features [N, max_frames, num_mel_bins] -> [N, tokens_per_audio, output_dim].
+
+    Runs under a no-SP scoped ParallelState like every tower (per-module
+    heterogeneous SP): audio slots are replicated along the sequence axes."""
+    from veomni_tpu import ops
+    from veomni_tpu.parallel.parallel_state import (
+        get_parallel_state_or_none, use_parallel_state,
+    )
+
+    ps = get_parallel_state_or_none()
+    if ps is not None and ps.sp_enabled:
+        with use_parallel_state(ps.without_sp()):
+            return audio_encoder_forward(params, cfg, features, dtype=dtype)
+    p = jax.tree.map(lambda t: t.astype(dtype), params)
+    n, t_mel, mel = features.shape
+    w2 = 2 * cfg.n_window
+    chunks = t_mel // w2
+    x = features.astype(dtype).reshape(n * chunks, w2, mel)
+    x = jax.nn.gelu(_k3_conv(x, p["conv1_w"], p["conv1_b"]))
+    x = jax.nn.gelu(_k3_conv(x, p["conv2_w"], p["conv2_b"], stride=2))
+    w_out = x.shape[1]  # n_window
+    pos = jnp.asarray(_sinusoid_table(cfg.max_source_positions, cfg.d_model))
+    x = x + pos[None, :w_out].astype(dtype)
+    # [N, chunks*W, d] with block-diagonal attention over chunks
+    x = x.reshape(n, chunks * w_out, cfg.d_model)
+    seg = jnp.broadcast_to(
+        jnp.repeat(jnp.arange(chunks, dtype=jnp.int32), w_out)[None], (n, chunks * w_out)
+    )
+    hd, nh = cfg.head_dim, cfg.encoder_attention_heads
+
+    def layer(x, lp):
+        y = _layer_norm(x, lp["attn_ln_w"], lp["attn_ln_b"])
+        q = (jnp.dot(y, lp["q_w"]) + lp["q_b"]).reshape(n, -1, nh, hd)
+        k = jnp.dot(y, lp["k_w"]).reshape(n, -1, nh, hd)
+        v = (jnp.dot(y, lp["v_w"]) + lp["v_b"]).reshape(n, -1, nh, hd)
+        attn = ops.attention(q, k, v, segment_ids=seg, causal=False)
+        x = x + jnp.dot(attn.reshape(n, -1, cfg.d_model), lp["o_w"]) + lp["o_b"]
+        y = _layer_norm(x, lp["final_ln_w"], lp["final_ln_b"])
+        y = jax.nn.gelu(jnp.dot(y, lp["fc1_w"]) + lp["fc1_b"])
+        return x + jnp.dot(y, lp["fc2_w"]) + lp["fc2_b"], None
+
+    x, _ = jax.lax.scan(lambda c, lp: layer(c, lp), x, p["layers"])
+    # pair-average pooling over the audio's full sequence, then LN + proj
+    x = x.reshape(n, (chunks * w_out) // 2, 2, cfg.d_model).mean(2)
+    x = _layer_norm(x, p["ln_post_w"], p["ln_post_b"])
+    return jnp.dot(x, p["proj_w"]) + p["proj_b"]
+
+
+# ---------------------------------------------------------------------------
+# Thinker params / forward
+# ---------------------------------------------------------------------------
+def init_params(rng: jax.Array, cfg: Qwen25OmniConfig) -> Params:
+    r1, r2, r3 = jax.random.split(rng, 3)
+    params: Params = {
+        "language_model": transformer.init_params(r1, cfg.text),
+    }
+    if cfg.vision is not None:
+        params["vision_tower"] = q25.init_vision_params(
+            r2, cfg.vision, cfg.text.param_dtype
+        )
+    if cfg.audio is not None:
+        params["audio_tower"] = init_audio_params(r3, cfg.audio, cfg.text.param_dtype)
+    return params
+
+
+def abstract_params(cfg: Qwen25OmniConfig) -> Params:
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+def _merge_audio_features(embeds, input_ids, feats, audio_mask, audio_token_id):
+    """Scatter [N_audio, tokens_per_audio, H] into audio placeholder runs —
+    merge_vision_features with the per-audio mask expanded per-token."""
+    n, tpa, h = feats.shape
+    return q25.merge_vision_features(
+        embeds, input_ids, feats.reshape(n * tpa, h),
+        jnp.repeat(audio_mask.reshape(-1), tpa),
+        audio_token_id, audio_token_id,
+    )
+
+
+def loss_fn(params, cfg: Qwen25OmniConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """qwen2_5_vl batch contract (mrope position_ids [B,3,S], packed window-
+    ordered pixel stream) plus ``audio_features [N_a, max_frames, mels]`` and
+    ``audio_mask [N_a]``."""
+    tcfg = cfg.text
+    lm = params["language_model"]
+    embeds = lm["embed_tokens"].astype(tcfg.dtype)[batch["input_ids"]]
+
+    if cfg.vision is not None and "pixel_values" in batch:
+        vp = params["vision_tower"]
+        if cfg.freeze_vision:
+            vp = jax.lax.stop_gradient(vp)
+        feats = q25.vision_forward(
+            vp, cfg.vision, batch["pixel_values"], batch["vis_pos_hw"],
+            batch["vis_seg_window"], batch["vis_seg_full"], batch["vis_reverse"],
+            dtype=tcfg.dtype,
+        )
+        embeds = q25.merge_vision_features(
+            embeds, batch["input_ids"], feats, batch["vis_merged_mask"],
+            cfg.image_token_id, cfg.video_token_id,
+        )
+    if cfg.audio is not None and "audio_features" in batch:
+        ap = params["audio_tower"]
+        if cfg.freeze_audio:
+            ap = jax.lax.stop_gradient(ap)
+        afeats = audio_encoder_forward(
+            ap, cfg.audio, batch["audio_features"], dtype=tcfg.dtype
+        )
+        embeds = _merge_audio_features(
+            embeds, batch["input_ids"], afeats,
+            batch.get("audio_mask", jnp.ones(afeats.shape[0], bool)),
+            cfg.audio_token_id,
+        )
+
+    hidden, moe_aux, moe_dropped = transformer.forward_hidden(
+        lm, tcfg, batch["input_ids"], batch["position_ids"],
+        batch.get("segment_ids"), inputs_embeds=embeds,
+    )
+    return transformer.head_loss(
+        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF io
+# ---------------------------------------------------------------------------
+_AUDIO_LAYER_MAP = [
+    ("attn_ln_w", "self_attn_layer_norm.weight", False),
+    ("attn_ln_b", "self_attn_layer_norm.bias", False),
+    ("q_w", "self_attn.q_proj.weight", True),
+    ("q_b", "self_attn.q_proj.bias", False),
+    ("k_w", "self_attn.k_proj.weight", True),
+    ("v_w", "self_attn.v_proj.weight", True),
+    ("v_b", "self_attn.v_proj.bias", False),
+    ("o_w", "self_attn.out_proj.weight", True),
+    ("o_b", "self_attn.out_proj.bias", False),
+    ("final_ln_w", "final_layer_norm.weight", False),
+    ("final_ln_b", "final_layer_norm.bias", False),
+    ("fc1_w", "fc1.weight", True),
+    ("fc1_b", "fc1.bias", False),
+    ("fc2_w", "fc2.weight", True),
+    ("fc2_b", "fc2.bias", False),
+]
+
+
+def hf_to_params(model_dir: str, cfg: Qwen25OmniConfig, target_shardings=None):
+    """Load an HF *thinker* checkpoint (``audio_tower.*`` / ``visual.*`` /
+    ``model.*`` / ``lm_head``). Full-omni checkpoints (``thinker.`` prefix +
+    talker/token2wav stacks) should be trimmed to the thinker first
+    (scripts/trim_checkpoint.py)."""
+    from veomni_tpu.models.hf_io import LazyHFTensors
+
+    src = LazyHFTensors(model_dir)
+    if any(k.startswith("thinker.") for k in src.keys()):
+        raise NotImplementedError(
+            "full-omni checkpoint (thinker.* prefix): extract the thinker "
+            "subtree first (scripts/trim_checkpoint.py)"
+        )
+
+    def get(name):
+        return np.asarray(src.read(name))
+
+    pd = cfg.text.param_dtype
+    params: Params = {}
+    from veomni_tpu.models import hf_io
+
+    params["language_model"] = hf_io.hf_to_params(
+        model_dir, cfg.text,
+        target_shardings=target_shardings["language_model"]
+        if target_shardings else None,
+        key_map=lambda k: None if k.split(".")[0] in (
+            "visual", "audio_tower") else k,
+    )
+    if cfg.vision is not None:
+        # omni's vision tower == qwen2_5_vl's, but with SPLIT attn.q/k/v
+        # tensors; fuse them into our qkv layout
+        vcfg = cfg.vision
+        blocks: Params = {}
+        split_map = [
+            ("norm1", "norm1.weight", False),
+            ("norm2", "norm2.weight", False),
+            ("proj_w", "attn.proj.weight", True),
+            ("proj_b", "attn.proj.bias", False),
+            ("gate_w", "mlp.gate_proj.weight", True),
+            ("gate_b", "mlp.gate_proj.bias", False),
+            ("up_w", "mlp.up_proj.weight", True),
+            ("up_b", "mlp.up_proj.bias", False),
+            ("down_w", "mlp.down_proj.weight", True),
+            ("down_b", "mlp.down_proj.bias", False),
+        ]
+        for ours, suffix, tr in split_map:
+            t = np.stack([
+                get(f"visual.blocks.{i}.{suffix}") for i in range(vcfg.depth)
+            ])
+            blocks[ours] = jnp.asarray(t.transpose(0, 2, 1) if tr else t, pd)
+        qkv_w = np.stack([
+            np.concatenate([
+                get(f"visual.blocks.{i}.attn.{n}.weight") for n in ("q", "k", "v")
+            ], axis=0).T
+            for i in range(vcfg.depth)
+        ])
+        qkv_b = np.stack([
+            np.concatenate([
+                get(f"visual.blocks.{i}.attn.{n}.bias") for n in ("q", "k", "v")
+            ])
+            for i in range(vcfg.depth)
+        ])
+        blocks["qkv_w"] = jnp.asarray(qkv_w, pd)
+        blocks["qkv_b"] = jnp.asarray(qkv_b, pd)
+        params["vision_tower"] = {
+            "patch_embed": jnp.asarray(
+                get("visual.patch_embed.proj.weight").reshape(vcfg.hidden_size, -1).T,
+                pd,
+            ),
+            "blocks": blocks,
+            "merger": {
+                "ln_q": jnp.asarray(get("visual.merger.ln_q.weight"), pd),
+                "fc1_w": jnp.asarray(get("visual.merger.mlp.0.weight").T, pd),
+                "fc1_b": jnp.asarray(get("visual.merger.mlp.0.bias"), pd),
+                "fc2_w": jnp.asarray(get("visual.merger.mlp.2.weight").T, pd),
+                "fc2_b": jnp.asarray(get("visual.merger.mlp.2.bias"), pd),
+            },
+        }
+    if cfg.audio is not None:
+        at: Params = {
+            # HF conv1d weight [out, in, k] -> [k, in, out]
+            "conv1_w": jnp.asarray(
+                get("audio_tower.conv1.weight").transpose(2, 1, 0), pd),
+            "conv1_b": jnp.asarray(get("audio_tower.conv1.bias"), pd),
+            "conv2_w": jnp.asarray(
+                get("audio_tower.conv2.weight").transpose(2, 1, 0), pd),
+            "conv2_b": jnp.asarray(get("audio_tower.conv2.bias"), pd),
+            "ln_post_w": jnp.asarray(get("audio_tower.ln_post.weight"), pd),
+            "ln_post_b": jnp.asarray(get("audio_tower.ln_post.bias"), pd),
+            "proj_w": jnp.asarray(get("audio_tower.proj.weight").T, pd),
+            "proj_b": jnp.asarray(get("audio_tower.proj.bias"), pd),
+        }
+        layers: Params = {}
+        for ours, suffix, tr in _AUDIO_LAYER_MAP:
+            t = np.stack([
+                get(f"audio_tower.layers.{i}.{suffix}")
+                for i in range(cfg.audio.encoder_layers)
+            ])
+            layers[ours] = jnp.asarray(
+                t.transpose(0, 2, 1) if tr else t, pd
+            )
+        at["layers"] = layers
+        params["audio_tower"] = at
+    if target_shardings is not None:
+        params = jax.tree.map(
+            lambda x, sh: jax.device_put(x, sh), params, target_shardings
+        )
+    return params
+
+
+def save_hf_checkpoint(params, cfg: Qwen25OmniConfig, out_dir: str) -> None:
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    from veomni_tpu.models.hf_io import gather_to_host
+
+    host = gather_to_host(params)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    if cfg.vision is not None:
+        vl_cfg = q25.Qwen25VLConfig(
+            text=cfg.text, vision=cfg.vision,
+            image_token_id=cfg.image_token_id, video_token_id=cfg.video_token_id,
+            vision_start_token_id=cfg.vision_start_token_id,
+        )
+        flat = q25.params_to_hf(
+            {"language_model": host["language_model"],
+             "vision_tower": host["vision_tower"]}, vl_cfg,
+        )
+        # VL layout -> thinker layout (text at model.*, vision at visual.*)
+        flat = {
+            k.replace("model.language_model.", "model.", 1)
+             .replace("model.visual.", "visual.", 1): v
+            for k, v in flat.items()
+        }
+        # thinker vision attn stores split q/k/v, not the VL fused qkv
+        for k in [k for k in list(flat) if ".attn.qkv." in k]:
+            t = flat.pop(k)
+            d = t.shape[0] // 3
+            for j, n in enumerate(("q", "k", "v")):
+                flat[k.replace(".attn.qkv.", f".attn.{n}.")] = t[j * d:(j + 1) * d]
+    else:
+        from veomni_tpu.models import hf_io
+
+        flat = hf_io.params_to_hf(host["language_model"], cfg.text)
+    if cfg.audio is not None:
+        at = host["audio_tower"]
+        flat["audio_tower.conv1.weight"] = np.asarray(at["conv1_w"]).transpose(2, 1, 0)
+        flat["audio_tower.conv1.bias"] = np.asarray(at["conv1_b"])
+        flat["audio_tower.conv2.weight"] = np.asarray(at["conv2_w"]).transpose(2, 1, 0)
+        flat["audio_tower.conv2.bias"] = np.asarray(at["conv2_b"])
+        flat["audio_tower.ln_post.weight"] = np.asarray(at["ln_post_w"])
+        flat["audio_tower.ln_post.bias"] = np.asarray(at["ln_post_b"])
+        flat["audio_tower.proj.weight"] = np.asarray(at["proj_w"]).T
+        flat["audio_tower.proj.bias"] = np.asarray(at["proj_b"])
+        for ours, suffix, tr in _AUDIO_LAYER_MAP:
+            t = np.asarray(at["layers"][ours])
+            for i in range(cfg.audio.encoder_layers):
+                flat[f"audio_tower.layers.{i}.{suffix}"] = (
+                    t[i].T if tr else t[i]
+                )
+    save_file({k: np.ascontiguousarray(v) for k, v in flat.items()},
+              os.path.join(out_dir, "model.safetensors"))
+    hf_cfg: Dict[str, Any] = {
+        "model_type": "qwen2_5_omni_thinker",
+        "text_config": cfg.text.to_hf_config(),
+        "image_token_index": cfg.image_token_id,
+        "video_token_index": cfg.video_token_id,
+        "audio_token_index": cfg.audio_token_id,
+        "vision_start_token_id": cfg.vision_start_token_id,
+        "audio_start_token_id": cfg.audio_start_token_id,
+        "audio_end_token_id": cfg.audio_end_token_id,
+        "position_id_per_seconds": cfg.position_id_per_seconds,
+    }
+    if cfg.vision is not None:
+        v = cfg.vision
+        hf_cfg["vision_config"] = {
+            "depth": v.depth, "hidden_size": v.hidden_size,
+            "intermediate_size": v.intermediate_size, "num_heads": v.num_heads,
+            "in_channels": v.in_channels, "patch_size": v.patch_size,
+            "temporal_patch_size": v.temporal_patch_size,
+            "spatial_merge_size": v.spatial_merge_size,
+            "window_size": v.window_size,
+            "fullatt_block_indexes": list(v.fullatt_block_indexes),
+            "out_hidden_size": v.out_hidden_size,
+        }
+    if cfg.audio is not None:
+        a = cfg.audio
+        hf_cfg["audio_config"] = {
+            "num_mel_bins": a.num_mel_bins, "d_model": a.d_model,
+            "encoder_layers": a.encoder_layers,
+            "encoder_attention_heads": a.encoder_attention_heads,
+            "encoder_ffn_dim": a.encoder_ffn_dim, "n_window": a.n_window,
+            "max_source_positions": a.max_source_positions,
+            "output_dim": a.output_dim,
+        }
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen25OmniConfig:
+    """Build from an HF thinker config dict (or a full omni config with
+    ``thinker_config``)."""
+    if "thinker_config" in hf:
+        hf = hf["thinker_config"]
+    tx = dict(hf.get("text_config", {}))
+    text_over = dict(overrides.pop("text", {}) or {})
+    for k in ("dtype", "param_dtype", "remat", "remat_policy", "chunk_mbs"):
+        if k in overrides:
+            text_over[k] = overrides.pop(k)
+    text = TransformerConfig.from_hf_config(
+        {**tx, "model_type": "qwen2"}, model_type="qwen2", **text_over
+    )
+    if tx.get("rope_scaling"):
+        text.rope_scaling = dict(tx["rope_scaling"])
+    vision = None
+    if hf.get("vision_config"):
+        v = hf["vision_config"]
+        vision = q25.Qwen25VisionConfig(**{
+            k: v[k] for k in (
+                "depth", "hidden_size", "intermediate_size", "num_heads",
+                "in_channels", "patch_size", "temporal_patch_size",
+                "spatial_merge_size", "window_size", "fullatt_block_indexes",
+                "out_hidden_size",
+            ) if k in v
+        })
+        vision.tokens_per_second = float(hf.get("position_id_per_seconds", 25))
+    audio = None
+    if hf.get("audio_config"):
+        a = hf["audio_config"]
+        audio = OmniAudioEncoderConfig(**{
+            **{k: a[k] for k in (
+                "num_mel_bins", "d_model", "encoder_layers",
+                "encoder_attention_heads", "encoder_ffn_dim", "n_window",
+                "max_source_positions", "output_dim",
+            ) if k in a},
+            **({"max_frames": overrides.pop("audio_max_frames")}
+               if "audio_max_frames" in overrides else {}),
+        })
+    return Qwen25OmniConfig(
+        text=text, vision=vision, audio=audio,
+        image_token_id=hf.get("image_token_index", 151655),
+        video_token_id=hf.get("video_token_index", 151656),
+        audio_token_id=hf.get("audio_token_index", 151646),
+        vision_start_token_id=hf.get("vision_start_token_id", 151652),
+        audio_start_token_id=hf.get("audio_start_token_id", 151647),
+        audio_end_token_id=hf.get("audio_end_token_id", 151648),
+        position_id_per_seconds=float(hf.get("position_id_per_seconds", 25)),
+        **overrides,
+    )
+
+
+def parallel_plan(cfg):
+    from veomni_tpu.parallel.parallel_plan import ParallelPlan
+
+    return ParallelPlan(
+        stacked_layer_prefixes=("layers", "dense_layers", "blocks"),
+    )

@@ -441,80 +441,179 @@ def _hf_layer_maps(cfg):
 
 
 def hf_to_params(model_dir: str, cfg: TransformerConfig, target_shardings=None):
-    """Load an HF Qwen3Next checkpoint into the [G, P]-stacked layout."""
+    """Load an HF Qwen3Next checkpoint into the [G, P]-stacked layout.
+
+    Streamed + shard-aligned like ``hf_io.hf_to_params``: with
+    ``target_shardings`` every stacked tensor is built via
+    ``jax.make_array_from_callback`` whose callback reads only the (layer,
+    expert, feature) slices the local shards need from the mmap'd
+    safetensors — peak host RAM O(one shard slice), EP processes read only
+    their expert slice (reference ``module_utils.py:348,530,867``)."""
+    import itertools
+
     import numpy as np
 
     from veomni_tpu.models.hf_io import LazyHFTensors
+    from veomni_tpu.parallel.parallel_plan import param_path_str
 
     G, P = _group_shape(cfg)
     interval = cfg.full_attention_interval
     lin_map, full_map, mlp_map = _hf_layer_maps(cfg)
-    src = LazyHFTensors(model_dir)
-    get = src.read
+    lazy = LazyHFTensors(model_dir)
+    pd = cfg.param_dtype
+    pd_np = np.dtype(jnp.zeros((), pd).dtype)
 
-    def layer_tensor(i, suffix, transpose):
-        t = np.asarray(get(f"model.layers.{i}.{suffix}"))
-        return t.T if transpose else t
+    shardings: Dict[str, Any] = {}
+    if target_shardings is not None:
+        jax.tree_util.tree_map_with_path(
+            lambda p, s: shardings.__setitem__(param_path_str(p), s),
+            target_shardings,
+        )
 
-    def stack(idxs, maps, lead):
+    def place(dotted, shape, read_block):
+        sh = shardings.get(dotted)
+        if shardings and sh is None:
+            raise KeyError(f"param {dotted!r} missing from target_shardings")
+        if sh is not None:
+            return jax.make_array_from_callback(
+                tuple(shape), sh,
+                lambda idx: np.ascontiguousarray(read_block(idx)).astype(pd_np),
+            )
+        full = read_block(tuple(slice(None) for _ in shape))
+        return jnp.asarray(np.ascontiguousarray(full), pd)
+
+    def lead_positions(lead_slices, lead):
+        """Cartesian product of the selected leading (group/per-group)
+        positions -> flat layer-list indices + output block shape."""
+        ranges = [range(*sl.indices(n)) for sl, n in zip(lead_slices, lead)]
+        return list(itertools.product(*ranges)), [len(r) for r in ranges]
+
+    def stacked(dotted, names, lead, transpose, extract=None):
+        one = lazy.shape(names[0])
+        one_ours = tuple(reversed(one)) if transpose else one
+        if extract is not None:
+            one_ours = extract.shape(one_ours)
+        for real in names:
+            lazy.mark_consumed(real)
+
+        def read(idx):
+            lead_sl, rest = idx[: len(lead)], tuple(idx[len(lead):])
+            pos, block = lead_positions(lead_sl, lead)
+            parts = []
+            for coords in pos:
+                flat = 0
+                for c, n in zip(coords, lead):
+                    flat = flat * n + c
+                if extract is not None:
+                    part = extract.extract(
+                        lazy.read_slice(names[flat], tuple(slice(None) for _ in one))
+                    )[rest]
+                elif transpose:
+                    part = lazy.read_slice(names[flat], tuple(reversed(rest))).T
+                else:
+                    part = lazy.read_slice(names[flat], rest)
+                parts.append(part)
+            return np.stack(parts).reshape(tuple(block) + parts[0].shape)
+
+        return place(dotted, tuple(lead) + tuple(one_ours), read)
+
+    class _ConvSqueeze:
+        """HF conv1d [C, 1, K] -> [C, K]."""
+
+        @staticmethod
+        def shape(s):
+            return (s[0], s[2])
+
+        @staticmethod
+        def extract(t):
+            return t[:, 0, :]
+
+    def experts_stacked(dotted, idxs, lead, name):
+        names = [
+            [f"model.layers.{i}.mlp.experts.{e}.{name}.weight"
+             for e in range(cfg.num_experts)]
+            for i in idxs
+        ]
+        for row in names:
+            for real in row:
+                lazy.mark_consumed(real)
+        o_dim, i_dim = lazy.shape(names[0][0])
+
+        def read(idx):
+            lead_sl, esl = idx[: len(lead)], idx[len(lead)]
+            isl, osl = idx[len(lead) + 1], idx[len(lead) + 2]
+            pos, block = lead_positions(lead_sl, lead)
+            parts = []
+            for coords in pos:
+                flat = 0
+                for c, n in zip(coords, lead):
+                    flat = flat * n + c
+                row = [
+                    lazy.read_slice(names[flat][e], (osl, isl)).T
+                    for e in range(*esl.indices(cfg.num_experts))
+                ]
+                parts.append(np.stack(row))
+            return np.stack(parts).reshape(
+                tuple(block) + parts[0].shape
+            )
+
+        return place(
+            dotted, tuple(lead) + (cfg.num_experts, i_dim, o_dim), read
+        )
+
+    lin_idxs = [i for i in range(cfg.num_hidden_layers) if (i + 1) % interval]
+    full_idxs = [i for i in range(cfg.num_hidden_layers) if not (i + 1) % interval]
+
+    def build_tree(prefix, idxs, maps, lead):
         out: Params = {}
         for ours, suffix, tr in maps:
-            tens = np.stack([layer_tensor(i, suffix, tr) for i in idxs])
-            tens = tens.reshape(lead + tens.shape[1:])
+            names = [f"model.layers.{i}.{suffix}" for i in idxs]
             node = out
             parts = ours.split(".")
             for p_ in parts[:-1]:
                 node = node.setdefault(p_, {})
-            node[parts[-1]] = jnp.asarray(tens, cfg.param_dtype)
+            node[parts[-1]] = stacked(
+                f"{prefix}.{ours}", names, lead, tr
+            )
         return out
 
-    lin_idxs = [i for i in range(cfg.num_hidden_layers) if (i + 1) % interval]
-    full_idxs = [i for i in range(cfg.num_hidden_layers) if not (i + 1) % interval]
     params: Params = {
-        "embed_tokens": jnp.asarray(
-            np.asarray(get("model.embed_tokens.weight")), cfg.param_dtype
+        "embed_tokens": place(
+            "embed_tokens",
+            lazy.shape("model.embed_tokens.weight"),
+            lambda idx: lazy.read_slice("model.embed_tokens.weight", idx),
         ),
-        "norm": jnp.asarray(np.asarray(get("model.norm.weight")), cfg.param_dtype),
-        "linear_layers": stack(lin_idxs, lin_map + mlp_map, (G, P)),
-        "full_layers": stack(full_idxs, full_map + mlp_map, (G,)),
+        "norm": place(
+            "norm", lazy.shape("model.norm.weight"),
+            lambda idx: lazy.read_slice("model.norm.weight", idx),
+        ),
+        "linear_layers": build_tree("linear_layers", lin_idxs, lin_map + mlp_map, (G, P)),
+        "full_layers": build_tree("full_layers", full_idxs, full_map + mlp_map, (G,)),
     }
-    # conv1d weight [C, 1, K] -> [C, K]
-    conv = np.stack([
-        np.asarray(get(f"model.layers.{i}.linear_attn.conv1d.weight"))[:, 0, :]
-        for i in lin_idxs
-    ])
-    params["linear_layers"]["conv_weight"] = jnp.asarray(
-        conv.reshape((G, P) + conv.shape[1:]), cfg.param_dtype
+    lazy.mark_consumed("model.embed_tokens.weight")
+    lazy.mark_consumed("model.norm.weight")
+    params["linear_layers"]["conv_weight"] = stacked(
+        "linear_layers.conv_weight",
+        [f"model.layers.{i}.linear_attn.conv1d.weight" for i in lin_idxs],
+        (G, P), False, extract=_ConvSqueeze,
     )
     if cfg.is_moe:
-        # per-expert HF tensors -> stacked [.., E, in, out]
-        for tree, idxs, lead in (
-            (params["linear_layers"], lin_idxs, (G, P)),
-            (params["full_layers"], full_idxs, (G,)),
+        for tree, idxs, lead, prefix in (
+            (params["linear_layers"], lin_idxs, (G, P), "linear_layers"),
+            (params["full_layers"], full_idxs, (G,), "full_layers"),
         ):
-            experts = {}
-            for name in ("gate_proj", "up_proj", "down_proj"):
-                t = np.stack([
-                    np.stack([
-                        np.asarray(
-                            get(f"model.layers.{i}.mlp.experts.{e}.{name}.weight")
-                        ).T
-                        for e in range(cfg.num_experts)
-                    ])
-                    for i in idxs
-                ])
-                experts[name] = jnp.asarray(
-                    t.reshape(lead + t.shape[1:]), cfg.param_dtype
-                )
-            tree["experts"] = experts
+            tree["experts"] = {
+                name: experts_stacked(f"{prefix}.experts.{name}", idxs, lead, name)
+                for name in ("gate_proj", "up_proj", "down_proj")
+            }
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = jnp.asarray(
-            np.asarray(get("lm_head.weight")).T, cfg.param_dtype
+        hf_shape = lazy.shape("lm_head.weight")
+        params["lm_head"] = place(
+            "lm_head", tuple(reversed(hf_shape)),
+            lambda idx: lazy.read_slice(
+                "lm_head.weight", tuple(reversed(idx))).T,
         )
-    if target_shardings is not None:
-        params = jax.tree.map(
-            lambda x, sh: jax.device_put(x, sh), params, target_shardings
-        )
+        lazy.mark_consumed("lm_head.weight")
     return params
 
 

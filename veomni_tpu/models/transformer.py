@@ -463,7 +463,11 @@ def _decoder_layer(
             return o
 
         c = cfg.chunk_mbs
-        if c and s > c and s % c == 0:
+        if c and s > c and s % c:
+            # round down to the largest divisor of s so chunking engages
+            # instead of silently no-op'ing on non-multiple lengths
+            c = next((d for d in range(c, 1, -1) if s % d == 0), 0)
+        if c and 1 < c < s:
             # ChunkMBS (reference chunk_mbs.py:145): bound the [B,S,inter]
             # intermediate to [B,c,inter]; lax.map serializes the chunks and
             # jax.checkpoint keeps the bwd recompute chunked too.
