@@ -5,6 +5,9 @@ Metric: training tokens/sec/chip on a Qwen3-0.6B-class dense model (largest
 of the family that fits a single v5e chip with full AdamW state); MFU is
 reported alongside. vs_baseline is measured MFU / 40.0 (BASELINE.json north
 star: >= 40% MFU for text SFT on TPU; no published TPU numbers exist).
+
+``run_bench()`` is importable so scripts/mfu_sweep.py can ladder over
+micro-batch size / attention impl / remat policy in one process.
 """
 
 import json
@@ -38,17 +41,45 @@ def _watchdog(timeout_s: float):
     os._exit(3)
 
 
-def main():
-    threading.Thread(
-        target=_watchdog,
-        args=(float(os.environ.get("BENCH_WATCHDOG_S", 900)),),
-        daemon=True,
-    ).start()
+def bench_config(remat_policy: str = "dots"):
+    import jax.numpy as jnp
+
+    from veomni_tpu.models import TransformerConfig
+
+    return TransformerConfig(
+        model_type="qwen3",
+        vocab_size=151936,
+        hidden_size=1024,
+        intermediate_size=3072,
+        num_hidden_layers=28,
+        num_attention_heads=16,
+        num_key_value_heads=8,
+        head_dim=128,
+        qk_norm=True,
+        tie_word_embeddings=True,
+        max_position_embeddings=131072,
+        rope_theta=1e6,
+        dtype=jnp.bfloat16,
+        remat_policy=remat_policy,
+    )
+
+
+def run_bench(
+    seq_len: int,
+    micro_bs: int,
+    steps: int,
+    *,
+    attention_impl: str = None,
+    remat_policy: str = "dots",
+    donate: bool = True,
+) -> dict:
+    """One full train-throughput measurement; returns {tok_s_chip, mfu, dt}."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from veomni_tpu.models import TransformerConfig, build_foundation_model
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.ops.kernel_registry import apply_ops_config
     from veomni_tpu.optim import build_lr_scheduler, build_optimizer
     from veomni_tpu.parallel import init_parallel_state, use_parallel_state
     from veomni_tpu.train import build_train_state, build_train_step
@@ -56,29 +87,14 @@ def main():
     from veomni_tpu.utils.count_flops import FlopsCounter
     from veomni_tpu.utils.device import get_device_peak_flops
 
+    os.environ["VEOMNI_DONATE_STATE"] = "1" if donate else "0"
+    apply_ops_config({"attention": attention_impl} if attention_impl else None)
+
     n_chips = jax.device_count()
     ps = init_parallel_state()
 
-    seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))
-    micro_bs = int(os.environ.get("BENCH_MICRO_BS", 4))
-    steps = int(os.environ.get("BENCH_STEPS", 10))
-
     with use_parallel_state(ps):
-        cfg = TransformerConfig(
-            model_type="qwen3",
-            vocab_size=151936,
-            hidden_size=1024,
-            intermediate_size=3072,
-            num_hidden_layers=28,
-            num_attention_heads=16,
-            num_key_value_heads=8,
-            head_dim=128,
-            qk_norm=True,
-            tie_word_embeddings=True,
-            max_position_embeddings=32768,
-            rope_theta=1e6,
-            dtype=jnp.bfloat16,
-        )
+        cfg = bench_config(remat_policy)
         model = build_foundation_model(config=cfg)
         plan = model.get_parallel_plan()
         opt = build_optimizer(model.abstract(), lr=build_lr_scheduler(lr=1e-4, train_steps=1000))
@@ -112,8 +128,8 @@ def main():
         batch = {k: jax.device_put(v, batch_shardings[k]) for k, v in batch.items()}
 
         # warmup (compile); NOTE: on the axon-tunneled TPU platform
-        # block_until_ready does not wait for remote execution — a host
-        # fetch (float()) is the only true synchronization point.
+        # block_until_ready has not always waited for remote execution — a
+        # host fetch (float()) is the only guaranteed synchronization point.
         state, metrics = step(state, batch)
         _ = float(metrics["loss"])
 
@@ -130,14 +146,38 @@ def main():
         ) * steps
         mfu = 100.0 * flops / dt / (get_device_peak_flops() * n_chips)
 
-        _done.set()  # before printing: the watchdog must never race the
-        # real record out of a block-buffered stdout via os._exit
-        print(json.dumps({
-            "metric": "train_tokens_per_sec_per_chip",
-            "value": round(tok_per_sec_chip, 1),
-            "unit": f"tokens/s/chip (qwen3-0.6B bf16, seq{seq_len}, mfu={mfu:.1f}%)",
-            "vs_baseline": round(mfu / 40.0, 4),
-        }), flush=True)
+        # free state before the caller builds the next config
+        del batch
+        jax.tree.map(lambda x: x.delete(), state)
+        return {"tok_s_chip": tok_per_sec_chip, "mfu": mfu, "dt": dt,
+                "seq_len": seq_len, "micro_bs": micro_bs, "steps": steps,
+                "attention": attention_impl or "auto",
+                "remat_policy": remat_policy}
+
+
+def main():
+    threading.Thread(
+        target=_watchdog,
+        args=(float(os.environ.get("BENCH_WATCHDOG_S", 900)),),
+        daemon=True,
+    ).start()
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))
+    micro_bs = int(os.environ.get("BENCH_MICRO_BS", 8))
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+    r = run_bench(
+        seq_len, micro_bs, steps,
+        attention_impl=os.environ.get("BENCH_ATTN_IMPL") or None,
+        remat_policy=os.environ.get("BENCH_REMAT", "dots"),
+    )
+    _done.set()  # before printing: the watchdog must never race the
+    # real record out of a block-buffered stdout via os._exit
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(r["tok_s_chip"], 1),
+        "unit": f"tokens/s/chip (qwen3-0.6B bf16, seq{seq_len}, "
+                f"mfu={r['mfu']:.1f}%)",
+        "vs_baseline": round(r["mfu"] / 40.0, 4),
+    }), flush=True)
 
 
 if __name__ == "__main__":

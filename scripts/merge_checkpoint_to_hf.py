@@ -44,15 +44,13 @@ def main():
     if os.path.isdir(args.config):
         with open(os.path.join(args.config, "config.json")) as f:
             hf = json.load(f)
-        from veomni_tpu.models.config import TransformerConfig
-
         mt = hf.get("model_type", "")
-        from veomni_tpu.models.auto import VLM_MODEL_TYPES
-
-        if mt in VLM_MODEL_TYPES:
+        if mt == "qwen2_vl":  # generic VLM composite: no config_from_hf
             config = build_config(mt, text=hf.get("text_config", hf))
         else:
-            config = TransformerConfig.from_hf_config(hf)
+            # delegate to auto's per-family config_from_hf dispatch so
+            # vision/audio sub-configs and token ids survive the round-trip
+            config = build_foundation_model(config_path=args.config).config
     else:
         overrides = json.loads(args.config)
         mt = overrides.pop("model_type", "")

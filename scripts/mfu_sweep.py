@@ -1,0 +1,50 @@
+"""MFU ladder: sweep attention impl x micro-batch x remat on the real chip.
+
+Run:  python scripts/mfu_sweep.py            # full ladder
+      SWEEP_CONFIGS='[[4096,8,"xla","dots"]]' python scripts/mfu_sweep.py
+
+Appends one JSON line per config to stdout; the best config should become
+bench.py's default (see BENCH_NOTES.md for the recorded ladder).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import run_bench  # noqa: E402
+
+
+DEFAULT = [
+    # [seq_len, micro_bs, attention_impl, remat_policy]
+    [4096, 4, "xla", "dots"],
+    [4096, 4, "pallas_flash", "dots"],
+    [4096, 8, "xla", "dots"],
+    [4096, 8, "pallas_flash", "dots"],
+    [4096, 8, "xla", "nothing"],
+    [4096, 16, "xla", "dots"],
+]
+
+
+def main():
+    configs = json.loads(os.environ.get("SWEEP_CONFIGS", "null")) or DEFAULT
+    steps = int(os.environ.get("SWEEP_STEPS", 8))
+    results = []
+    for seq_len, micro_bs, attn, remat in configs:
+        try:
+            r = run_bench(int(seq_len), int(micro_bs), steps,
+                          attention_impl=attn, remat_policy=remat)
+        except Exception as e:  # OOM etc: record and continue the ladder
+            r = {"seq_len": seq_len, "micro_bs": micro_bs, "attention": attn,
+                 "remat_policy": remat, "error": repr(e)[:200]}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    ok = [r for r in results if "mfu" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["mfu"])
+        print("BEST:", json.dumps(best), flush=True)
+
+
+if __name__ == "__main__":
+    main()

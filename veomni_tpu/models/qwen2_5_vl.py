@@ -406,20 +406,32 @@ def vision_forward(
 # loss
 # ---------------------------------------------------------------------------
 
-def merge_vision_features(embeds, input_ids, feats, merged_mask,
-                          image_token_id, video_token_id):
-    """Scatter packed vision features (image order) into placeholder tokens
-    (reading order over the whole batch — the collator packs images in batch
-    row order)."""
-    b, s, h = embeds.shape
+def gather_packed_features(input_ids, feats, merged_mask,
+                           image_token_id, video_token_id):
+    """Align packed per-token features [M, H] (image order) with placeholder
+    tokens (reading order over the whole batch): returns
+    (gathered [B*S, H], valid [B*S]) — the shared scatter core for the
+    VLM/omni composites."""
     m = feats.shape[0]
     is_vis = (input_ids == image_token_id) | (input_ids == video_token_id)
     flat = is_vis.reshape(-1)
     ordinal = jnp.cumsum(flat.astype(jnp.int32)) - 1
     idx = jnp.clip(ordinal, 0, m - 1)
     valid = flat & (ordinal < m) & merged_mask[idx]
-    gathered = feats[idx].astype(embeds.dtype)
-    out = jnp.where(valid[:, None], gathered, embeds.reshape(b * s, h))
+    return feats[idx], valid
+
+
+def merge_vision_features(embeds, input_ids, feats, merged_mask,
+                          image_token_id, video_token_id):
+    """Scatter packed vision features (image order) into placeholder tokens
+    (reading order over the whole batch — the collator packs images in batch
+    row order)."""
+    b, s, h = embeds.shape
+    gathered, valid = gather_packed_features(
+        input_ids, feats, merged_mask, image_token_id, video_token_id
+    )
+    out = jnp.where(valid[:, None], gathered.astype(embeds.dtype),
+                    embeds.reshape(b * s, h))
     return out.reshape(b, s, h)
 
 

@@ -489,12 +489,19 @@ def forward_hidden(
     position_ids: jax.Array,       # [B,S] int32
     segment_ids: Optional[jax.Array] = None,  # [B,S] int32
     inputs_embeds: Optional[jax.Array] = None,  # [B,S,H] overrides embedding
+    post_layer_residuals: Optional[jax.Array] = None,  # [K,B,S,H]
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (final_hidden [B,S,H] in cfg.dtype, moe_aux_loss scalar,
     moe_dropped_frac scalar — mean EP capacity-drop fraction, 0 when dropless).
 
     ``inputs_embeds`` lets composite models (VLM/omni) inject merged
-    multimodal embeddings while sharing the decoder stack."""
+    multimodal embeddings while sharing the decoder stack.
+
+    ``post_layer_residuals``: deepstack-style injection (qwen3-vl,
+    reference ``qwen3_vl/generated/patched_modeling_qwen3_vl_gpu.py:1481``
+    ``_deepstack_process``) — residual ``[i]`` is added to the hidden state
+    after decoder layer ``i`` for the first K layers (already scattered to
+    sequence positions; zeros elsewhere)."""
     compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
     if inputs_embeds is not None:
         hidden = inputs_embeds.astype(cfg.dtype)
@@ -557,15 +564,28 @@ def forward_hidden(
 
     auxes_total = jnp.float32(0.0)
     drops_total = jnp.float32(0.0)
+    K_inject = 0 if post_layer_residuals is None else post_layer_residuals.shape[0]
+
+    segments = []
     if k_dense:
-        hidden, aux0, drop0 = run_segment(hidden, compute["dense_layers"], 0, k_dense, False)
-        auxes_total = auxes_total + aux0
-        drops_total = drops_total + drop0
-    hidden, auxes, drops = run_segment(
-        hidden, compute["layers"], k_dense, L - k_dense, cfg.is_moe
-    )
-    auxes_total = auxes_total + auxes
-    drops_total = drops_total + drops
+        segments.append(("dense_layers", 0, k_dense, False))
+    segments.append(("layers", k_dense, L - k_dense, cfg.is_moe))
+    for name, offset, count, is_moe_seg in segments:
+        tree = compute[name]
+        start = 0
+        while start < count:
+            g = offset + start  # global layer index
+            n = 1 if g < K_inject else count - start
+            sub = (
+                tree if (start == 0 and n == count)
+                else jax.tree.map(lambda t: t[start:start + n], tree)
+            )
+            hidden, auxes, drops = run_segment(hidden, sub, g, n, is_moe_seg)
+            auxes_total = auxes_total + auxes
+            drops_total = drops_total + drops
+            if g < K_inject:
+                hidden = hidden + post_layer_residuals[g].astype(hidden.dtype)
+            start += n
     hidden = _norm(hidden, compute["norm"], cfg)
     # mean dropped-assignment fraction over the MoE layers (diagnostic)
     n_moe = (L - k_dense) if cfg.is_moe else 0

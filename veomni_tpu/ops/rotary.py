@@ -105,9 +105,23 @@ def rotary_tables(
         # [B,3,S] -> [3,B,S,D/2] per-stream angles, then pick each frequency
         # chunk from its stream (static section map, no gather needed)
         ang3 = positions.astype(jnp.float32).transpose(1, 0, 2)[..., None] * inv_freq
-        sec = np.concatenate(
-            [np.full(n, i % 3, np.int32) for i, n in enumerate(msec)]
-        )
+        if (rope_scaling or {}).get("mrope_interleaved"):
+            # qwen3-vl layout (HF apply_interleaved_mrope): frequency j reads
+            # stream 1 when j%3==1 and j<3*sec[1], stream 2 when j%3==2 and
+            # j<3*sec[2], else the temporal stream — [THW THW ... TT] keeps
+            # frequency continuity across the three streams.
+            if sum(msec) != head_dim // 2:
+                raise ValueError(
+                    f"mrope_section {msec} must sum to head_dim/2 = {head_dim // 2}"
+                )
+            sec = np.zeros(head_dim // 2, np.int32)
+            js = np.arange(head_dim // 2)
+            sec[(js % 3 == 1) & (js < 3 * msec[1])] = 1
+            sec[(js % 3 == 2) & (js < 3 * msec[2])] = 2
+        else:
+            sec = np.concatenate(
+                [np.full(n, i % 3, np.int32) for i, n in enumerate(msec)]
+            )
         if sec.shape[0] != head_dim // 2:
             raise ValueError(
                 f"mrope_section {msec} must sum to head_dim/2 = {head_dim // 2}"
