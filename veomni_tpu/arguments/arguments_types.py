@@ -17,7 +17,7 @@ class ModelArguments:
     model_path: str = ""             # dir with safetensors weights ("" = random init)
     tokenizer_path: str = ""         # defaults to config_path
     model_type: str = ""             # override/bypass config.json model_type
-    attn_implementation: str = "auto"    # auto|xla|pallas_flash
+    attn_implementation: str = "auto"    # auto|xla|xla_chunked|xla_twopass|pallas_flash
     moe_implementation: str = "auto"     # auto|xla|xla_ragged|pallas|pallas_gmm
     ops_implementation: Dict[str, str] = field(default_factory=dict)  # op -> impl pin
     # tiny-model construction without config.json (tests/toy configs)

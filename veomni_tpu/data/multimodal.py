@@ -12,7 +12,7 @@ with a validity mask. The transform expands every image into
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -181,6 +181,8 @@ def image_to_qwen_patches(img: np.ndarray, vcfg) -> "tuple[np.ndarray, tuple]":
 
 
 @DATA_TRANSFORM_REGISTRY.register("qwen2_5_vl")
+@DATA_TRANSFORM_REGISTRY.register("qwen3_vl")  # same row contract; the
+# config object (Qwen3VLConfig) carries the family-specific geometry
 def build_qwen25_vl_transform(
     tokenizer=None,
     *,
@@ -380,11 +382,11 @@ class Qwen25VLCollator:
                 break
         return ids, lab, grids[:kept], sum(patch_counts[:kept])
 
-    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
-        from veomni_tpu.models.qwen2_5_vl import mrope_position_ids, vision_metadata
-
+    def _assemble_text(self, samples) -> Tuple[Dict[str, np.ndarray], np.ndarray, list]:
+        """Shared text/patch assembly: returns (text arrays, packed patch
+        buffer [max_patches, patch_dim], grids)."""
         b, s = self.micro_batch_size, self.seq_len
-        cfg, vcfg = self.cfg, self.cfg.vision
+        vcfg = self.cfg.vision
         out = {
             "input_ids": np.zeros((b, s), np.int32),
             "labels": np.full((b, s), IGNORE_INDEX, np.int32),
@@ -412,18 +414,48 @@ class Qwen25VLCollator:
             out["input_ids"][i, :n] = ids
             out["labels"][i, :n] = shifted
             out["segment_ids"][i, :n] = 1
-        out["position_ids"] = mrope_position_ids(
-            out["input_ids"].astype(np.int64), all_grids, cfg
-        ).astype(np.int32)
-        meta = vision_metadata(all_grids, vcfg, self.max_patches)
         px = np.zeros((self.max_patches, vcfg.patch_dim), np.float32)
         if all_patches:
             cat = np.concatenate(all_patches)
             px[: len(cat)] = cat
+        return out, px, all_grids
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        from veomni_tpu.models.qwen2_5_vl import mrope_position_ids, vision_metadata
+
+        cfg, vcfg = self.cfg, self.cfg.vision
+        out, px, all_grids = self._assemble_text(samples)
+        out["position_ids"] = mrope_position_ids(
+            out["input_ids"].astype(np.int64), all_grids, cfg
+        ).astype(np.int32)
+        meta = vision_metadata(all_grids, vcfg, self.max_patches)
         out["pixel_values"] = px[meta["patch_gather"]]
         out["vis_pos_hw"] = meta["pos_hw"]
         out["vis_seg_window"] = meta["seg_window"]
         out["vis_seg_full"] = meta["seg_full"]
         out["vis_reverse"] = meta["reverse"]
+        out["vis_merged_mask"] = meta["merged_mask"]
+        return out
+
+
+class Qwen3VLCollator(Qwen25VLCollator):
+    """Qwen3-VL variant: patches stay in processor (merge-block) order — no
+    window gather — and the index plan carries the learnable-pos-embed
+    bilinear interpolation instead of window segments."""
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        from veomni_tpu.models.qwen3_vl import mrope_position_ids, vision_metadata
+
+        cfg, vcfg = self.cfg, self.cfg.vision
+        out, px, all_grids = self._assemble_text(samples)
+        out["position_ids"] = mrope_position_ids(
+            out["input_ids"].astype(np.int64), all_grids, cfg
+        ).astype(np.int32)
+        meta = vision_metadata(all_grids, vcfg, self.max_patches)
+        out["pixel_values"] = px
+        out["vis_pos_hw"] = meta["pos_hw"]
+        out["vis_pos_interp_idx"] = meta["pos_interp_idx"]
+        out["vis_pos_interp_w"] = meta["pos_interp_w"]
+        out["vis_seg_full"] = meta["seg_full"]
         out["vis_merged_mask"] = meta["merged_mask"]
         return out

@@ -124,22 +124,24 @@ def _register_vlm_families():
         ),
     )
 
-    # qwen3_vl is the real architecture (deepstack ViT + interleaved mrope)
+    # qwen3_vl is the real architecture (deepstack ViT + interleaved mrope);
+    # qwen3_vl_moe = same tower + qwen3_moe text (fused-chunked experts)
     from veomni_tpu.models import qwen3_vl as q3vl
 
-    MODEL_REGISTRY.register(
-        "qwen3_vl",
-        ModelFamily(
-            model_type="qwen3_vl",
-            config_cls=q3vl.Qwen3VLConfig,
-            init_params=q3vl.init_params,
-            abstract_params=q3vl.abstract_params,
-            loss_fn=q3vl.loss_fn,
-            forward_logits=None,
-            hf_to_params=q3vl.hf_to_params,
-            save_hf_checkpoint=q3vl.save_hf_checkpoint,
-        ),
-    )
+    for mt in ("qwen3_vl", "qwen3_vl_moe"):
+        MODEL_REGISTRY.register(
+            mt,
+            ModelFamily(
+                model_type=mt,
+                config_cls=q3vl.Qwen3VLConfig,
+                init_params=q3vl.init_params,
+                abstract_params=q3vl.abstract_params,
+                loss_fn=q3vl.loss_fn,
+                forward_logits=None,
+                hf_to_params=q3vl.hf_to_params,
+                save_hf_checkpoint=q3vl.save_hf_checkpoint,
+            ),
+        )
 
     # qwen2_5_vl is the real architecture (window-attn ViT + mrope + merger)
     from veomni_tpu.models import qwen2_5_vl as q25
@@ -179,7 +181,7 @@ def _register_vlm_families():
 
 _register_vlm_families()
 
-VLM_MODEL_TYPES = ("qwen2_vl", "qwen2_5_vl", "qwen3_vl")
+VLM_MODEL_TYPES = ("qwen2_vl", "qwen2_5_vl", "qwen3_vl", "qwen3_vl_moe")
 
 
 def build_config(model_type: str = "", **overrides):
@@ -189,7 +191,7 @@ def build_config(model_type: str = "", **overrides):
     nested text config so the same override surface works for both.
     """
     overrides.pop("model_type", None)
-    if model_type in ("qwen2_5_vl", "qwen3_vl"):
+    if model_type in ("qwen2_5_vl", "qwen3_vl", "qwen3_vl_moe"):
         if model_type == "qwen2_5_vl":
             from veomni_tpu.models.qwen2_5_vl import Qwen25VLConfig as vl_cfg
 
@@ -197,7 +199,7 @@ def build_config(model_type: str = "", **overrides):
         else:
             from veomni_tpu.models.qwen3_vl import Qwen3VLConfig as vl_cfg
 
-            text_mt = "qwen3"
+            text_mt = "qwen3_moe" if model_type == "qwen3_vl_moe" else "qwen3"
         kw = {
             k: overrides.pop(k)
             for k in ("vision", "image_token_id", "video_token_id",
@@ -207,12 +209,15 @@ def build_config(model_type: str = "", **overrides):
         text = dict(overrides.pop("text", {}) or {})
         text.update(overrides)
         text.setdefault("model_type", text_mt)
-        if model_type == "qwen3_vl" and text.get("rope_scaling"):
+        if model_type.startswith("qwen3_vl") and text.get("rope_scaling"):
             # qwen3-vl mrope is interleaved — keep both config paths
             # (build_config and config_from_hf) on the same rope layout
             rs = dict(text["rope_scaling"])
             rs.setdefault("mrope_interleaved", True)
             text["rope_scaling"] = rs
+        if model_type == "qwen3_vl_moe":
+            text.setdefault("expert_layout", "fused_chunked")
+            kw["model_type"] = model_type
         return vl_cfg(text=text, **kw)
     if model_type in VLM_MODEL_TYPES:
         from veomni_tpu.models.vlm import VLMConfig
@@ -289,7 +294,7 @@ def build_foundation_model(
             from veomni_tpu.models.qwen2_5_vl import config_from_hf
 
             config = config_from_hf(hf_dict, **config_overrides)
-        elif hf_dict.get("model_type") == "qwen3_vl":
+        elif hf_dict.get("model_type") in ("qwen3_vl", "qwen3_vl_moe"):
             from veomni_tpu.models.qwen3_vl import config_from_hf as q3vl_from_hf
 
             config = q3vl_from_hf(hf_dict, **config_overrides)

@@ -94,6 +94,10 @@ class TransformerConfig:
     attn_output_gate: bool = False      # full-attn layers: out *= sigmoid(gate)
     # EP dispatch capacity factor; <= 0 means dropless (see parallel/moe.py)
     moe_capacity_factor: float = 0.0
+    # HF checkpoint expert-tensor layout: "" = auto by model_type
+    # (gpt_oss -> fused_interleaved, else per_expert); "fused_chunked" is the
+    # qwen3_vl_moe layout (gate_up_proj [E, H, 2I] with gate then up halves)
+    expert_layout: str = ""
     # numerics
     dtype: Any = jnp.bfloat16       # activation/compute dtype
     param_dtype: Any = jnp.float32  # master param dtype

@@ -246,7 +246,10 @@ def hf_to_params(
             lsl, rest = idx[0], tuple(idx[1:])
             parts = []
             for i in range(*lsl.indices(count)):
-                if postprocess is not None:
+                if postprocess is not None and hasattr(postprocess, "slice_read"):
+                    # contiguous fused layouts: direct offset read (streamed)
+                    part = postprocess.slice_read(lazy, names[i], rest, one)
+                elif postprocess is not None:
                     # interleaved layouts: read the layer tensor, slice host-side
                     part = postprocess.extract(lazy.read_slice(
                         names[i], tuple(slice(None) for _ in one)))[rest]
@@ -304,6 +307,31 @@ def hf_to_params(
         def extract(self, arr):
             return arr[..., self.start::2]
 
+    class _Chunk:
+        """qwen3_vl_moe fused gate_up [..., 2I] -> gate/up half extract.
+
+        Halves are contiguous on the last dim, so a target-sharding slice
+        maps to a direct offset read — the streamed O(slice) load contract
+        holds (unlike gpt_oss's stride-2 interleave, which must read the
+        full layer tensor host-side)."""
+
+        def __init__(self, start):
+            self.start = start
+
+        def shape(self, s):
+            return s[:-1] + (s[-1] // 2,)
+
+        def slice_read(self, lazy_, name, rest, hf_shape):
+            half = hf_shape[-1] // 2
+            rest = tuple(rest) + tuple(
+                slice(None) for _ in range(len(hf_shape) - len(rest))
+            )
+            lo, hi, step = rest[-1].indices(half)
+            off = self.start * half
+            return lazy_.read_slice(
+                name, rest[:-1] + (slice(lo + off, hi + off, step),)
+            )
+
     def load_segment(prefix: str, offset: int, count: int, moe_seg: bool):
         layers: Dict[str, Any] = {}
         for ours, hf_suffix, transpose in _LAYER_MAP:
@@ -313,14 +341,18 @@ def hf_to_params(
                 f"{prefix}.{ours}", hf_suffix, offset, count, transpose))
         if moe_seg and cfg.is_moe:
             if has(f"layers.{offset}.mlp.experts.gate_up_proj"):
-                # gpt_oss fused experts: [E, H, 2I] gate/up interleaved
+                # fused experts [E, H, 2I]: gpt_oss interleaves gate/up on the
+                # last dim (and has a dedicated mlp.router); qwen3_vl_moe
+                # chunks gate|up halves (router = generic mlp.gate map)
+                interleaved = has(f"layers.{offset}.mlp.router.weight")
+                split = _Interleave if interleaved else _Chunk
                 layers["experts"] = {
                     "gate_proj": stacked(
                         f"{prefix}.experts.gate_proj", "mlp.experts.gate_up_proj",
-                        offset, count, False, postprocess=_Interleave(0)),
+                        offset, count, False, postprocess=split(0)),
                     "up_proj": stacked(
                         f"{prefix}.experts.up_proj", "mlp.experts.gate_up_proj",
-                        offset, count, False, postprocess=_Interleave(1)),
+                        offset, count, False, postprocess=split(1)),
                     "down_proj": stacked(
                         f"{prefix}.experts.down_proj", "mlp.experts.down_proj",
                         offset, count, False),
@@ -337,12 +369,14 @@ def hf_to_params(
                     layers["experts"]["down_bias"] = stacked(
                         f"{prefix}.experts.down_bias",
                         "mlp.experts.down_proj_bias", offset, count, False)
-                layers["router"] = stacked(
-                    f"{prefix}.router", "mlp.router.weight", offset, count, True)
-                if has(f"layers.{offset}.mlp.router.bias"):
-                    layers["router_bias"] = stacked(
-                        f"{prefix}.router_bias", "mlp.router.bias",
-                        offset, count, False)
+                if interleaved:
+                    layers["router"] = stacked(
+                        f"{prefix}.router", "mlp.router.weight",
+                        offset, count, True)
+                    if has(f"layers.{offset}.mlp.router.bias"):
+                        layers["router_bias"] = stacked(
+                            f"{prefix}.router_bias", "mlp.router.bias",
+                            offset, count, False)
             else:
                 for ours, hf_tmpl in _EXPERT_MAP:
                     set_nested(layers, ours, experts_stacked(
@@ -423,7 +457,19 @@ def params_to_hf(params: Dict[str, Any], cfg: TransformerConfig) -> Dict[str, np
                 out[f"model.layers.{offset + i}.{hf_suffix}"] = x.T if transpose else x
         if moe_seg and cfg.is_moe:
             ex = layers["experts"]
-            if cfg.model_type == "gpt_oss":
+            layout = cfg.expert_layout or (
+                "fused_interleaved" if cfg.model_type == "gpt_oss"
+                else "per_expert"
+            )
+            if layout == "fused_chunked":
+                # qwen3_vl_moe: gate_up_proj [E, H, 2I] = gate | up halves
+                for i in range(count):
+                    pfx = f"model.layers.{offset + i}.mlp.experts"
+                    out[f"{pfx}.gate_up_proj"] = np.concatenate(
+                        [ex["gate_proj"][i], ex["up_proj"][i]], axis=-1
+                    )
+                    out[f"{pfx}.down_proj"] = ex["down_proj"][i]
+            elif cfg.model_type == "gpt_oss":
                 for i in range(count):
                     gu = np.empty(
                         (cfg.num_experts, cfg.hidden_size,

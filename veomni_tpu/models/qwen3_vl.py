@@ -658,15 +658,20 @@ def save_hf_checkpoint(params, cfg: Qwen3VLConfig, out_dir: str) -> None:
     os.makedirs(out_dir, exist_ok=True)
     save_file({k: jnp.asarray(v) for k, v in tensors.items()},
               os.path.join(out_dir, "model.safetensors"))
+    moe = cfg.model_type == "qwen3_vl_moe"
     hf_cfg = {
-        "model_type": "qwen3_vl",
-        "architectures": ["Qwen3VLForConditionalGeneration"],
+        "model_type": cfg.model_type,
+        "architectures": ["Qwen3VLMoeForConditionalGeneration" if moe
+                          else "Qwen3VLForConditionalGeneration"],
         "image_token_id": cfg.image_token_id,
         "video_token_id": cfg.video_token_id,
         "vision_start_token_id": cfg.vision_start_token_id,
-        "text_config": {**cfg.text.to_hf_config(), "model_type": "qwen3_vl_text"},
+        "text_config": {
+            **cfg.text.to_hf_config(),
+            "model_type": "qwen3_vl_moe_text" if moe else "qwen3_vl_text",
+        },
         "vision_config": {
-            "model_type": "qwen3_vl",
+            "model_type": "qwen3_vl_moe" if moe else "qwen3_vl",
             "depth": cfg.vision.depth,
             "hidden_size": cfg.vision.hidden_size,
             "intermediate_size": cfg.vision.intermediate_size,
@@ -686,7 +691,8 @@ def save_hf_checkpoint(params, cfg: Qwen3VLConfig, out_dir: str) -> None:
 
 
 def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3VLConfig:
-    """Build from an HF Qwen3VLConfig dict (config.json)."""
+    """Build from an HF Qwen3VLConfig / Qwen3VLMoeConfig dict (config.json)."""
+    moe = hf.get("model_type") == "qwen3_vl_moe"
     text_hf = dict(hf.get("text_config") or {})
     for key in ("vocab_size", "hidden_size", "intermediate_size",
                 "num_hidden_layers", "num_attention_heads",
@@ -698,8 +704,10 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3VLConfig:
     rs = dict(text_hf.get("rope_scaling") or {})
     rs.setdefault("mrope_interleaved", True)  # qwen3-vl mrope is interleaved
     text_hf["rope_scaling"] = rs
+    if moe:
+        overrides.setdefault("expert_layout", "fused_chunked")
     text = TransformerConfig.from_hf_config(
-        {**text_hf, "model_type": "qwen3"}, **overrides
+        {**text_hf, "model_type": "qwen3_moe" if moe else "qwen3"}, **overrides
     )
     vis_hf = dict(hf.get("vision_config") or {})
     vis_fields = {f for f in Qwen3VisionConfig.__dataclass_fields__}
@@ -710,4 +718,5 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3VLConfig:
         image_token_id=hf.get("image_token_id", 151655),
         video_token_id=hf.get("video_token_id", 151656),
         vision_start_token_id=hf.get("vision_start_token_id", 151652),
+        model_type="qwen3_vl_moe" if moe else "qwen3_vl",
     )

@@ -152,6 +152,123 @@ def _attention_xla_chunked(
     return out
 
 
+@KERNEL_REGISTRY.register("attention", "xla_twopass", priority=2,
+                          device_types=("tpu",))
+def _attention_xla_twopass(
+    q,
+    k,
+    v,
+    segment_ids: Optional[jax.Array] = None,
+    causal: bool = True,
+    softmax_scale: Optional[float] = None,
+    sliding_window=None,
+    sinks: Optional[jax.Array] = None,
+    q_chunk: int = 2048,
+):
+    """HBM-lean attention in pure XLA: q-chunked, scores computed TWICE.
+
+    On TPU, matmul outputs always round-trip through HBM, so the dense
+    impl's f32 [B,H,S,S] score tensor costs ~12 bytes/element of HBM
+    traffic — attention runs at ~1/10 of the MXU rate. Computing QK^T a
+    second time trades +50% attention FLOPs for a fused pipeline where the
+    first pass feeds only a row-max *reduction* (fusion root: no score
+    materialization) and the second pass materializes just bf16
+    probabilities (2B/element) consumed once by PV. Net: ~4 bytes/element
+    of traffic, ~3-4x the throughput of the dense impl on v5e, measured
+    through the relay (see BENCH_NOTES.md round-2 ladder).
+
+    This matters on platforms where Mosaic/Pallas kernels underperform XLA
+    (the axon-tunneled chip runs Pallas at ~1/4 of XLA's matmul rate);
+    elsewhere the Pallas flash kernel outranks it by priority.
+
+    Chunking over q bounds live probs to [B,H,cq,S] and the backward
+    (jax.checkpoint per chunk) recomputes scores flash-style.
+    """
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    # bound live probs to [B, H, cq, Sk] with cq*Sk <= ~8M elements; at
+    # very long Sk the divisor-constrained cq collapses and the online-
+    # softmax chunked path (O(cq*ck) blocks) takes over instead
+    cq = _best_chunk(sq, min(q_chunk, max(1, 8_388_608 // max(sk, 1))))
+    if cq < 256 and sq > 256:
+        return _attention_xla_chunked(q, k, v, segment_ids, causal,
+                                      softmax_scale, sliding_window, sinks)
+    n_rep = hq // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    nq = sq // cq
+
+    kpos = jnp.arange(sk)[None, :]
+    seg_k = segment_ids  # [B, Sk]
+
+    def chunk_body(qi, seg_qi, i):
+        # qi [B, cq, Hq, D]; seg_qi [B, cq] or None; i chunk index
+        qpos = i * cq + jnp.arange(cq)[:, None]
+        mask = None
+        if causal:
+            mask = qpos >= kpos
+            if sliding_window is not None:
+                in_window = (qpos - kpos < sliding_window) | jnp.less_equal(
+                    sliding_window, 0
+                )
+                mask = mask & in_window
+            mask = mask[None, None]
+        if seg_qi is not None:
+            seg = seg_qi[:, None, :, None] == seg_k[:, None, None, :]
+            mask = seg if mask is None else (mask & seg)
+
+        def scores():
+            return jnp.einsum(
+                "bqhd,bkhd->bhqk", qi, k, preferred_element_type=jnp.float32
+            ) * scale
+
+        s1 = scores()
+        if mask is not None:
+            s1 = jnp.where(mask, s1, -1e30)
+        m = jnp.max(s1, axis=-1, keepdims=True)  # [B,H,cq,1] fused reduce
+        if sinks is not None:
+            sink = sinks.astype(jnp.float32)[None, :, None, None]
+            m = jnp.maximum(m, sink)
+        m = jax.lax.stop_gradient(m)
+        # mask BEFORE the exp: a masked-out score can exceed the (masked)
+        # row max by > ln(f32 max) and overflow exp to inf — the forward
+        # would be saved by a post-exp where(), but the exp VJP's 0 * inf
+        # then NaNs the grads (cf. _attention_dense, which masks scores)
+        s2 = scores()
+        if mask is not None:
+            s2 = jnp.where(mask, s2, -jnp.inf)
+        p = jnp.exp(s2 - m)
+        l = p.sum(-1)  # [B,H,cq]
+        if sinks is not None:
+            l = l + jnp.exp(sink[..., 0] - m[..., 0])
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v,
+                       preferred_element_type=jnp.float32)
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    if nq == 1:
+        return chunk_body(q, segment_ids, 0)
+
+    qs = jnp.moveaxis(q.reshape(b, nq, cq, hq, d), 1, 0)
+    seg_qs = (
+        jnp.moveaxis(segment_ids.reshape(b, nq, cq), 1, 0)
+        if segment_ids is not None else None
+    )
+
+    def body(_, args):
+        if seg_qs is not None:
+            qi, seg_qi, i = args
+        else:
+            qi, i = args
+            seg_qi = None
+        return None, jax.checkpoint(chunk_body)(qi, seg_qi, i)
+
+    xs = (qs, seg_qs, jnp.arange(nq)) if seg_qs is not None else (qs, jnp.arange(nq))
+    _, out = jax.lax.scan(body, None, xs)
+    return jnp.moveaxis(out, 0, 1).reshape(b, sq, hq, d)
+
+
 @KERNEL_REGISTRY.register("attention", "xla", priority=1)
 def _attention_xla(
     q,

@@ -5,6 +5,10 @@ toggles, model-owned collate hooks). Differences here: the multimodal
 collator is shape-uniform (see data/multimodal.py), so no dummy-forward or
 per-group LR machinery is needed; vision freezing happens functionally via
 ``stop_gradient`` (VLMConfig.freeze_vision).
+
+Real-architecture families (qwen2_5_vl, qwen3_vl, qwen3_vl_moe) use the
+packed-patch collators + per-family index plans; the generic ``qwen2_vl``
+composite keeps the fixed-slot VLMCollator.
 """
 
 from __future__ import annotations
@@ -17,6 +21,13 @@ from veomni_tpu.data.data_transform import build_data_transform
 from veomni_tpu.data.multimodal import VLMCollator
 from veomni_tpu.trainer.base import BaseTrainer
 
+# model_type -> (transform/collator key, collator class name)
+_REAL_VL = {
+    "qwen2_5_vl": "qwen2_5_vl",
+    "qwen3_vl": "qwen3_vl",
+    "qwen3_vl_moe": "qwen3_vl",  # same tower + data contract as qwen3_vl
+}
+
 
 class VLMTrainer(BaseTrainer):
     BATCH_KEYS = (
@@ -25,12 +36,13 @@ class VLMTrainer(BaseTrainer):
     )
 
     @property
-    def _is_qwen25(self) -> bool:
-        return self.model.config.model_type == "qwen2_5_vl"
+    def _real_vl_key(self):
+        return _REAL_VL.get(self.model.config.model_type)
 
     def _build_data_transform(self):
         d = self.args.data
-        if self._is_qwen25:
+        key = self._real_vl_key
+        if key:
             import jax
 
             ps = self.parallel_state
@@ -38,7 +50,7 @@ class VLMTrainer(BaseTrainer):
                 1, self.args.train.micro_batch_size * ps.dp_size // jax.process_count()
             )
             self.data_transform = build_data_transform(
-                "qwen2_5_vl",
+                key,
                 tokenizer=self.tokenizer,
                 vlm_config=self.model.config,
                 max_seq_len=d.max_seq_len,
@@ -71,15 +83,19 @@ class VLMTrainer(BaseTrainer):
         self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
         nproc = jax.process_count()
         local_mb = t.micro_batch_size * ps.dp_size // nproc
-        if self._is_qwen25:
+        key = self._real_vl_key
+        if key:
             if nproc > 1:
                 raise NotImplementedError(
-                    "qwen2_5_vl multihost data assembly needs the per-row "
+                    "packed-patch multihost data assembly needs the per-row "
                     "patch budget variant"
                 )
-            from veomni_tpu.data.multimodal import Qwen25VLCollator
+            from veomni_tpu.data.multimodal import (
+                Qwen3VLCollator, Qwen25VLCollator,
+            )
 
-            collator = Qwen25VLCollator(
+            cls = Qwen25VLCollator if key == "qwen2_5_vl" else Qwen3VLCollator
+            collator = cls(
                 seq_len=d.max_seq_len,
                 micro_batch_size=local_mb,
                 vlm_config=self.model.config,
@@ -110,13 +126,17 @@ class VLMTrainer(BaseTrainer):
 
     def _batch_sharding_map(self):
         ps = self.parallel_state
-        if self._is_qwen25:
+        key = self._real_vl_key
+        text = {
+            "input_ids": P(None, ps.dp_axes, ps.sp_axes),
+            "labels": P(None, ps.dp_axes, ps.sp_axes),
+            "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
+        }
+        if key == "qwen2_5_vl":
             return {
-                "input_ids": P(None, ps.dp_axes, ps.sp_axes),
-                "labels": P(None, ps.dp_axes, ps.sp_axes),
+                **text,
                 # mrope positions [A, B, 3, S]
                 "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
-                "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
                 # packed global patch sequence: replicated (vision tower runs
                 # data-parallel-replicated; batch-sharded variant follows the
                 # per-row budget collator)
@@ -127,11 +147,20 @@ class VLMTrainer(BaseTrainer):
                 "vis_reverse": P(None, None),
                 "vis_merged_mask": P(None, None),
             }
+        if key == "qwen3_vl":
+            return {
+                **text,
+                "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
+                "pixel_values": P(None, None, None),
+                "vis_pos_hw": P(None, None, None),
+                "vis_pos_interp_idx": P(None, None, None),
+                "vis_pos_interp_w": P(None, None, None),
+                "vis_seg_full": P(None, None),
+                "vis_merged_mask": P(None, None),
+            }
         return {
-            "input_ids": P(None, ps.dp_axes, ps.sp_axes),
-            "labels": P(None, ps.dp_axes, ps.sp_axes),
+            **text,
             "position_ids": P(None, ps.dp_axes, ps.sp_axes),
-            "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
             # image slots shard over batch only (vision runs unsharded-on-seq)
             "pixel_patches": P(None, ps.dp_axes, None, None, None),
             "image_mask": P(None, ps.dp_axes, None),
