@@ -178,6 +178,24 @@ def _register_vlm_families():
         ),
     )
 
+    # qwen3_omni_moe thinker: AuT audio + qwen3_vl vision + MoE LM
+    from veomni_tpu.models import qwen3_omni_moe as q3o
+
+    MODEL_REGISTRY.register(
+        "qwen3_omni_moe",
+        ModelFamily(
+            model_type="qwen3_omni_moe",
+            config_cls=q3o.Qwen3OmniMoeConfig,
+            init_params=q3o.init_params,
+            abstract_params=q3o.abstract_params,
+            loss_fn=q3o.loss_fn,
+            forward_logits=None,
+            hf_to_params=q3o.hf_to_params,
+            save_hf_checkpoint=q3o.save_hf_checkpoint,
+            parallel_plan_fn=q3o.parallel_plan,
+        ),
+    )
+
 
 _register_vlm_families()
 
@@ -302,6 +320,10 @@ def build_foundation_model(
             from veomni_tpu.models.qwen2_5_omni import config_from_hf as omni_from_hf
 
             config = omni_from_hf(hf_dict, **config_overrides)
+        elif hf_dict.get("model_type") in ("qwen3_omni_moe", "qwen3_omni_moe_thinker"):
+            from veomni_tpu.models.qwen3_omni_moe import config_from_hf as q3o_from_hf
+
+            config = q3o_from_hf(hf_dict, **config_overrides)
         else:
             config = TransformerConfig.from_hf_config(hf_dict, **config_overrides)
     if config.model_type not in MODEL_REGISTRY:

@@ -1,0 +1,804 @@
+"""Qwen3-Omni-MoE thinker: AuT audio encoder + qwen3-vl vision + MoE LM.
+
+Reference: ``veomni/models/transformers/qwen3_omni_moe/`` (8,976 LoC
+generated modeling; upstream contract =
+``Qwen3OmniMoeThinkerForConditionalGeneration``). Architecture (verified
+against the installed transformers source):
+
+* audio tower (AuT): mel features are split into ``2*n_window``-frame
+  chunks, each downsampled by three stride-2 3x3 Conv2d over (mel, time)
+  with GELU, projected to d_model, plus a sinusoid positional embedding
+  *per position within the chunk*; pre-LN encoder layers with biased
+  attention over ``n_window_infer``-frame windows; ln_post then
+  proj1/GELU/proj2 into the LM width.
+* vision tower: byte-identical architecture to qwen3_vl (deepstack ViT) —
+  reused from ``models/qwen3_vl.py``; only the HF parameter prefix differs
+  (``merger_list`` instead of ``deepstack_merger_list``).
+* LM: qwen3_moe dialect with interleaved mrope and deepstack injection;
+  audio features scatter into audio placeholder tokens, vision features
+  into image/video placeholders.
+
+TPU-first: the torch code's ragged chunking / pad_sequence / boolean-mask
+compaction becomes a host-precomputed plan over statically padded chunk and
+frame buffers; the tower is dense conv + gathers inside jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu import ops
+from veomni_tpu.models import qwen3_vl, transformer
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.models.qwen3_vl import Qwen3VisionConfig
+
+
+def audio_output_lengths(mel_len: int) -> int:
+    """HF ``_get_feat_extract_output_lengths``: audio placeholder count for
+    a mel sequence (13 conv frames per full 100-frame window)."""
+    leave = mel_len % 100
+    feat = (leave - 1) // 2 + 1
+    return ((feat - 1) // 2 + 1 - 1) // 2 + 1 + (mel_len // 100) * 13
+
+
+def _conv_out_len(n: int) -> int:
+    """Time length after one stride-2 k3 p1 conv."""
+    return (n + 2 - 3) // 2 + 1
+
+
+@dataclass
+class Qwen3OmniAudioConfig:
+    """HF ``Qwen3OmniMoeAudioEncoderConfig`` surface."""
+
+    d_model: int = 1280
+    encoder_layers: int = 32
+    encoder_attention_heads: int = 20
+    encoder_ffn_dim: int = 5120
+    num_mel_bins: int = 128
+    max_source_positions: int = 1500
+    scale_embedding: bool = False
+    n_window: int = 50
+    n_window_infer: int = 400
+    downsample_hidden_size: int = 480
+    output_dim: int = 3584
+    activation_function: str = "gelu"
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.encoder_attention_heads
+
+    @property
+    def chunk_len(self) -> int:
+        return 2 * self.n_window
+
+    @property
+    def chunk_out_len(self) -> int:
+        """Conv time length of a full chunk."""
+        return _conv_out_len(_conv_out_len(_conv_out_len(self.chunk_len)))
+
+    @property
+    def freq_out(self) -> int:
+        f = self.num_mel_bins
+        for _ in range(3):
+            f = _conv_out_len(f)
+        return f
+
+
+@dataclass
+class Qwen3OmniMoeConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    vision: Qwen3VisionConfig = field(default_factory=Qwen3VisionConfig)
+    audio: Qwen3OmniAudioConfig = field(default_factory=Qwen3OmniAudioConfig)
+    image_token_id: int = 151655
+    video_token_id: int = 151656
+    audio_token_id: int = 151646
+    vision_start_token_id: int = 151652
+    audio_start_token_id: int = 151647
+    position_id_per_seconds: int = 13
+    freeze_vision: bool = False
+    freeze_audio: bool = False
+    model_type: str = "qwen3_omni_moe"
+
+    def __post_init__(self):
+        if isinstance(self.text, dict):
+            self.text = TransformerConfig(**self.text)
+        if isinstance(self.vision, dict):
+            self.vision = Qwen3VisionConfig(**self.vision)
+        if isinstance(self.audio, dict):
+            self.audio = Qwen3OmniAudioConfig(**self.audio)
+
+    def __getattr__(self, name):  # FlopsCounter / trainer surface
+        return getattr(object.__getattribute__(self, "text"), name)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_audio_params(rng: jax.Array, cfg: Qwen3OmniAudioConfig, dtype=jnp.float32):
+    s = cfg.initializer_range
+    d, f, L = cfg.d_model, cfg.encoder_ffn_dim, cfg.encoder_layers
+    ds = cfg.downsample_hidden_size
+    keys = iter(jax.random.split(rng, 16))
+
+    def init(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
+
+    return {
+        # conv kernels stored HWIO for lax.conv_general_dilated
+        "conv1_w": init(next(keys), (3, 3, 1, ds)),
+        "conv1_b": jnp.zeros((ds,), dtype),
+        "conv2_w": init(next(keys), (3, 3, ds, ds)),
+        "conv2_b": jnp.zeros((ds,), dtype),
+        "conv3_w": init(next(keys), (3, 3, ds, ds)),
+        "conv3_b": jnp.zeros((ds,), dtype),
+        "conv_out_w": init(next(keys), (ds * cfg.freq_out, d)),
+        "layers": {
+            "ln1_w": jnp.ones((L, d), dtype),
+            "ln1_b": jnp.zeros((L, d), dtype),
+            "q_w": init(next(keys), (L, d, d)),
+            "q_b": jnp.zeros((L, d), dtype),
+            "k_w": init(next(keys), (L, d, d)),
+            "k_b": jnp.zeros((L, d), dtype),
+            "v_w": init(next(keys), (L, d, d)),
+            "v_b": jnp.zeros((L, d), dtype),
+            "o_w": init(next(keys), (L, d, d)),
+            "o_b": jnp.zeros((L, d), dtype),
+            "ln2_w": jnp.ones((L, d), dtype),
+            "ln2_b": jnp.zeros((L, d), dtype),
+            "fc1_w": init(next(keys), (L, d, f)),
+            "fc1_b": jnp.zeros((L, f), dtype),
+            "fc2_w": init(next(keys), (L, f, d)),
+            "fc2_b": jnp.zeros((L, d), dtype),
+        },
+        "ln_post_w": jnp.ones((d,), dtype),
+        "ln_post_b": jnp.zeros((d,), dtype),
+        "proj1_w": init(next(keys), (d, d)),
+        "proj1_b": jnp.zeros((d,), dtype),
+        "proj2_w": init(next(keys), (d, cfg.output_dim)),
+        "proj2_b": jnp.zeros((cfg.output_dim,), dtype),
+    }
+
+
+def init_params(rng: jax.Array, cfg: Qwen3OmniMoeConfig) -> Dict[str, Any]:
+    r1, r2, r3 = jax.random.split(rng, 3)
+    pd = cfg.text.param_dtype
+    return {
+        "language_model": transformer.init_params(r1, cfg.text),
+        "vision_tower": qwen3_vl.init_vision_params(r2, cfg.vision, dtype=pd),
+        "audio_tower": init_audio_params(r3, cfg.audio, dtype=pd),
+    }
+
+
+def abstract_params(cfg: Qwen3OmniMoeConfig):
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+# ---------------------------------------------------------------------------
+# audio host-side plan
+# ---------------------------------------------------------------------------
+
+def audio_metadata(
+    feature_lens: Sequence[int],
+    cfg: Qwen3OmniAudioConfig,
+    n_chunk_pad: int,
+    n_frame_pad: int,
+) -> Dict[str, np.ndarray]:
+    """Static plan for a batch of audios (mel lengths ``feature_lens``).
+
+    Returns:
+    - ``chunk_lens`` [n_chunk_pad]: mel frames per chunk (0 = padding chunk);
+      the collator uses this to split/pad features into the chunk buffer;
+    - ``frame_gather`` [n_frame_pad]: (chunk, t) -> flat index into the
+      [n_chunk_pad * chunk_out_len] conv output picking valid frames (the
+      sinusoid position embedding is applied per chunk-local time index
+      before this gather, so no separate position array is needed);
+    - ``seg`` [n_frame_pad]: attention window segments (0 = padding);
+    - ``frame_mask`` [n_frame_pad]: valid frames (== audio placeholders).
+    """
+    cl, col = cfg.chunk_len, cfg.chunk_out_len
+    chunk_lens: List[int] = []
+    gather, seg = [], []
+    win_chunks = max(1, cfg.n_window_infer // cfg.chunk_len)
+    win_seg = 0
+    for mel_len in feature_lens:
+        n_chunks = -(-mel_len // cl)
+        start_chunk = len(chunk_lens)
+        n_frames_audio = 0
+        for c in range(n_chunks):
+            this = min(cl, mel_len - c * cl)
+            chunk_lens.append(this)
+            t = this
+            for _ in range(3):
+                t = _conv_out_len(t)
+            ci = start_chunk + c
+            if c % win_chunks == 0:
+                win_seg += 1
+            gather.append(np.arange(t) + ci * col)
+            seg.append(np.full(t, win_seg, np.int32))
+            n_frames_audio += t
+        expected = audio_output_lengths(mel_len)
+        if n_frames_audio != expected:
+            raise ValueError(
+                f"audio plan mismatch: conv yields {n_frames_audio} frames, "
+                f"placeholder formula says {expected} (mel_len={mel_len}, "
+                f"n_window={cfg.n_window}) — placeholder scatter would desync"
+            )
+    if len(chunk_lens) > n_chunk_pad:
+        raise ValueError(
+            f"{len(chunk_lens)} chunks exceed the static budget {n_chunk_pad}"
+        )
+    n = sum(len(g) for g in gather)
+    if n > n_frame_pad:
+        raise ValueError(f"{n} audio frames exceed the budget {n_frame_pad}")
+
+    def pad_to(x, size, fill=0):
+        out = np.full((size,), fill, np.int32)
+        out[: len(x)] = x
+        return out
+
+    return {
+        "chunk_lens": pad_to(np.asarray(chunk_lens, np.int32), n_chunk_pad),
+        "frame_gather": pad_to(
+            np.concatenate(gather).astype(np.int32) if gather
+            else np.zeros(0, np.int32), n_frame_pad),
+        "seg": pad_to(
+            np.concatenate(seg) if seg else np.zeros(0, np.int32), n_frame_pad),
+        "frame_mask": pad_to(
+            np.ones(n, np.int32), n_frame_pad).astype(bool),
+    }
+
+
+def pack_audio_chunks(
+    features: Sequence[np.ndarray],  # each [mel_bins, T]
+    cfg: Qwen3OmniAudioConfig,
+    n_chunk_pad: int,
+) -> np.ndarray:
+    """[n_chunk_pad, mel_bins, chunk_len] padded chunk buffer."""
+    cl = cfg.chunk_len
+    out = np.zeros((n_chunk_pad, cfg.num_mel_bins, cl), np.float32)
+    i = 0
+    for feat in features:
+        feat = np.asarray(feat, np.float32)
+        n_chunks = -(-feat.shape[1] // cl)
+        for c in range(n_chunks):
+            piece = feat[:, c * cl:(c + 1) * cl]
+            out[i, :, : piece.shape[1]] = piece
+            i += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# audio tower forward
+# ---------------------------------------------------------------------------
+
+from veomni_tpu.models.qwen2_5_omni import _layer_norm, _sinusoid_table
+
+
+def _conv2d_s2(x, w, b):
+    """x [N, H, W, C] -> stride-2 3x3 same-ish conv (torch padding=1)."""
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(2, 2), padding=((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + b
+
+
+def _audio_layer(x, lp, cfg: Qwen3OmniAudioConfig, seg):
+    n, d = x.shape
+    hd = cfg.head_dim
+    nh = cfg.encoder_attention_heads
+    y = _layer_norm(x, lp["ln1_w"], lp["ln1_b"])
+    q = (jnp.dot(y, lp["q_w"]) + lp["q_b"]).reshape(1, n, nh, hd)
+    k = (jnp.dot(y, lp["k_w"]) + lp["k_b"]).reshape(1, n, nh, hd)
+    v = (jnp.dot(y, lp["v_w"]) + lp["v_b"]).reshape(1, n, nh, hd)
+    attn = ops.attention(q, k, v, segment_ids=seg, causal=False)
+    x = x + jnp.dot(attn.reshape(n, d), lp["o_w"]) + lp["o_b"]
+    y = _layer_norm(x, lp["ln2_w"], lp["ln2_b"])
+    y = jax.nn.gelu(jnp.dot(y, lp["fc1_w"]) + lp["fc1_b"], approximate=False)
+    x = x + jnp.dot(y, lp["fc2_w"]) + lp["fc2_b"]
+    return x
+
+
+def audio_forward(
+    params, cfg: Qwen3OmniAudioConfig, chunk_feats, frame_gather,
+    seg, dtype=jnp.bfloat16,
+):
+    """chunk_feats [n_chunks, mel, chunk_len] -> features [n_frame_pad,
+    output_dim] (packed audio frames in audio order).
+
+    Runs under a no-SP scoped ParallelState like the vision tower."""
+    from veomni_tpu.parallel.parallel_state import (
+        get_parallel_state_or_none, use_parallel_state,
+    )
+
+    ps = get_parallel_state_or_none()
+    if ps is not None and ps.sp_enabled:
+        with use_parallel_state(ps.without_sp()):
+            return audio_forward(
+                params, cfg, chunk_feats, frame_gather, seg, dtype=dtype,
+            )
+    p = jax.tree.map(lambda t: t.astype(dtype), params)
+    # [n_chunks, mel, T] -> NHWC [n_chunks, mel, T, 1]
+    x = chunk_feats.astype(dtype)[..., None]
+    x = jax.nn.gelu(_conv2d_s2(x, p["conv1_w"], p["conv1_b"]), approximate=False)
+    x = jax.nn.gelu(_conv2d_s2(x, p["conv2_w"], p["conv2_b"]), approximate=False)
+    x = jax.nn.gelu(_conv2d_s2(x, p["conv3_w"], p["conv3_b"]), approximate=False)
+    # [n_chunks, mel', T', ds] -> [n_chunks, T', ds * mel'] (torch permutes
+    # NCHW [n, ds, mel', T'] to [n, T', ds, mel'] then flattens)
+    n_chunks, melp, tp, ds = x.shape
+    x = x.transpose(0, 2, 3, 1).reshape(n_chunks, tp, ds * melp)
+    x = jnp.dot(x, p["conv_out_w"])  # no bias
+    sin_tab = jnp.asarray(
+        _sinusoid_table(cfg.max_source_positions, cfg.d_model), dtype
+    )
+    x = x + sin_tab[:tp][None]
+    flat = x.reshape(n_chunks * tp, cfg.d_model)
+    x = flat[frame_gather]  # [n_frame_pad, d] packed valid frames
+
+    seg2 = seg[None]
+    body = partial(_audio_layer, cfg=cfg, seg=seg2)
+    stacked = p["layers"]
+    x, _ = jax.lax.scan(
+        lambda c, lp: (jax.checkpoint(body)(c, lp), None), x, stacked
+    )
+    x = _layer_norm(x, p["ln_post_w"], p["ln_post_b"])
+    x = jax.nn.gelu(jnp.dot(x, p["proj1_w"]) + p["proj1_b"], approximate=False)
+    return jnp.dot(x, p["proj2_w"]) + p["proj2_b"]
+
+
+# ---------------------------------------------------------------------------
+# position ids (numpy port of the thinker's get_rope_index)
+# ---------------------------------------------------------------------------
+
+def omni_position_ids(
+    input_ids: np.ndarray,
+    cfg: Qwen3OmniMoeConfig,
+    image_grid_thw: Sequence[Tuple[int, int, int]] = (),
+    video_grid_thw: Sequence[Tuple[int, int, int]] = (),
+    audio_lens: Sequence[int] = (),
+    second_per_grids: Optional[Sequence[float]] = None,
+) -> np.ndarray:
+    """input_ids [B, S] -> position_ids [B, 3, S].
+
+    Media spans are located by their placeholder runs; text and audio get
+    1D positions, vision spans 3D grid positions with t scaled by
+    ``position_id_per_seconds``. Not yet supported (the collator never
+    emits them): ``use_audio_in_video`` interleaving, and fractional video
+    ``second_per_grid`` values — HF keeps float positions there (e.g. t =
+    0, 6.5, 13 for spg=0.5); this port truncates to int64, so only integer
+    ``spg * position_id_per_seconds`` products match HF exactly."""
+    b, s = input_ids.shape
+    out = np.zeros((b, 3, s), np.int64)
+    img_it = iter(list(image_grid_thw))
+    vid_it = iter(list(zip(
+        video_grid_thw,
+        second_per_grids or [1.0] * len(video_grid_thw),
+    )))
+    aud_it = iter(list(audio_lens))
+    m = cfg.vision.spatial_merge_size
+    pps = cfg.position_id_per_seconds
+    for row in range(b):
+        ids = input_ids[row]
+        chunks: List[np.ndarray] = []
+        p = 0
+        st = 0
+        while p < s:
+            tok = ids[p]
+            if tok not in (cfg.image_token_id, cfg.video_token_id,
+                           cfg.audio_token_id):
+                p += 1
+                continue
+            st_idx = (chunks[-1].max() + 1) if chunks else 0
+            text_len = p - st
+            if text_len:
+                chunks.append(
+                    np.broadcast_to(np.arange(text_len), (3, text_len)) + st_idx
+                )
+                st_idx = chunks[-1].max() + 1
+            if tok == cfg.audio_token_id:
+                alen = audio_output_lengths(next(aud_it))
+                chunks.append(
+                    np.broadcast_to(np.arange(alen), (3, alen)) + st_idx
+                )
+                p += alen
+            else:
+                if tok == cfg.image_token_id:
+                    (t, h, w) = next(img_it)
+                    spg = 1.0
+                else:
+                    (t, h, w), spg = next(vid_it)
+                lt, lh, lw = t, h // m, w // m
+                t_idx = (np.arange(lt) * spg * pps).astype(np.int64)
+                t_idx = t_idx[:, None].repeat(lh * lw, 1).reshape(-1)
+                h_idx = np.tile(np.arange(lh)[None, :, None], (lt, 1, lw)).reshape(-1)
+                w_idx = np.tile(np.arange(lw)[None, None, :], (lt, lh, 1)).reshape(-1)
+                chunks.append(np.stack([t_idx, h_idx, w_idx]) + st_idx)
+                p += lt * lh * lw
+            st = p
+        if st < s:
+            st_idx = (chunks[-1].max() + 1) if chunks else 0
+            text_len = s - st
+            chunks.append(
+                np.broadcast_to(np.arange(text_len), (3, text_len)) + st_idx
+            )
+        out[row] = np.concatenate(chunks, axis=1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# loss
+# ---------------------------------------------------------------------------
+
+def loss_fn(params, cfg: Qwen3OmniMoeConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: text keys as qwen3_vl plus (all optional by shape):
+    ``pixel_values``/``vis_*`` (qwen3_vl contract) and ``audio_chunks``
+    [n_chunks, mel, chunk_len] + ``aud_frame_gather/aud_seg``
+    [n_frame_pad] + ``aud_frame_mask``."""
+    from veomni_tpu.models.qwen2_5_vl import merge_vision_features
+
+    tcfg = cfg.text
+    lm = params["language_model"]
+    embeds = lm["embed_tokens"].astype(tcfg.dtype)[batch["input_ids"]]
+
+    residuals = None
+    if "pixel_values" in batch:
+        vp = params["vision_tower"]
+        if cfg.freeze_vision:
+            vp = jax.lax.stop_gradient(vp)
+        feats, deepstack = qwen3_vl.vision_forward(
+            vp, cfg.vision, batch["pixel_values"], batch["vis_pos_hw"],
+            batch["vis_pos_interp_idx"], batch["vis_pos_interp_w"],
+            batch["vis_seg_full"], dtype=tcfg.dtype,
+        )
+        embeds = merge_vision_features(
+            embeds, batch["input_ids"], feats, batch["vis_merged_mask"],
+            cfg.image_token_id, cfg.video_token_id,
+        )
+        residuals = jax.vmap(
+            lambda f: qwen3_vl.scatter_vision_features(
+                batch["input_ids"], f, batch["vis_merged_mask"],
+                cfg.image_token_id, cfg.video_token_id, tcfg.hidden_size,
+                tcfg.dtype,
+            )
+        )(deepstack)
+
+    if "audio_chunks" in batch:
+        ap = params["audio_tower"]
+        if cfg.freeze_audio:
+            ap = jax.lax.stop_gradient(ap)
+        afeats = audio_forward(
+            ap, cfg.audio, batch["audio_chunks"], batch["aud_frame_gather"],
+            batch["aud_seg"], dtype=tcfg.dtype,
+        )
+        embeds = merge_vision_features(
+            embeds, batch["input_ids"], afeats, batch["aud_frame_mask"],
+            cfg.audio_token_id, cfg.audio_token_id,
+        )
+
+    hidden, moe_aux, moe_dropped = transformer.forward_hidden(
+        lm, tcfg, batch["input_ids"], batch["position_ids"],
+        batch.get("segment_ids"), inputs_embeds=embeds,
+        post_layer_residuals=residuals,
+    )
+    return transformer.head_loss(
+        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint io
+# ---------------------------------------------------------------------------
+
+_AUD_LAYER_MAP = [
+    ("ln1_w", "self_attn_layer_norm.weight", False),
+    ("ln1_b", "self_attn_layer_norm.bias", False),
+    ("q_w", "self_attn.q_proj.weight", True),
+    ("q_b", "self_attn.q_proj.bias", False),
+    ("k_w", "self_attn.k_proj.weight", True),
+    ("k_b", "self_attn.k_proj.bias", False),
+    ("v_w", "self_attn.v_proj.weight", True),
+    ("v_b", "self_attn.v_proj.bias", False),
+    ("o_w", "self_attn.out_proj.weight", True),
+    ("o_b", "self_attn.out_proj.bias", False),
+    ("ln2_w", "final_layer_norm.weight", False),
+    ("ln2_b", "final_layer_norm.bias", False),
+    ("fc1_w", "fc1.weight", True),
+    ("fc1_b", "fc1.bias", False),
+    ("fc2_w", "fc2.weight", True),
+    ("fc2_b", "fc2.bias", False),
+]
+
+_AUD_TOP_MAP = [
+    # (ours, hf name, conv kernel OIHW->HWIO | transpose | none)
+    ("conv1_w", "conv2d1.weight", "conv"),
+    ("conv1_b", "conv2d1.bias", None),
+    ("conv2_w", "conv2d2.weight", "conv"),
+    ("conv2_b", "conv2d2.bias", None),
+    ("conv3_w", "conv2d3.weight", "conv"),
+    ("conv3_b", "conv2d3.bias", None),
+    ("conv_out_w", "conv_out.weight", "t"),
+    ("ln_post_w", "ln_post.weight", None),
+    ("ln_post_b", "ln_post.bias", None),
+    ("proj1_w", "proj1.weight", "t"),
+    ("proj1_b", "proj1.bias", None),
+    ("proj2_w", "proj2.weight", "t"),
+    ("proj2_b", "proj2.bias", None),
+]
+
+
+def _strip_thinker(k: str) -> str:
+    return k[len("thinker."):] if k.startswith("thinker.") else k
+
+
+def _text_key_map(k: str) -> Optional[str]:
+    k = _strip_thinker(k)
+    if ".visual." in k or k.startswith("visual.") or "audio_tower." in k:
+        return None
+    return k.replace("model.language_model.", "model.").replace(
+        "language_model.model.", "model."
+    )
+
+
+_OMNI_MERGER_MAP = [
+    ("ln_w", "ln_q.weight", False),
+    ("ln_b", "ln_q.bias", False),
+    ("fc1_w", "mlp.0.weight", True),
+    ("fc1_b", "mlp.0.bias", False),
+    ("fc2_w", "mlp.2.weight", True),
+    ("fc2_b", "mlp.2.bias", False),
+]
+
+
+def hf_to_params(model_dir: str, cfg: Qwen3OmniMoeConfig, target_shardings=None):
+    from veomni_tpu.models import hf_io
+
+    pd = cfg.text.param_dtype
+    ts = target_shardings or {}
+
+    language_model = hf_io.hf_to_params(
+        model_dir, cfg.text, target_shardings=ts.get("language_model"),
+        key_map=_text_key_map,
+    )
+
+    lazy = hf_io.LazyHFTensors(model_dir)
+    alias: Dict[str, str] = {}
+    for k in lazy.keys():
+        sk = _strip_thinker(k)
+        if ".visual." in sk or sk.startswith("visual."):
+            alias[sk[sk.index("visual.") + len("visual."):]] = k
+        elif "audio_tower." in sk:
+            alias[sk[sk.index("audio_tower.") + len("audio_tower."):]] = k
+
+    def read(name: str) -> np.ndarray:
+        return np.asarray(lazy.read(alias[name]))
+
+    def place(tree_name, path, arr):
+        arr = jnp.asarray(np.ascontiguousarray(arr), pd)
+        sh = ts.get(tree_name)
+        if sh is None:
+            return arr
+        for p in path:
+            sh = sh[p]
+        return jax.device_put(arr, sh)
+
+    # vision tower: qwen3_vl layout with `merger_list` as the deepstack name
+    vcfg = cfg.vision
+    blocks: Dict[str, Any] = {}
+    for ours, suffix, transpose in qwen3_vl._VIS_BLOCK_MAP:
+        stacked = np.stack([
+            read(f"blocks.{i}.{suffix}").T if transpose
+            else read(f"blocks.{i}.{suffix}")
+            for i in range(vcfg.depth)
+        ])
+        blocks[ours] = place("vision_tower", ("blocks", ours), stacked)
+
+    def load_merger(prefix, path0, stack_range=None):
+        out = {}
+        for ours, suffix, transpose in _OMNI_MERGER_MAP:
+            if stack_range is None:
+                arr = read(f"{prefix}.{suffix}")
+                arr = arr.T if transpose else arr
+            else:
+                arr = np.stack([
+                    read(f"{prefix}.{i}.{suffix}").T if transpose
+                    else read(f"{prefix}.{i}.{suffix}")
+                    for i in stack_range
+                ])
+            out[ours] = place("vision_tower", path0 + (ours,), arr)
+        return out
+
+    K = len(vcfg.deepstack_visual_indexes)
+    vision_tower = {
+        "patch_embed_w": place(
+            "vision_tower", ("patch_embed_w",),
+            read("patch_embed.proj.weight").reshape(vcfg.hidden_size, -1).T,
+        ),
+        "patch_embed_b": place(
+            "vision_tower", ("patch_embed_b",), read("patch_embed.proj.bias")
+        ),
+        "pos_embed": place("vision_tower", ("pos_embed",), read("pos_embed.weight")),
+        "blocks": blocks,
+        "merger": load_merger("merger", ("merger",)),
+        "deepstack_mergers": load_merger(
+            "merger_list", ("deepstack_mergers",), range(K)
+        ),
+    }
+
+    acfg = cfg.audio
+    audio_tower: Dict[str, Any] = {}
+    for ours, hf_name, kind in _AUD_TOP_MAP:
+        arr = read(hf_name)
+        if kind == "conv":
+            arr = arr.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        elif kind == "t":
+            arr = arr.T
+        audio_tower[ours] = place("audio_tower", (ours,), arr)
+    layers: Dict[str, Any] = {}
+    for ours, suffix, transpose in _AUD_LAYER_MAP:
+        stacked = np.stack([
+            read(f"layers.{i}.{suffix}").T if transpose
+            else read(f"layers.{i}.{suffix}")
+            for i in range(acfg.encoder_layers)
+        ])
+        layers[ours] = place("audio_tower", ("layers", ours), stacked)
+    audio_tower["layers"] = layers
+
+    return {
+        "language_model": language_model,
+        "vision_tower": vision_tower,
+        "audio_tower": audio_tower,
+    }
+
+
+def params_to_hf(params, cfg: Qwen3OmniMoeConfig) -> Dict[str, np.ndarray]:
+    from veomni_tpu.models import hf_io
+
+    out: Dict[str, np.ndarray] = {}
+    out.update(hf_io.params_to_hf(params["language_model"], cfg.text))
+    vt = hf_io.gather_to_host(params["vision_tower"])
+    vcfg = cfg.vision
+    pfx = "visual"
+    out[f"{pfx}.patch_embed.proj.weight"] = vt["patch_embed_w"].T.reshape(
+        vcfg.hidden_size, vcfg.in_channels, vcfg.temporal_patch_size,
+        vcfg.patch_size, vcfg.patch_size,
+    )
+    out[f"{pfx}.patch_embed.proj.bias"] = vt["patch_embed_b"]
+    out[f"{pfx}.pos_embed.weight"] = vt["pos_embed"]
+    for ours, suffix, transpose in qwen3_vl._VIS_BLOCK_MAP:
+        for i in range(vcfg.depth):
+            x = vt["blocks"][ours][i]
+            out[f"{pfx}.blocks.{i}.{suffix}"] = x.T if transpose else x
+    for ours, suffix, transpose in _OMNI_MERGER_MAP:
+        x = vt["merger"][ours]
+        out[f"{pfx}.merger.{suffix}"] = x.T if transpose else x
+        for k in range(len(vcfg.deepstack_visual_indexes)):
+            xk = vt["deepstack_mergers"][ours][k]
+            out[f"{pfx}.merger_list.{k}.{suffix}"] = xk.T if transpose else xk
+
+    at = hf_io.gather_to_host(params["audio_tower"])
+    apfx = "audio_tower"
+    for ours, hf_name, kind in _AUD_TOP_MAP:
+        arr = at[ours]
+        if kind == "conv":
+            arr = arr.transpose(3, 2, 0, 1)  # HWIO -> OIHW
+        elif kind == "t":
+            arr = arr.T
+        out[f"{apfx}.{hf_name}"] = arr
+    for ours, suffix, transpose in _AUD_LAYER_MAP:
+        for i in range(cfg.audio.encoder_layers):
+            x = at["layers"][ours][i]
+            out[f"{apfx}.layers.{i}.{suffix}"] = x.T if transpose else x
+    return out
+
+
+def save_hf_checkpoint(params, cfg: Qwen3OmniMoeConfig, out_dir: str) -> None:
+    import json
+    import os
+
+    from safetensors.flax import save_file
+
+    tensors = params_to_hf(params, cfg)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    save_file({k: jnp.asarray(v) for k, v in tensors.items()},
+              os.path.join(out_dir, "model.safetensors"))
+    hf_cfg = {
+        "model_type": "qwen3_omni_moe_thinker",
+        "architectures": ["Qwen3OmniMoeThinkerForConditionalGeneration"],
+        "image_token_id": cfg.image_token_id,
+        "video_token_id": cfg.video_token_id,
+        "audio_token_id": cfg.audio_token_id,
+        "vision_start_token_id": cfg.vision_start_token_id,
+        "audio_start_token_id": cfg.audio_start_token_id,
+        "position_id_per_seconds": cfg.position_id_per_seconds,
+        "text_config": {**cfg.text.to_hf_config(),
+                        "model_type": "qwen3_omni_moe_text"},
+        "vision_config": {
+            "model_type": "qwen3_omni_moe_vision_encoder",
+            "depth": cfg.vision.depth,
+            "hidden_size": cfg.vision.hidden_size,
+            "intermediate_size": cfg.vision.intermediate_size,
+            "num_heads": cfg.vision.num_heads,
+            "in_channels": cfg.vision.in_channels,
+            "patch_size": cfg.vision.patch_size,
+            "temporal_patch_size": cfg.vision.temporal_patch_size,
+            "spatial_merge_size": cfg.vision.spatial_merge_size,
+            "out_hidden_size": cfg.vision.out_hidden_size,
+            "num_position_embeddings": cfg.vision.num_position_embeddings,
+            "deepstack_visual_indexes": list(cfg.vision.deepstack_visual_indexes),
+            "hidden_act": cfg.vision.hidden_act,
+        },
+        "audio_config": {
+            "model_type": "qwen3_omni_moe_audio_encoder",
+            "d_model": cfg.audio.d_model,
+            "encoder_layers": cfg.audio.encoder_layers,
+            "encoder_attention_heads": cfg.audio.encoder_attention_heads,
+            "encoder_ffn_dim": cfg.audio.encoder_ffn_dim,
+            "num_mel_bins": cfg.audio.num_mel_bins,
+            "max_source_positions": cfg.audio.max_source_positions,
+            "n_window": cfg.audio.n_window,
+            "n_window_infer": cfg.audio.n_window_infer,
+            "downsample_hidden_size": cfg.audio.downsample_hidden_size,
+            "output_dim": cfg.audio.output_dim,
+            "activation_function": cfg.audio.activation_function,
+        },
+    }
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3OmniMoeConfig:
+    """Accepts a full Qwen3OmniMoeConfig dict ({"thinker_config": ...}) or a
+    bare thinker config dict."""
+    thinker = hf.get("thinker_config") or hf
+    text_hf = dict(thinker.get("text_config") or {})
+    rs = dict(text_hf.get("rope_scaling") or {})
+    rs.setdefault("mrope_section", [24, 20, 20])
+    rs.setdefault("mrope_interleaved", True)
+    text_hf["rope_scaling"] = rs
+    text = TransformerConfig.from_hf_config(
+        {**text_hf, "model_type": "qwen3_moe"}, **overrides
+    )
+    vis_hf = dict(thinker.get("vision_config") or {})
+    vis_fields = set(Qwen3VisionConfig.__dataclass_fields__)
+    vision = Qwen3VisionConfig(
+        **{k: v for k, v in vis_hf.items() if k in vis_fields}
+    )
+    aud_hf = dict(thinker.get("audio_config") or {})
+    aud_fields = set(Qwen3OmniAudioConfig.__dataclass_fields__)
+    audio = Qwen3OmniAudioConfig(
+        **{k: v for k, v in aud_hf.items() if k in aud_fields}
+    )
+    get = lambda k, d: thinker.get(k, hf.get(k, d))
+    return Qwen3OmniMoeConfig(
+        text=text,
+        vision=vision,
+        audio=audio,
+        image_token_id=get("image_token_id", 151655),
+        video_token_id=get("video_token_id", 151656),
+        audio_token_id=get("audio_token_id", 151646),
+        vision_start_token_id=get("vision_start_token_id", 151652),
+        audio_start_token_id=get("audio_start_token_id", 151647),
+        position_id_per_seconds=get("position_id_per_seconds", 13),
+    )
+
+
+def parallel_plan(cfg):
+    """Text-subtree MoE rules under the composite prefix; towers replicate
+    (FSDP-sharded by the auto rules where profitable)."""
+    from veomni_tpu.parallel.parallel_plan import ParallelPlan
+
+    rules = {}
+    if cfg.text.is_moe:
+        rules[r"language_model\.layers\.experts\..*"] = ("ep", "ep_fsdp", None)
+        rules[r"language_model\.layers\.router$"] = ()
+    return ParallelPlan(rules=rules)
