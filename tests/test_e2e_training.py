@@ -186,3 +186,37 @@ def test_e2e_resume_exact_dynbsz_channels(tmp_path):
         data_kwargs={"channels": ["code", "web"]},
         data_overrides={"dyn_bsz": True, "channel_list": ["code", "web"]},
     )
+
+
+def test_e2e_eval_loop(tmp_path):
+    """Periodic evaluation: eval_loss computed from data.eval_path every
+    eval_steps and at train end (the reference's EvaluateCallback is an
+    empty TODO — ours runs)."""
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+    from veomni_tpu.trainer import TextTrainer
+
+    _write_dummy_data(tmp_path / "data.jsonl")
+    _write_dummy_data(tmp_path / "eval.jsonl")
+    args = _make_args(tmp_path, train_steps=4)
+    args.data.eval_path = str(tmp_path / "eval.jsonl")
+    args.train.eval_steps = 2
+    args.train.eval_batches = 2
+    destroy_parallel_state()
+    try:
+        trainer = TextTrainer(args)
+        seen = []
+        orig = trainer.evaluate
+
+        def spy():
+            loss = orig()
+            seen.append(loss)
+            return loss
+
+        trainer.evaluate = spy
+        ctl = trainer.train()
+        trainer.checkpointer.close()
+        assert len(seen) == 2  # steps 2 and 4 (train-end skips: 4 % 2 == 0)
+        assert all(np.isfinite(l) for l in seen)
+        assert "eval_loss" in ctl.metrics
+    finally:
+        destroy_parallel_state()

@@ -113,6 +113,10 @@ class TrainingArguments:
     auto_resume: bool = True
     max_ckpt_to_keep: int = 0
     async_save: bool = True
+    # evaluation (runs forward-only loss over data.eval_path; the reference's
+    # EvaluateCallback is a TODO stub — this one is real)
+    eval_steps: int = 0               # every N steps (0 = at train end only if eval_path set)
+    eval_batches: int = 32            # micro-batches per evaluation
     # observability
     log_steps: int = 1
     enable_profiling: bool = False

@@ -197,7 +197,26 @@ def _register_vlm_families():
     )
 
 
+def _register_diffusion_families():
+    from veomni_tpu.models import wan as wan_mod
+
+    MODEL_REGISTRY.register(
+        "wan_t2v",
+        ModelFamily(
+            model_type="wan_t2v",
+            config_cls=wan_mod.WanConfig,
+            init_params=wan_mod.init_params,
+            abstract_params=wan_mod.abstract_params,
+            loss_fn=wan_mod.loss_fn,
+            forward_logits=None,
+            hf_to_params=wan_mod.hf_to_params,
+            save_hf_checkpoint=wan_mod.save_hf_checkpoint,
+        ),
+    )
+
+
 _register_vlm_families()
+_register_diffusion_families()
 
 VLM_MODEL_TYPES = ("qwen2_vl", "qwen2_5_vl", "qwen3_vl", "qwen3_vl_moe")
 
@@ -324,6 +343,11 @@ def build_foundation_model(
             from veomni_tpu.models.qwen3_omni_moe import config_from_hf as q3o_from_hf
 
             config = q3o_from_hf(hf_dict, **config_overrides)
+        elif (hf_dict.get("model_type") == "wan_t2v"
+              or hf_dict.get("_class_name") == "WanTransformer3DModel"):
+            from veomni_tpu.models.wan import config_from_hf as wan_from_hf
+
+            config = wan_from_hf(hf_dict, **config_overrides)
         else:
             config = TransformerConfig.from_hf_config(hf_dict, **config_overrides)
     if config.model_type not in MODEL_REGISTRY:

@@ -765,6 +765,15 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3OmniMoeConfig:
     rs.setdefault("mrope_section", [24, 20, 20])
     rs.setdefault("mrope_interleaved", True)
     text_hf["rope_scaling"] = rs
+    # split composite-level overrides from text-config ones (cf.
+    # qwen2_5_omni.config_from_hf): passing freeze_*/model_type through to
+    # TransformerConfig would crash or silently change the text dialect
+    composite = {
+        k: overrides.pop(k)
+        for k in ("freeze_vision", "freeze_audio")
+        if k in overrides
+    }
+    overrides.pop("model_type", None)
     text = TransformerConfig.from_hf_config(
         {**text_hf, "model_type": "qwen3_moe"}, **overrides
     )
@@ -789,6 +798,7 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3OmniMoeConfig:
         vision_start_token_id=get("vision_start_token_id", 151652),
         audio_start_token_id=get("audio_start_token_id", 151647),
         position_id_per_seconds=get("position_id_per_seconds", 13),
+        **composite,
     )
 
 

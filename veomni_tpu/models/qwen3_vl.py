@@ -704,6 +704,10 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3VLConfig:
     rs = dict(text_hf.get("rope_scaling") or {})
     rs.setdefault("mrope_interleaved", True)  # qwen3-vl mrope is interleaved
     text_hf["rope_scaling"] = rs
+    composite = {
+        k: overrides.pop(k) for k in ("freeze_vision",) if k in overrides
+    }
+    overrides.pop("model_type", None)
     if moe:
         overrides.setdefault("expert_layout", "fused_chunked")
     text = TransformerConfig.from_hf_config(
@@ -719,4 +723,5 @@ def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen3VLConfig:
         video_token_id=hf.get("video_token_id", 151656),
         vision_start_token_id=hf.get("vision_start_token_id", 151652),
         model_type="qwen3_vl_moe" if moe else "qwen3_vl",
+        **composite,
     )

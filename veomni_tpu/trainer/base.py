@@ -373,6 +373,7 @@ class BaseTrainer:
             max_grad_norm=t.max_grad_norm,
             grad_mask=grad_mask,
         )
+        self._loss_fn = loss_fn  # forward-only reuse (evaluate)
         self.meter = EnvironMeter(
             flops_counter=FlopsCounter.from_config(model.config),
             world_size=ps.world_size,
@@ -391,6 +392,10 @@ class BaseTrainer:
             LoggingCallback(t.log_steps),
             CheckpointCallback(self.checkpointer, t.save_steps),
         ]
+        if self.args.data.eval_path:
+            from veomni_tpu.trainer.callbacks import EvaluateCallback
+
+            self.callbacks.append(EvaluateCallback(t.eval_steps))
         if self.args.data.channel_list:
             from veomni_tpu.train.channel_loss import ChannelLossCallback
 
@@ -439,6 +444,64 @@ class BaseTrainer:
             logger.info_rank0("resumed from checkpoint")
         return restored is not None, extra
 
+    # ------------------------------------------------------------- evaluation
+    def _build_eval_dataloader(self):
+        """Eval pipeline via the subclass's own dataset/dataloader builders
+        (same transform + collator contract as training)."""
+        saved = (self.dataset, self.dataloader, self.args.data.train_path)
+        self.args.data.train_path = self.args.data.eval_path
+        try:
+            self._build_dataset()
+            self._build_dataloader()
+            eval_dl = self.dataloader
+        finally:
+            self.dataset, self.dataloader, self.args.data.train_path = saved
+        return eval_dl
+
+    def _ship_batch(self, batch_np):
+        """Host batch -> globally-sharded device arrays (multihost-aware)."""
+        if jax.process_count() > 1:
+            return {
+                k: jax.make_array_from_process_local_data(
+                    self.batch_shardings[k], v
+                )
+                for k, v in batch_np.items() if k in self.batch_shardings
+            }
+        return {
+            k: jax.device_put(v, self.batch_shardings[k])
+            for k, v in batch_np.items() if k in self.batch_shardings
+        }
+
+    def evaluate(self) -> Optional[float]:
+        """Forward-only mean loss over ``eval_batches`` micro-batches of
+        data.eval_path (the reference's EvaluateCallback is an empty TODO —
+        ``trainer/callbacks/evaluate_callback.py:37`` — this one runs).
+
+        The eval dataloader is rebuilt per call: with the fixed seed it
+        yields the SAME deterministic slice every time, so eval_loss values
+        at different steps are comparable."""
+        if not self.args.data.eval_path:
+            return None
+        if not hasattr(self, "_eval_step"):
+            self._eval_step = jax.jit(
+                lambda params, batch: self._loss_fn(params, batch)
+            )
+        it = iter(self._build_eval_dataloader())
+        total, ntok = 0.0, 0.0
+        for _ in range(self.args.train.eval_batches):
+            try:
+                batch_np = next(it)
+            except StopIteration:
+                break
+            batch = self._ship_batch(batch_np)
+            # accum dim: evaluate micro-batch by micro-batch ([A,B,S] -> [B,S])
+            for a in range(next(iter(batch.values())).shape[0]):
+                micro = {k: v[a] for k, v in batch.items()}
+                loss_sum, metrics = self._eval_step(self.train_state.params, micro)
+                total += float(loss_sum)
+                ntok += float(metrics["ntokens"])
+        return total / max(ntok, 1.0)
+
     # ------------------------------------------------------------------ train
     def _fire(self, hook: str, state):
         for cb in self.callbacks:
@@ -453,20 +516,9 @@ class BaseTrainer:
                 batch_np = next(data_iter)
                 self.current_batch = batch_np
                 self._fire("on_step_begin", ctl)
-                if jax.process_count() > 1:
-                    # each process holds [A, B_local, S]; stitch into the
-                    # globally-sharded array (single-controller semantics)
-                    batch = {
-                        k: jax.make_array_from_process_local_data(
-                            self.batch_shardings[k], v
-                        )
-                        for k, v in batch_np.items() if k in self.batch_shardings
-                    }
-                else:
-                    batch = {
-                        k: jax.device_put(v, self.batch_shardings[k])
-                        for k, v in batch_np.items() if k in self.batch_shardings
-                    }
+                # each process holds [A, B_local, S]; stitch into the
+                # globally-sharded array (single-controller semantics)
+                batch = self._ship_batch(batch_np)
                 self.train_state, metrics = self.train_step(self.train_state, batch)
                 ctl.global_step += 1
                 ctl.metrics = {

@@ -133,6 +133,29 @@ class EnvironMeterCallback(Callback):
         state.metrics.update(self.meter.step())
 
 
+class EvaluateCallback(Callback):
+    """Periodic eval-set loss (reference EvaluateCallback is an empty TODO,
+    ``trainer/callbacks/evaluate_callback.py:37``; here it runs a real
+    forward-only pass over data.eval_path)."""
+
+    def __init__(self, eval_steps: int):
+        self.eval_steps = eval_steps
+
+    def _run(self, trainer, state):
+        loss = trainer.evaluate()
+        if loss is not None:
+            state.metrics["eval_loss"] = loss
+            logger.info_rank0("step %d | eval_loss=%.4g", state.global_step, loss)
+
+    def on_step_end(self, trainer, state):
+        if self.eval_steps and state.global_step % self.eval_steps == 0:
+            self._run(trainer, state)
+
+    def on_train_end(self, trainer, state):
+        if not self.eval_steps or state.global_step % self.eval_steps:
+            self._run(trainer, state)
+
+
 class CheckpointCallback(Callback):
     """Periodic sharded train-state save + exact resume
     (reference CheckpointerCallback, checkpoint_callback.py:35-170)."""

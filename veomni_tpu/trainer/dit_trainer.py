@@ -53,28 +53,81 @@ class DiTCollator:
             self._rng.bit_generator.state = state["rng_state"]
 
 
+class WanCollator:
+    """Rows {latents [C,F,H,W], text_states [Lt,text_dim]} -> batch with
+    sampled flow-match noise/timesteps (checkpointable numpy RNG)."""
+
+    def __init__(self, cfg, micro_batch_size: int,
+                 scheduler: FlowMatchScheduler, latent_shape, text_len: int,
+                 seed: int = 0):
+        self.cfg = cfg
+        self.micro_batch_size = micro_batch_size
+        self.scheduler = scheduler
+        self.latent_shape = tuple(latent_shape)  # (C, F, H, W)
+        self.text_len = text_len
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, samples) -> Dict[str, np.ndarray]:
+        b = self.micro_batch_size
+        x0 = np.zeros((b,) + self.latent_shape, np.float32)
+        text = np.zeros((b, self.text_len, self.cfg.text_dim), np.float32)
+        for i, s in enumerate(samples[:b]):
+            x0[i] = np.asarray(s["latents"], np.float32).reshape(self.latent_shape)
+            ts = np.asarray(s["text_states"], np.float32).reshape(-1, self.cfg.text_dim)
+            text[i, : min(len(ts), self.text_len)] = ts[: self.text_len]
+        t = self.scheduler.sample_timesteps(self._rng, b)
+        noise = self._rng.standard_normal(x0.shape).astype(np.float32)
+        return {
+            "latents": FlowMatchScheduler.add_noise(x0, noise, t),
+            "timestep": (t * 1000.0).astype(np.float32),
+            "text_states": text,
+            "target": FlowMatchScheduler.velocity_target(x0, noise),
+        }
+
+    def state_dict(self):
+        return {"rng_state": self._rng.bit_generator.state}
+
+    def load_state_dict(self, state):
+        if "rng_state" in state:
+            self._rng.bit_generator.state = state["rng_state"]
+
+
 class DiTTrainer(BaseTrainer):
     def _build_model(self):
         overrides = dict(self.args.model.config_overrides)
-        overrides.pop("model_type", None)
+        mt = overrides.pop("model_type", "")
         overrides.setdefault("dtype", self.args.train.compute_dtype)
         overrides["remat"] = self.args.train.enable_gradient_checkpointing
-        cfg = DiTConfig(**overrides)
         from veomni_tpu.models.auto import FoundationModel, ModelFamily
 
-        family = ModelFamily(
-            model_type="dit",
-            config_cls=DiTConfig,
-            init_params=init_dit_params,
-            abstract_params=abstract_dit_params,
-            loss_fn=dit_loss_fn,
-            forward_logits=None,
-            hf_to_params=None,
-            save_hf_checkpoint=self._save_native,
-        )
+        if mt == "wan_t2v" or self.args.model.model_type == "wan_t2v":
+            from veomni_tpu.models.auto import MODEL_REGISTRY
+            from veomni_tpu.models.wan import WanConfig
+
+            # collator geometry knobs, not model-config fields
+            self._latent_shape = tuple(overrides.pop("latent_shape", (16, 4, 16, 16)))
+            self._text_len = int(overrides.pop("text_len", 64))
+            cfg = WanConfig(**overrides)
+            family = MODEL_REGISTRY.get("wan_t2v")
+        else:
+            cfg = DiTConfig(**overrides)
+            family = ModelFamily(
+                model_type="dit",
+                config_cls=DiTConfig,
+                init_params=init_dit_params,
+                abstract_params=abstract_dit_params,
+                loss_fn=dit_loss_fn,
+                forward_logits=None,
+                hf_to_params=None,
+                save_hf_checkpoint=self._save_native,
+            )
         self.model = FoundationModel(config=cfg, family=family)
         self.tokenizer = None
         self.scheduler = FlowMatchScheduler()
+
+    @property
+    def _is_wan(self) -> bool:
+        return self.model.config.model_type == "wan_t2v"
 
     @staticmethod
     def _save_native(params, cfg, out_dir):
@@ -102,10 +155,18 @@ class DiTTrainer(BaseTrainer):
         self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
         nproc = jax.process_count()
         local_mb = t.micro_batch_size * ps.dp_size // nproc
+        if self._is_wan:
+            collator = WanCollator(
+                self.model.config, local_mb, self.scheduler,
+                latent_shape=self._latent_shape, text_len=self._text_len,
+                seed=t.seed,
+            )
+        else:
+            collator = DiTCollator(self.model.config, local_mb, self.scheduler, t.seed)
         self.dataloader = build_dataloader(
             self.args.data.dataloader_type,
             dataset=self.dataset,
-            collate_fn=DiTCollator(self.model.config, local_mb, self.scheduler, t.seed),
+            collate_fn=collator,
             micro_batch_size=local_mb,
             grad_accum_steps=self.grad_accum_steps,
             samples_per_micro_batch=local_mb,
@@ -117,6 +178,13 @@ class DiTTrainer(BaseTrainer):
 
     def _batch_sharding_map(self):
         ps = self.parallel_state
+        if self._is_wan:
+            return {
+                "latents": P(None, ps.dp_axes, None, None, None, None),
+                "timestep": P(None, ps.dp_axes),
+                "text_states": P(None, ps.dp_axes, None, None),
+                "target": P(None, ps.dp_axes, None, None, None, None),
+            }
         return {
             "latents": P(None, ps.dp_axes, None, None, None),
             "noise": P(None, ps.dp_axes, None, None, None),
