@@ -198,21 +198,25 @@ def _register_vlm_families():
 
 
 def _register_diffusion_families():
-    from veomni_tpu.models import wan as wan_mod
+    from veomni_tpu.models import qwen_image as qi_mod, wan as wan_mod
 
-    MODEL_REGISTRY.register(
-        "wan_t2v",
-        ModelFamily(
-            model_type="wan_t2v",
-            config_cls=wan_mod.WanConfig,
-            init_params=wan_mod.init_params,
-            abstract_params=wan_mod.abstract_params,
-            loss_fn=wan_mod.loss_fn,
-            forward_logits=None,
-            hf_to_params=wan_mod.hf_to_params,
-            save_hf_checkpoint=wan_mod.save_hf_checkpoint,
-        ),
-    )
+    for mt, mod, cfg_cls in (
+        ("wan_t2v", wan_mod, wan_mod.WanConfig),
+        ("qwen_image", qi_mod, qi_mod.QwenImageConfig),
+    ):
+        MODEL_REGISTRY.register(
+            mt,
+            ModelFamily(
+                model_type=mt,
+                config_cls=cfg_cls,
+                init_params=mod.init_params,
+                abstract_params=mod.abstract_params,
+                loss_fn=mod.loss_fn,
+                forward_logits=None,
+                hf_to_params=mod.hf_to_params,
+                save_hf_checkpoint=mod.save_hf_checkpoint,
+            ),
+        )
 
 
 _register_vlm_families()
@@ -348,6 +352,11 @@ def build_foundation_model(
             from veomni_tpu.models.wan import config_from_hf as wan_from_hf
 
             config = wan_from_hf(hf_dict, **config_overrides)
+        elif (hf_dict.get("model_type") == "qwen_image"
+              or hf_dict.get("_class_name") == "QwenImageTransformer2DModel"):
+            from veomni_tpu.models.qwen_image import config_from_hf as qi_from_hf
+
+            config = qi_from_hf(hf_dict, **config_overrides)
         else:
             config = TransformerConfig.from_hf_config(hf_dict, **config_overrides)
     if config.model_type not in MODEL_REGISTRY:

@@ -1,0 +1,471 @@
+"""Qwen-Image MMDiT (real architecture).
+
+Reference: ``veomni/models/diffusers/qwen_image/`` (wraps diffusers
+``QwenImageTransformer2DModel`` with an SP-patched forward —
+``modeling_qwen_image_transformer.py:166-312`` documents the model flow this
+module re-implements TPU-first):
+
+* ``img_in``: linear over pre-patchified latents (in_channels = C * p * p);
+  ``txt_norm`` (RMSNorm) + ``txt_in`` linear over the text-encoder states;
+* ``time_text_embed``: sinusoidal timesteps -> SiLU MLP -> ``temb``;
+* dual-stream (MMDiT / flux-style) blocks: per-stream 6-way modulation
+  (SiLU + linear on ``temb``), affine-free LayerNorms, **joint attention**
+  over the concatenated [text, image] streams (per-head q/k RMSNorm on both
+  streams, 3-axis rope on image tokens and a trailing 1-D range on text
+  tokens), per-stream output projections and 4x gelu-tanh MLPs;
+* output head: adaLN-continuous (SiLU + linear -> scale/shift over an
+  affine-free LayerNorm) + linear to the patch dim.
+
+Objective: flow-matching MSE on the image stream (same contract as wan.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu import ops
+
+
+@dataclass
+class QwenImageConfig:
+    """``QwenImageTransformer2DModelConfig`` surface (defaults = 20B)."""
+
+    patch_size: int = 2
+    in_channels: int = 64          # pre-patchified: latent C * p * p
+    out_channels: int = 16
+    num_layers: int = 60
+    attention_head_dim: int = 128
+    num_attention_heads: int = 24
+    joint_attention_dim: int = 3584
+    axes_dims_rope: Tuple[int, int, int] = (16, 56, 56)
+    # static latent grid (frame, h, w) for the rope plan; () = infer a
+    # square single-frame grid from the token count
+    img_shape: Tuple[int, int, int] = ()
+    rope_theta: float = 10000.0
+    eps: float = 1e-6
+    initializer_range: float = 0.02
+    model_type: str = "qwen_image"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    def __post_init__(self):
+        self.axes_dims_rope = tuple(self.axes_dims_rope)
+        self.img_shape = tuple(self.img_shape)
+        for f in ("dtype", "param_dtype"):
+            v = getattr(self, f)
+            if isinstance(v, str):
+                setattr(self, f, getattr(jnp, v))
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_attention_heads * self.attention_head_dim
+
+    @property
+    def proj_dim(self) -> int:
+        return self.patch_size ** 2 * self.out_channels
+
+
+def init_params(rng: jax.Array, cfg: QwenImageConfig) -> Dict[str, Any]:
+    s = cfg.initializer_range
+    d, L = cfg.inner_dim, cfg.num_layers
+    keys = iter(jax.random.split(rng, 32))
+    pd = cfg.param_dtype
+
+    def init(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(pd)
+
+    def stream_attn(key, prefix_dim):
+        ks = jax.random.split(key, 4)
+        return {
+            "q_w": init(ks[0], (L, prefix_dim, d)), "q_b": jnp.zeros((L, d), pd),
+            "k_w": init(ks[1], (L, prefix_dim, d)), "k_b": jnp.zeros((L, d), pd),
+            "v_w": init(ks[2], (L, prefix_dim, d)), "v_b": jnp.zeros((L, d), pd),
+            "o_w": init(ks[3], (L, d, d)), "o_b": jnp.zeros((L, d), pd),
+            "norm_q": jnp.ones((L, cfg.attention_head_dim), pd),
+            "norm_k": jnp.ones((L, cfg.attention_head_dim), pd),
+        }
+
+    def mlp(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "fc1_w": init(k1, (L, d, 4 * d)), "fc1_b": jnp.zeros((L, 4 * d), pd),
+            "fc2_w": init(k2, (L, 4 * d, d)), "fc2_b": jnp.zeros((L, d), pd),
+        }
+
+    return {
+        "img_in_w": init(next(keys), (cfg.in_channels, d)),
+        "img_in_b": jnp.zeros((d,), pd),
+        "txt_norm": jnp.ones((cfg.joint_attention_dim,), pd),
+        "txt_in_w": init(next(keys), (cfg.joint_attention_dim, d)),
+        "txt_in_b": jnp.zeros((d,), pd),
+        "time_embedder": {
+            "fc1_w": init(next(keys), (256, d)), "fc1_b": jnp.zeros((d,), pd),
+            "fc2_w": init(next(keys), (d, d)), "fc2_b": jnp.zeros((d,), pd),
+        },
+        "blocks": {
+            "img_mod_w": init(next(keys), (L, d, 6 * d)),
+            "img_mod_b": jnp.zeros((L, 6 * d), pd),
+            "txt_mod_w": init(next(keys), (L, d, 6 * d)),
+            "txt_mod_b": jnp.zeros((L, 6 * d), pd),
+            "img_attn": stream_attn(next(keys), d),
+            "txt_attn": stream_attn(next(keys), d),
+            "img_mlp": mlp(next(keys)),
+            "txt_mlp": mlp(next(keys)),
+        },
+        "norm_out_w": init(next(keys), (d, 2 * d)),
+        "norm_out_b": jnp.zeros((2 * d,), pd),
+        "proj_out_w": init(next(keys), (d, cfg.proj_dim)),
+        "proj_out_b": jnp.zeros((cfg.proj_dim,), pd),
+    }
+
+
+def abstract_params(cfg: QwenImageConfig):
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+# ---------------------------------------------------------------------------
+# rope plan
+# ---------------------------------------------------------------------------
+
+def rope_plan(cfg: QwenImageConfig, img_shape: Tuple[int, int, int], txt_len: int):
+    """(cos, sin) [1, txt_len + f*h*w, head_dim] in joint [text, image]
+    order — diffusers ``QwenEmbedRope`` with ``scale_rope=True``: image
+    row/col positions are centered around zero (rows span
+    ``[-(h - h//2), h//2)``), frames start at 0, and text tokens carry a
+    1-D range starting at ``max(h//2, w//2)`` on every axis."""
+    f, h, w = img_shape
+    dims = cfg.axes_dims_rope
+
+    def axis_ang(pos, dim):
+        inv = 1.0 / (cfg.rope_theta ** (np.arange(0, dim, 2) / dim))
+        return np.repeat(pos[:, None] * inv[None, :], 2, axis=1)
+
+    fpos = np.arange(f)
+    hpos = np.arange(h) - (h - h // 2)
+    wpos = np.arange(w) - (w - w // 2)
+    ff, hh, ww = np.meshgrid(fpos, hpos, wpos, indexing="ij")
+    img_ang = np.concatenate([
+        axis_ang(ff.reshape(-1), dims[0]),
+        axis_ang(hh.reshape(-1), dims[1]),
+        axis_ang(ww.reshape(-1), dims[2]),
+    ], axis=1)
+    start = max(h // 2, w // 2)
+    tpos = np.arange(start, start + txt_len)
+    txt_ang = np.concatenate([axis_ang(tpos, dim) for dim in dims], axis=1)
+    ang = np.concatenate([txt_ang, img_ang], axis=0)[None]
+    return jnp.cos(ang).astype(jnp.float32), jnp.sin(ang).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _ln_noaffine(x, eps):
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps)
+
+
+def _rms(x, w, eps):
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt((x * x).mean(-1, keepdims=True) + eps)
+    return (x * w).astype(dt)
+
+
+def _qkv(x, ap, cfg: QwenImageConfig):
+    b, n, _ = x.shape
+    nh, hd = cfg.num_attention_heads, cfg.attention_head_dim
+    q = (jnp.dot(x, ap["q_w"]) + ap["q_b"]).reshape(b, n, nh, hd)
+    k = (jnp.dot(x, ap["k_w"]) + ap["k_b"]).reshape(b, n, nh, hd)
+    v = (jnp.dot(x, ap["v_w"]) + ap["v_b"]).reshape(b, n, nh, hd)
+    q = _rms(q, ap["norm_q"], cfg.eps)
+    k = _rms(k, ap["norm_k"], cfg.eps)
+    return q, k, v
+
+
+def _mod6(temb, w, b):
+    """SiLU + linear -> [B, 1, 6D] f32 -> six [B,1,D] streams."""
+    m = jnp.dot(jax.nn.silu(temb), w) + b
+    return jnp.split(m.astype(jnp.float32)[:, None, :], 6, axis=-1)
+
+
+def _block(carry, lp, cfg: QwenImageConfig, temb, cos, sin, txt_seg, img_seg):
+    img, txt = carry
+    sh1_i, sc1_i, g1_i, sh2_i, sc2_i, g2_i = _mod6(temb, lp["img_mod_w"], lp["img_mod_b"])
+    sh1_t, sc1_t, g1_t, sh2_t, sc2_t, g2_t = _mod6(temb, lp["txt_mod_w"], lp["txt_mod_b"])
+
+    img_n = (_ln_noaffine(img, cfg.eps) * (1 + sc1_i) + sh1_i).astype(img.dtype)
+    txt_n = (_ln_noaffine(txt, cfg.eps) * (1 + sc1_t) + sh1_t).astype(txt.dtype)
+
+    qi, ki, vi = _qkv(img_n, lp["img_attn"], cfg)
+    qt, kt, vt = _qkv(txt_n, lp["txt_attn"], cfg)
+    # joint order [text, image]
+    q = jnp.concatenate([qt, qi], axis=1)
+    k = jnp.concatenate([kt, ki], axis=1)
+    v = jnp.concatenate([vt, vi], axis=1)
+    q, k = ops.apply_rotary(q, k, cos, sin, interleaved=True)
+    seg = jnp.concatenate([txt_seg, img_seg], axis=1)
+    o = ops.attention(q, k, v, segment_ids=seg, causal=False)
+    nt = txt.shape[1]
+    b = img.shape[0]
+    ot = o[:, :nt].reshape(b, nt, -1)
+    oi = o[:, nt:].reshape(b, img.shape[1], -1)
+    oi = jnp.dot(oi, lp["img_attn"]["o_w"]) + lp["img_attn"]["o_b"]
+    ot = jnp.dot(ot, lp["txt_attn"]["o_w"]) + lp["txt_attn"]["o_b"]
+    img = (img.astype(jnp.float32) + oi.astype(jnp.float32) * g1_i).astype(img.dtype)
+    txt = (txt.astype(jnp.float32) + ot.astype(jnp.float32) * g1_t).astype(txt.dtype)
+
+    def stream_mlp(x, mp, sh, sc, g):
+        xn = (_ln_noaffine(x, cfg.eps) * (1 + sc) + sh).astype(x.dtype)
+        y = jnp.dot(xn, mp["fc1_w"]) + mp["fc1_b"]
+        y = jax.nn.gelu(y, approximate=True)
+        y = jnp.dot(y, mp["fc2_w"]) + mp["fc2_b"]
+        return (x.astype(jnp.float32) + y.astype(jnp.float32) * g).astype(x.dtype)
+
+    img = stream_mlp(img, lp["img_mlp"], sh2_i, sc2_i, g2_i)
+    txt = stream_mlp(txt, lp["txt_mlp"], sh2_t, sc2_t, g2_t)
+    return img, txt
+
+
+def _timestep_embedding(t, dim: int = 256):
+    half = dim // 2
+    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+def qwen_image_forward(params, cfg: QwenImageConfig, latents, timestep,
+                       text_states, text_mask=None,
+                       img_shape: Tuple[int, int, int] = None):
+    """latents [B, N_img, in_channels] (pre-patchified, N_img = f*h*w of
+    ``img_shape``); timestep [B]; text_states [B, Lt, joint_dim];
+    text_mask [B, Lt] (1 = real token) -> prediction [B, N_img, proj_dim]."""
+    p = jax.tree.map(lambda t: t.astype(cfg.dtype), params)
+    b, n_img, _ = latents.shape
+    lt = text_states.shape[1]
+    if img_shape is None:
+        side = int(round(n_img ** 0.5))
+        img_shape = (1, side, side)
+
+    img = jnp.dot(latents.astype(cfg.dtype), p["img_in_w"]) + p["img_in_b"]
+    txt = _rms(text_states.astype(cfg.dtype), p["txt_norm"], cfg.eps)
+    txt = jnp.dot(txt, p["txt_in_w"]) + p["txt_in_b"]
+
+    te = p["time_embedder"]
+    temb = _timestep_embedding(timestep).astype(cfg.dtype)
+    temb = jnp.dot(temb, te["fc1_w"]) + te["fc1_b"]
+    temb = jnp.dot(jax.nn.silu(temb), te["fc2_w"]) + te["fc2_b"]  # [B, D]
+
+    cos, sin = rope_plan(cfg, img_shape, lt)
+    img_seg = jnp.ones((b, n_img), jnp.int32)
+    txt_seg = (
+        text_mask.astype(jnp.int32) if text_mask is not None
+        else jnp.ones((b, lt), jnp.int32)
+    )
+
+    body = partial(_block, cfg=cfg, temb=temb, cos=cos, sin=sin,
+                   txt_seg=txt_seg, img_seg=img_seg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    (img, txt), _ = jax.lax.scan(
+        lambda c, lp: (body(c, lp), None), (img, txt), p["blocks"]
+    )
+
+    # adaLN-continuous output head
+    mod = jnp.dot(jax.nn.silu(temb), p["norm_out_w"]) + p["norm_out_b"]
+    scale, shift = jnp.split(mod.astype(jnp.float32)[:, None, :], 2, axis=-1)
+    img = (_ln_noaffine(img, cfg.eps) * (1 + scale) + shift).astype(img.dtype)
+    return jnp.dot(img, p["proj_out_w"]) + p["proj_out_b"]
+
+
+def loss_fn(params, cfg: QwenImageConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: latents [B,N,in_channels] (noisy), timestep [B], text_states
+    [B,Lt,joint_dim], text_mask [B,Lt], target [B,N,proj_dim]."""
+    pred = qwen_image_forward(
+        params, cfg, batch["latents"], batch["timestep"],
+        batch["text_states"], batch.get("text_mask"),
+        img_shape=cfg.img_shape or None,
+    )
+    err = (pred.astype(jnp.float32) - batch["target"].astype(jnp.float32)) ** 2
+    per_sample = err.reshape(err.shape[0], -1).mean(axis=1)
+    loss = per_sample.mean()
+    n = jnp.int32(err.shape[0])
+    return loss * n, {"loss": loss, "ntokens": n, "mse_loss": loss}
+
+
+# ---------------------------------------------------------------------------
+# diffusers-format checkpoint io
+# ---------------------------------------------------------------------------
+
+_STREAM_ATTN_MAP = {
+    "img_attn": [
+        ("q_w", "attn.to_q.weight", True), ("q_b", "attn.to_q.bias", False),
+        ("k_w", "attn.to_k.weight", True), ("k_b", "attn.to_k.bias", False),
+        ("v_w", "attn.to_v.weight", True), ("v_b", "attn.to_v.bias", False),
+        ("o_w", "attn.to_out.0.weight", True), ("o_b", "attn.to_out.0.bias", False),
+        ("norm_q", "attn.norm_q.weight", False),
+        ("norm_k", "attn.norm_k.weight", False),
+    ],
+    "txt_attn": [
+        ("q_w", "attn.add_q_proj.weight", True), ("q_b", "attn.add_q_proj.bias", False),
+        ("k_w", "attn.add_k_proj.weight", True), ("k_b", "attn.add_k_proj.bias", False),
+        ("v_w", "attn.add_v_proj.weight", True), ("v_b", "attn.add_v_proj.bias", False),
+        ("o_w", "attn.to_add_out.weight", True), ("o_b", "attn.to_add_out.bias", False),
+        ("norm_q", "attn.norm_added_q.weight", False),
+        ("norm_k", "attn.norm_added_k.weight", False),
+    ],
+}
+
+_BLOCK_MAP = [
+    ("img_mod_w", "img_mod.1.weight", True), ("img_mod_b", "img_mod.1.bias", False),
+    ("txt_mod_w", "txt_mod.1.weight", True), ("txt_mod_b", "txt_mod.1.bias", False),
+    ("img_mlp.fc1_w", "img_mlp.net.0.proj.weight", True),
+    ("img_mlp.fc1_b", "img_mlp.net.0.proj.bias", False),
+    ("img_mlp.fc2_w", "img_mlp.net.2.weight", True),
+    ("img_mlp.fc2_b", "img_mlp.net.2.bias", False),
+    ("txt_mlp.fc1_w", "txt_mlp.net.0.proj.weight", True),
+    ("txt_mlp.fc1_b", "txt_mlp.net.0.proj.bias", False),
+    ("txt_mlp.fc2_w", "txt_mlp.net.2.weight", True),
+    ("txt_mlp.fc2_b", "txt_mlp.net.2.bias", False),
+]
+
+_TOP_MAP = [
+    ("img_in_w", "img_in.weight", True), ("img_in_b", "img_in.bias", False),
+    ("txt_norm", "txt_norm.weight", False),
+    ("txt_in_w", "txt_in.weight", True), ("txt_in_b", "txt_in.bias", False),
+    ("time_embedder.fc1_w",
+     "time_text_embed.timestep_embedder.linear_1.weight", True),
+    ("time_embedder.fc1_b",
+     "time_text_embed.timestep_embedder.linear_1.bias", False),
+    ("time_embedder.fc2_w",
+     "time_text_embed.timestep_embedder.linear_2.weight", True),
+    ("time_embedder.fc2_b",
+     "time_text_embed.timestep_embedder.linear_2.bias", False),
+    ("norm_out_w", "norm_out.linear.weight", True),
+    ("norm_out_b", "norm_out.linear.bias", False),
+    ("proj_out_w", "proj_out.weight", True),
+    ("proj_out_b", "proj_out.bias", False),
+]
+
+
+def _get(tree, dotted):
+    for part in dotted.split("."):
+        tree = tree[part]
+    return tree
+
+
+def _set(tree, dotted, v):
+    parts = dotted.split(".")
+    for part in parts[:-1]:
+        tree = tree.setdefault(part, {})
+    tree[parts[-1]] = v
+
+
+def hf_to_params(model_dir: str, cfg: QwenImageConfig, target_shardings=None):
+    from veomni_tpu.models import hf_io
+
+    lazy = hf_io.LazyHFTensors(model_dir)
+    pd = cfg.param_dtype
+
+    def read(name):
+        return np.asarray(lazy.read(name))
+
+    def place(path, arr):
+        arr = jnp.asarray(np.ascontiguousarray(arr), pd)
+        if target_shardings is None:
+            return arr
+        return jax.device_put(arr, _get(target_shardings, path))
+
+    params: Dict[str, Any] = {}
+    for ours, hf, transpose in _TOP_MAP:
+        arr = read(hf)
+        _set(params, ours, place(ours, arr.T if transpose else arr))
+
+    L = cfg.num_layers
+
+    def stack(tmpl, transform):
+        return np.stack([
+            transform(read(tmpl.format(i=i))) for i in range(L)
+        ])
+
+    blocks: Dict[str, Any] = {}
+    for which, mapping in _STREAM_ATTN_MAP.items():
+        sub = {}
+        for ours, hf, transpose in mapping:
+            sub[ours] = place(
+                f"blocks.{which}.{ours}",
+                stack(f"transformer_blocks.{{i}}.{hf}",
+                      (lambda a: a.T) if transpose else (lambda a: a)),
+            )
+        blocks[which] = sub
+    for ours, hf, transpose in _BLOCK_MAP:
+        _set(blocks, ours, place(
+            f"blocks.{ours}",
+            stack(f"transformer_blocks.{{i}}.{hf}",
+                  (lambda a: a.T) if transpose else (lambda a: a)),
+        ))
+    params["blocks"] = blocks
+    return params
+
+
+def params_to_hf(params, cfg: QwenImageConfig) -> Dict[str, np.ndarray]:
+    from veomni_tpu.models import hf_io
+
+    host = hf_io.gather_to_host(params)
+    out: Dict[str, np.ndarray] = {}
+    for ours, hf, transpose in _TOP_MAP:
+        arr = _get(host, ours)
+        out[hf] = arr.T if transpose else arr
+    for i in range(cfg.num_layers):
+        for which, mapping in _STREAM_ATTN_MAP.items():
+            for ours, hf, transpose in mapping:
+                arr = host["blocks"][which][ours][i]
+                out[f"transformer_blocks.{i}.{hf}"] = arr.T if transpose else arr
+        for ours, hf, transpose in _BLOCK_MAP:
+            arr = _get(host["blocks"], ours)[i]
+            out[f"transformer_blocks.{i}.{hf}"] = arr.T if transpose else arr
+    return out
+
+
+def save_hf_checkpoint(params, cfg: QwenImageConfig, out_dir: str) -> None:
+    import json
+    import os
+
+    from safetensors.flax import save_file
+
+    tensors = params_to_hf(params, cfg)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    save_file({k: jnp.asarray(v) for k, v in tensors.items()},
+              os.path.join(out_dir, "diffusion_pytorch_model.safetensors"))
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "_class_name": "QwenImageTransformer2DModel",
+            "model_type": "qwen_image",
+            "patch_size": cfg.patch_size,
+            "in_channels": cfg.in_channels,
+            "out_channels": cfg.out_channels,
+            "num_layers": cfg.num_layers,
+            "attention_head_dim": cfg.attention_head_dim,
+            "num_attention_heads": cfg.num_attention_heads,
+            "joint_attention_dim": cfg.joint_attention_dim,
+            "axes_dims_rope": list(cfg.axes_dims_rope),
+        }, f, indent=2)
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> QwenImageConfig:
+    fields = set(QwenImageConfig.__dataclass_fields__)
+    kw = {k: v for k, v in hf.items() if k in fields}
+    kw.update(overrides)
+    kw["model_type"] = "qwen_image"
+    return QwenImageConfig(**kw)

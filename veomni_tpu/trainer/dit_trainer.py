@@ -63,24 +63,33 @@ class WanCollator:
         self.cfg = cfg
         self.micro_batch_size = micro_batch_size
         self.scheduler = scheduler
-        self.latent_shape = tuple(latent_shape)  # (C, F, H, W)
+        self.latent_shape = tuple(latent_shape)  # wan: (C,F,H,W); qwen_image: (N, in_ch)
         self.text_len = text_len
+        # wan conditions on T5 states (text_dim); qwen_image on Qwen2.5-VL
+        # states (joint_attention_dim)
+        self.text_dim = getattr(cfg, "text_dim", 0) or cfg.joint_attention_dim
         self._rng = np.random.default_rng(seed)
 
     def __call__(self, samples) -> Dict[str, np.ndarray]:
         b = self.micro_batch_size
         x0 = np.zeros((b,) + self.latent_shape, np.float32)
-        text = np.zeros((b, self.text_len, self.cfg.text_dim), np.float32)
+        text = np.zeros((b, self.text_len, self.text_dim), np.float32)
+        mask = np.zeros((b, self.text_len), np.int32)
         for i, s in enumerate(samples[:b]):
             x0[i] = np.asarray(s["latents"], np.float32).reshape(self.latent_shape)
-            ts = np.asarray(s["text_states"], np.float32).reshape(-1, self.cfg.text_dim)
-            text[i, : min(len(ts), self.text_len)] = ts[: self.text_len]
+            ts = np.asarray(s["text_states"], np.float32).reshape(-1, self.text_dim)
+            n = min(len(ts), self.text_len)
+            text[i, :n] = ts[:n]
+            mask[i, :n] = 1
         t = self.scheduler.sample_timesteps(self._rng, b)
         noise = self._rng.standard_normal(x0.shape).astype(np.float32)
         return {
             "latents": FlowMatchScheduler.add_noise(x0, noise, t),
             "timestep": (t * 1000.0).astype(np.float32),
             "text_states": text,
+            # padded text positions must not join the joint attention
+            # (qwen_image reads it; wan ignores unmasked padding upstream)
+            "text_mask": mask,
             "target": FlowMatchScheduler.velocity_target(x0, noise),
         }
 
@@ -100,15 +109,15 @@ class DiTTrainer(BaseTrainer):
         overrides["remat"] = self.args.train.enable_gradient_checkpointing
         from veomni_tpu.models.auto import FoundationModel, ModelFamily
 
-        if mt == "wan_t2v" or self.args.model.model_type == "wan_t2v":
+        req_mt = mt or self.args.model.model_type
+        if req_mt in ("wan_t2v", "qwen_image"):
             from veomni_tpu.models.auto import MODEL_REGISTRY
-            from veomni_tpu.models.wan import WanConfig
 
             # collator geometry knobs, not model-config fields
             self._latent_shape = tuple(overrides.pop("latent_shape", (16, 4, 16, 16)))
             self._text_len = int(overrides.pop("text_len", 64))
-            cfg = WanConfig(**overrides)
-            family = MODEL_REGISTRY.get("wan_t2v")
+            family = MODEL_REGISTRY.get(req_mt)
+            cfg = family.config_cls(**overrides)
         else:
             cfg = DiTConfig(**overrides)
             family = ModelFamily(
@@ -127,7 +136,7 @@ class DiTTrainer(BaseTrainer):
 
     @property
     def _is_wan(self) -> bool:
-        return self.model.config.model_type == "wan_t2v"
+        return self.model.config.model_type in ("wan_t2v", "qwen_image")
 
     @staticmethod
     def _save_native(params, cfg, out_dir):
@@ -179,11 +188,13 @@ class DiTTrainer(BaseTrainer):
     def _batch_sharding_map(self):
         ps = self.parallel_state
         if self._is_wan:
+            lat = (None,) * len(self._latent_shape)
             return {
-                "latents": P(None, ps.dp_axes, None, None, None, None),
+                "latents": P(None, ps.dp_axes, *lat),
                 "timestep": P(None, ps.dp_axes),
                 "text_states": P(None, ps.dp_axes, None, None),
-                "target": P(None, ps.dp_axes, None, None, None, None),
+                "text_mask": P(None, ps.dp_axes, None),
+                "target": P(None, ps.dp_axes, *lat),
             }
         return {
             "latents": P(None, ps.dp_axes, None, None, None),
