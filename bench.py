@@ -156,6 +156,9 @@ def run_bench(
 
 
 def main():
+    from veomni_tpu.utils.xla_flags import apply_performance_flags
+
+    apply_performance_flags()
     threading.Thread(
         target=_watchdog,
         args=(float(os.environ.get("BENCH_WATCHDOG_S", 900)),),

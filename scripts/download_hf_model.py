@@ -1,0 +1,38 @@
+"""Download an HF model snapshot (reference: ``scripts/download_hf_model.py``).
+
+Usage: python scripts/download_hf_model.py --repo_id Qwen/Qwen3-8B --local_dir ./qwen3-8b
+Optionally restrict to weights/config only with --weights_only.
+"""
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--repo_id", required=True)
+    p.add_argument("--local_dir", required=True)
+    p.add_argument("--revision", default=None)
+    p.add_argument("--weights_only", action="store_true",
+                   help="only *.safetensors / *.json / tokenizer files")
+    args = p.parse_args()
+
+    try:
+        from huggingface_hub import snapshot_download
+    except ImportError as e:  # huggingface_hub isn't a hard dependency
+        raise SystemExit(
+            "huggingface_hub is required for downloads: pip install huggingface_hub"
+        ) from e
+
+    allow = (
+        ["*.safetensors", "*.json", "tokenizer*", "*.model", "*.jinja"]
+        if args.weights_only else None
+    )
+    path = snapshot_download(
+        args.repo_id, local_dir=args.local_dir, revision=args.revision,
+        allow_patterns=allow,
+    )
+    print(path)
+
+
+if __name__ == "__main__":
+    main()

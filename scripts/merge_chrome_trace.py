@@ -1,0 +1,50 @@
+"""Merge multiple chrome-trace JSON files (e.g. per-host jax.profiler dumps)
+into one timeline, offsetting pids so hosts don't collide.
+
+Reference capability: ``scripts/profile/merge_chrome_trace.py``.
+Our ProfileCallback writes traces under
+``<output_dir>/profile/plugins/profile/<run>/*.trace.json.gz``.
+
+Usage:
+  python scripts/merge_chrome_trace.py out.json trace_host0.json.gz trace_host1.json.gz
+"""
+
+import gzip
+import json
+import sys
+
+
+def load(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        data = json.load(f)
+    return data.get("traceEvents", data) if isinstance(data, dict) else data
+
+
+def main():
+    if len(sys.argv) < 3:
+        raise SystemExit(__doc__)
+    out, inputs = sys.argv[1], sys.argv[2:]
+    merged = []
+    pid_base = 0
+    for i, path in enumerate(inputs):
+        events = load(path)
+        max_pid = 0
+        for ev in events:
+            ev = dict(ev)
+            if isinstance(ev.get("pid"), int):
+                max_pid = max(max_pid, ev["pid"])
+                ev["pid"] += pid_base
+            # tag host in the process names so the viewer groups clearly
+            if ev.get("name") == "process_name" and "args" in ev:
+                ev["args"] = dict(ev["args"])
+                ev["args"]["name"] = f"host{i}/{ev['args'].get('name', '')}"
+            merged.append(ev)
+        pid_base += max_pid + 1
+    with open(out, "w") as f:
+        json.dump({"traceEvents": merged}, f)
+    print(f"merged {len(inputs)} traces, {len(merged)} events -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Trim an HF safetensors checkpoint to its first N decoder layers.
+
+Reference capability: ``scripts/trim_safetensor_layers.py`` — produce a
+small real-weights model (e.g. deepseek 5-layer) to exercise streamed
+weight loading without the full checkpoint. This version streams tensor by
+tensor (numpy; peak RAM = one tensor), rewrites the weight index, and
+patches ``num_hidden_layers`` (+ ``first_k_dense_replace`` /
+``layer_types`` when present) in config.json.
+
+Usage:
+  python scripts/trim_safetensor_layers.py --model_dir IN --out_dir OUT --num_layers 4
+"""
+
+import argparse
+import json
+import os
+import re
+import shutil
+
+import numpy as np
+from safetensors import safe_open
+from safetensors.numpy import save_file
+
+_LAYER_RE = re.compile(r"(^|\.)layers\.(\d+)\.")
+
+
+def layer_id(key: str):
+    m = _LAYER_RE.search(key)
+    return int(m.group(2)) if m else None
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model_dir", required=True)
+    p.add_argument("--out_dir", required=True)
+    p.add_argument("--num_layers", type=int, required=True)
+    p.add_argument("--max_shard_gb", type=float, default=4.0)
+    args = p.parse_args()
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    shards = sorted(
+        f for f in os.listdir(args.model_dir) if f.endswith(".safetensors")
+    )
+    if not shards:
+        raise SystemExit(f"no safetensors in {args.model_dir}")
+
+    budget = int(args.max_shard_gb * 1024 ** 3)
+    out_idx, weight_map = 1, {}
+    current, current_bytes = {}, 0
+    n_out = 0
+
+    def flush():
+        nonlocal current, current_bytes, out_idx
+        if not current:
+            return
+        name = f"model-trimmed-{out_idx:05d}.safetensors"
+        save_file(current, os.path.join(args.out_dir, name))
+        for k in current:
+            weight_map[k] = name
+        out_idx += 1
+        current, current_bytes = {}, 0
+
+    for shard in shards:
+        with safe_open(os.path.join(args.model_dir, shard), framework="np") as f:
+            for key in f.keys():
+                lid = layer_id(key)
+                if lid is not None and lid >= args.num_layers:
+                    continue
+                t = f.get_tensor(key)
+                current[key] = np.ascontiguousarray(t)
+                current_bytes += t.nbytes
+                n_out += 1
+                if current_bytes >= budget:
+                    flush()
+    flush()
+
+    with open(os.path.join(args.out_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {}, "weight_map": weight_map}, f, indent=2)
+
+    cfg_path = os.path.join(args.model_dir, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+
+        def patch(c):
+            if "num_hidden_layers" in c:
+                c["num_hidden_layers"] = min(c["num_hidden_layers"], args.num_layers)
+            if "first_k_dense_replace" in c:
+                c["first_k_dense_replace"] = min(
+                    c["first_k_dense_replace"], args.num_layers
+                )
+            if isinstance(c.get("layer_types"), list):
+                c["layer_types"] = c["layer_types"][: args.num_layers]
+            for sub in ("text_config", "thinker_config"):
+                if isinstance(c.get(sub), dict):
+                    patch(c[sub])
+
+        patch(cfg)
+        with open(os.path.join(args.out_dir, "config.json"), "w") as f:
+            json.dump(cfg, f, indent=2)
+
+    for asset in ("tokenizer.json", "tokenizer_config.json", "generation_config.json",
+                  "special_tokens_map.json", "vocab.json", "merges.txt"):
+        src = os.path.join(args.model_dir, asset)
+        if os.path.exists(src):
+            shutil.copy2(src, args.out_dir)
+
+    print(f"wrote {n_out} tensors in {out_idx - 1} shards to {args.out_dir}")
+
+
+if __name__ == "__main__":
+    main()

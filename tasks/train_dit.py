@@ -10,6 +10,9 @@ from veomni_tpu.trainer.dit_trainer import DiTTrainer
 
 
 def main():
+    from veomni_tpu.utils.xla_flags import apply_performance_flags
+
+    apply_performance_flags()
     args = parse_args(VeOmniArguments)
     save_args(args, args.train.output_dir)
     trainer = DiTTrainer(args)

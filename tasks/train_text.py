@@ -14,6 +14,9 @@ from veomni_tpu.trainer import TextTrainer
 
 
 def main():
+    from veomni_tpu.utils.xla_flags import apply_performance_flags
+
+    apply_performance_flags()
     args = parse_args(VeOmniArguments)
     save_args(args, args.train.output_dir)
     trainer = TextTrainer(args)

@@ -10,6 +10,9 @@ from veomni_tpu.trainer.vlm_trainer import VLMTrainer
 
 
 def main():
+    from veomni_tpu.utils.xla_flags import apply_performance_flags
+
+    apply_performance_flags()
     args = parse_args(VeOmniArguments)
     save_args(args, args.train.output_dir)
     trainer = VLMTrainer(args)

@@ -202,3 +202,66 @@ def test_ep_capacity_drop_metric():
         assert float(metrics2["moe_dropped_frac"]) == 0.0
     finally:
         destroy_parallel_state()
+
+
+def test_trim_safetensor_layers(tmp_path):
+    """scripts/trim_safetensor_layers.py: layer filter + index + config patch."""
+    import json
+    import subprocess
+    import sys
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    src = tmp_path / "full"
+    src.mkdir()
+    tensors = {"model.embed_tokens.weight": np.ones((8, 4), np.float32)}
+    for i in range(4):
+        tensors[f"model.layers.{i}.mlp.w"] = np.full((2, 2), float(i), np.float32)
+    save_file(tensors, str(src / "model.safetensors"))
+    with open(src / "config.json", "w") as f:
+        json.dump({"num_hidden_layers": 4, "text_config": {"num_hidden_layers": 4}}, f)
+
+    out = tmp_path / "trim"
+    r = subprocess.run(
+        [sys.executable, "scripts/trim_safetensor_layers.py",
+         "--model_dir", str(src), "--out_dir", str(out), "--num_layers", "2"],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr
+    from safetensors import safe_open
+
+    with open(out / "model.safetensors.index.json") as f:
+        wm = json.load(f)["weight_map"]
+    assert "model.layers.1.mlp.w" in wm and "model.layers.2.mlp.w" not in wm
+    with safe_open(str(out / next(iter(set(wm.values())))), framework="np") as f:
+        assert set(f.keys()) == set(wm)
+    with open(out / "config.json") as f:
+        cfg = json.load(f)
+    assert cfg["num_hidden_layers"] == 2
+    assert cfg["text_config"]["num_hidden_layers"] == 2
+
+
+def test_merge_chrome_trace(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    for i in range(2):
+        with open(tmp_path / f"t{i}.json", "w") as f:
+            json.dump({"traceEvents": [
+                {"pid": 1, "tid": 1, "name": "process_name", "ph": "M",
+                 "args": {"name": "dev"}},
+                {"pid": 1, "tid": 1, "name": "op", "ph": "X", "ts": i, "dur": 1},
+            ]}, f)
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, "scripts/merge_chrome_trace.py", str(out),
+         str(tmp_path / "t0.json"), str(tmp_path / "t1.json")],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr
+    with open(out) as f:
+        ev = json.load(f)["traceEvents"]
+    assert len(ev) == 4
+    assert {e["pid"] for e in ev} == {1, 3}  # hosts offset apart

@@ -30,6 +30,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from veomni_tpu import ops
+from veomni_tpu.models.diffusion_common import (
+    ln_noaffine as _ln_noaffine,
+    rms_norm as _rms,
+    timestep_embedding as _ts_embed,
+    tree_get as _get,
+    tree_set as _set,
+)
 
 
 @dataclass
@@ -167,20 +174,6 @@ def rope_plan(cfg: QwenImageConfig, img_shape: Tuple[int, int, int], txt_len: in
 # forward
 # ---------------------------------------------------------------------------
 
-def _ln_noaffine(x, eps):
-    x = x.astype(jnp.float32)
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps)
-
-
-def _rms(x, w, eps):
-    dt = x.dtype
-    x = x.astype(jnp.float32)
-    x = x * jax.lax.rsqrt((x * x).mean(-1, keepdims=True) + eps)
-    return (x * w).astype(dt)
-
-
 def _qkv(x, ap, cfg: QwenImageConfig):
     b, n, _ = x.shape
     nh, hd = cfg.num_attention_heads, cfg.attention_head_dim
@@ -236,13 +229,6 @@ def _block(carry, lp, cfg: QwenImageConfig, temb, cos, sin, txt_seg, img_seg):
     return img, txt
 
 
-def _timestep_embedding(t, dim: int = 256):
-    half = dim // 2
-    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
-    ang = t.astype(jnp.float32)[:, None] * freqs[None]
-    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
-
-
 def qwen_image_forward(params, cfg: QwenImageConfig, latents, timestep,
                        text_states, text_mask=None,
                        img_shape: Tuple[int, int, int] = None):
@@ -254,14 +240,21 @@ def qwen_image_forward(params, cfg: QwenImageConfig, latents, timestep,
     lt = text_states.shape[1]
     if img_shape is None:
         side = int(round(n_img ** 0.5))
+        if side * side != n_img:
+            raise ValueError(
+                f"{n_img} image tokens is not a square grid; set "
+                "cfg.img_shape=(f, h, w) explicitly"
+            )
         img_shape = (1, side, side)
+    elif int(np.prod(img_shape)) != n_img:
+        raise ValueError(f"img_shape {img_shape} != {n_img} image tokens")
 
     img = jnp.dot(latents.astype(cfg.dtype), p["img_in_w"]) + p["img_in_b"]
     txt = _rms(text_states.astype(cfg.dtype), p["txt_norm"], cfg.eps)
     txt = jnp.dot(txt, p["txt_in_w"]) + p["txt_in_b"]
 
     te = p["time_embedder"]
-    temb = _timestep_embedding(timestep).astype(cfg.dtype)
+    temb = _ts_embed(timestep, 256).astype(cfg.dtype)
     temb = jnp.dot(temb, te["fc1_w"]) + te["fc1_b"]
     temb = jnp.dot(jax.nn.silu(temb), te["fc2_w"]) + te["fc2_b"]  # [B, D]
 
@@ -357,19 +350,6 @@ _TOP_MAP = [
 ]
 
 
-def _get(tree, dotted):
-    for part in dotted.split("."):
-        tree = tree[part]
-    return tree
-
-
-def _set(tree, dotted, v):
-    parts = dotted.split(".")
-    for part in parts[:-1]:
-        tree = tree.setdefault(part, {})
-    tree[parts[-1]] = v
-
-
 def hf_to_params(model_dir: str, cfg: QwenImageConfig, target_shardings=None):
     from veomni_tpu.models import hf_io
 
@@ -460,6 +440,9 @@ def save_hf_checkpoint(params, cfg: QwenImageConfig, out_dir: str) -> None:
             "num_attention_heads": cfg.num_attention_heads,
             "joint_attention_dim": cfg.joint_attention_dim,
             "axes_dims_rope": list(cfg.axes_dims_rope),
+            # non-diffusers extra: keep the trained latent grid so a reload
+            # doesn't regress to square inference
+            "img_shape": list(cfg.img_shape),
         }, f, indent=2)
 
 

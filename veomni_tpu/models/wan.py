@@ -35,6 +35,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from veomni_tpu import ops
+from veomni_tpu.models.diffusion_common import (
+    ln_noaffine as _ln_noaffine,
+    rms_norm as _rms,
+    timestep_embedding as _ts_embed,
+    tree_get as _get,
+    tree_set as _set,
+)
 
 
 @dataclass
@@ -139,20 +146,6 @@ def abstract_params(cfg: WanConfig):
 # forward
 # ---------------------------------------------------------------------------
 
-def _ln_noaffine(x, eps):
-    x = x.astype(jnp.float32)
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps)
-
-
-def _rms(x, w, eps):
-    dt = x.dtype
-    x = x.astype(jnp.float32)
-    x = x * jax.lax.rsqrt((x * x).mean(-1, keepdims=True) + eps)
-    return (x * w).astype(dt)
-
-
 def rope_3d(cfg: WanConfig, f: int, h: int, w: int):
     """(cos, sin) [1, f*h*w, head_dim] — pairwise-interleaved layout; the
     head_dim splits [d-2*(d//3), d//3, d//3] over (frame, height, width)."""
@@ -216,18 +209,10 @@ def _block(x, lp, cfg: WanConfig, text, temb6, cos, sin):
     return x
 
 
-def _timestep_embedding(t, dim: int):
-    """diffusers Timesteps(flip_sin_to_cos=True, downscale_freq_shift=0)."""
-    half = dim // 2
-    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
-    ang = t.astype(jnp.float32)[:, None] * freqs[None]
-    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
-
-
 def _condition(params, cfg: WanConfig, timestep, text_states):
     p = params
     te = p["time_embedder"]
-    ts = _timestep_embedding(timestep, cfg.freq_dim).astype(cfg.dtype)
+    ts = _ts_embed(timestep, cfg.freq_dim).astype(cfg.dtype)
     temb = jnp.dot(ts, te["fc1_w"]) + te["fc1_b"]
     temb = jnp.dot(jax.nn.silu(temb), te["fc2_w"]) + te["fc2_b"]  # [B, D]
     proj = jnp.dot(jax.nn.silu(temb), p["time_proj_w"]) + p["time_proj_b"]
@@ -320,19 +305,6 @@ _TOP_MAP = [
     ("proj_out_w", "proj_out.weight", True),
     ("proj_out_b", "proj_out.bias", False),
 ]
-
-
-def _get(tree, dotted):
-    for part in dotted.split("."):
-        tree = tree[part]
-    return tree
-
-
-def _set(tree, dotted, v):
-    parts = dotted.split(".")
-    for part in parts[:-1]:
-        tree = tree.setdefault(part, {})
-    tree[parts[-1]] = v
 
 
 def hf_to_params(model_dir: str, cfg: WanConfig, target_shardings=None):

@@ -1,0 +1,39 @@
+"""XLA performance flags (the TPU analogue of the reference's async-Ulysses
+comm/compute overlap, ``distributed/sequence_parallel/async_ulysses.py``:
+on TPU, overlap is the compiler's job — the latency-hiding scheduler
+reorders collectives behind compute when these flags are on).
+
+Must run BEFORE the first JAX backend initialization; entrypoints
+(tasks/*, bench.py) call ``apply_performance_flags()`` first thing.
+Disable with ``VEOMNI_XLA_PERF_FLAGS=0``.
+"""
+
+from __future__ import annotations
+
+import os
+
+_PERF_FLAGS = (
+    # overlap ICI collectives (Ulysses a2a, FSDP all-gather/reduce-scatter)
+    # with compute instead of scheduling them synchronously
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    # allow collectives to combine into fewer, larger transfers
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+)
+
+
+def apply_performance_flags() -> bool:
+    """Append the TPU perf flags to XLA_FLAGS (idempotent). Returns whether
+    the flags are active."""
+    if os.environ.get("VEOMNI_XLA_PERF_FLAGS", "1") in ("0", "false"):
+        return False
+    import jax
+
+    if jax._src.xla_bridge._backends:  # backend already up: flags won't apply
+        return False
+    current = os.environ.get("XLA_FLAGS", "")
+    present = {tok.split("=")[0] for tok in current.split()}
+    added = [f for f in _PERF_FLAGS if f.split("=")[0] not in present]
+    if added:
+        os.environ["XLA_FLAGS"] = (current + " " + " ".join(added)).strip()
+    return True
