@@ -242,3 +242,87 @@ def test_hf_export_roundtrip(hf_and_ours, tmp_path):
         ):
             assert n1 == n2
             np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6, atol=1e-6)
+
+
+def test_qwen3_omni_trainer_e2e(tmp_path):
+    """Full OmniTrainer drive: raw audio + images -> mel/patch plans ->
+    omni rope -> deepstack MoE train steps; checkpoint + HF export."""
+    import json
+
+    from veomni_tpu.arguments import VeOmniArguments
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+    from veomni_tpu.trainer import OmniTrainer
+
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(16):
+        row = {
+            "input_ids": rng.integers(12, 256, int(rng.integers(8, 20))).tolist(),
+        }
+        if i % 2 == 0:  # 8x8 pixels -> 4x4 patch grid (patch 2)
+            row["images"] = [rng.random((8, 8, 3)).tolist()]
+        if i % 3 == 0:  # precomputed mel [n_mels, T]
+            row["audios"] = [rng.standard_normal((32, 60)).tolist()]
+        rows.append(row)
+    with open(tmp_path / "data.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    args = VeOmniArguments()
+    args.model.config_overrides = {
+        "model_type": "qwen3_omni_moe",
+        "vocab_size": 256,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "moe_intermediate_size": 32,
+        "num_experts": 4,
+        "num_experts_per_tok": 2,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 16,
+        "rope_scaling": {"rope_type": "default", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "hidden_size": 32, "intermediate_size": 64,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+            "out_hidden_size": 64, "num_position_embeddings": 16,
+            "deepstack_visual_indexes": [0],
+        },
+        "audio": {
+            "d_model": 32, "encoder_layers": 2, "encoder_attention_heads": 2,
+            "encoder_ffn_dim": 64, "num_mel_bins": 32,
+            "max_source_positions": 64, "n_window": 50, "n_window_infer": 200,
+            "downsample_hidden_size": 16, "output_dim": 64,
+        },
+        "image_token_id": 9, "video_token_id": 10, "audio_token_id": 11,
+        "vision_start_token_id": 8, "audio_start_token_id": 7,
+    }
+    args.data.train_path = str(tmp_path / "data.jsonl")
+    args.data.data_type = "pretokenized"
+    args.data.max_seq_len = 64
+    args.data.max_patches = 256
+    args.data.max_audio_chunks = 8
+    args.train.output_dir = str(tmp_path / "out")
+    args.train.micro_batch_size = 2
+    args.train.train_steps = 3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.save_hf_weights = True
+    args.train.log_steps = 100
+    destroy_parallel_state()
+    try:
+        trainer = OmniTrainer(args)
+        ctl = trainer.train()
+        assert ctl.global_step == 3
+        assert np.isfinite(ctl.metrics["loss"])
+        trainer.checkpointer.close()
+        import os
+
+        hf_dir = os.path.join(args.train.output_dir, "hf_ckpt")
+        assert os.path.exists(os.path.join(hf_dir, "model.safetensors"))
+        from veomni_tpu.models import build_foundation_model
+
+        m2 = build_foundation_model(hf_dir, dtype="float32")
+        m2.load_hf(hf_dir)
+    finally:
+        destroy_parallel_state()

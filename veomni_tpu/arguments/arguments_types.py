@@ -56,6 +56,9 @@ class DataArguments:
     # static packed vision-patch budget per micro-batch (qwen2_5_vl pipeline);
     # also the per-sample cap in the transform
     max_patches: int = 4096
+    # static audio chunk budget per micro-batch (qwen3_omni pipeline; one
+    # chunk = 2*n_window mel frames)
+    max_audio_chunks: int = 64
 
 
 @dataclass

@@ -1,0 +1,216 @@
+"""Qwen3-Omni data pipeline: transform + collator.
+
+Reference: the omni task path (``tasks/omni/train_qwen3_omni.py`` +
+``veomni/data/multimodal/{audio_utils,multimodal_chat_template}.py``) —
+rows with raw media become placeholder-expanded token sequences plus the
+packed static-plan tensors the thinker's jitted loss consumes
+(``models/qwen3_omni_moe.py`` batch contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from veomni_tpu.data.data_collator import IGNORE_INDEX
+from veomni_tpu.data.media import load_audio, log_mel_spectrogram
+from veomni_tpu.data.multimodal import (
+    DATA_TRANSFORM_REGISTRY, image_to_qwen_patches, load_image,
+)
+
+
+@DATA_TRANSFORM_REGISTRY.register("qwen3_omni")
+def build_qwen3_omni_transform(
+    tokenizer=None,
+    *,
+    omni_config=None,   # Qwen3OmniMoeConfig
+    max_seq_len: int = 0,
+    max_patches_per_sample: int = 0,
+    max_mel_frames_per_sample: int = 0,
+    text_keys: str = "text",
+    **_,
+):
+    """Rows: {"text" | "input_ids", "images": [...], "audios": [...]} —
+    audios are wav paths/arrays or precomputed mel [n_mels, T]. Each medium
+    becomes its placeholder run at the head of the sequence (audio_start +
+    AUDIO*n / vision_start + IMAGE*n)."""
+    from veomni_tpu.models.qwen3_omni_moe import audio_output_lengths
+
+    cfg = omni_config
+    vcfg, acfg = cfg.vision, cfg.audio
+
+    def to_mel(item) -> np.ndarray:
+        arr = np.asarray(item, np.float32) if not isinstance(item, str) else None
+        if arr is not None and arr.ndim == 2 and arr.shape[0] == acfg.num_mel_bins:
+            return arr  # precomputed mel features
+        wav = load_audio(item if arr is None else arr)
+        return log_mel_spectrogram(wav, n_mels=acfg.num_mel_bins).T  # [mel, T]
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        patches_list, grids = [], []
+        budget = max_patches_per_sample
+        for im in row.get("images", []):
+            arr = load_image(im, image_size=0) if isinstance(im, str) else np.asarray(im, np.float32)
+            if arr.max() > 1.5:
+                arr = arr / 255.0
+            px, grid = image_to_qwen_patches(arr, vcfg)
+            if budget and sum(p.shape[0] for p in patches_list) + px.shape[0] > budget:
+                break
+            patches_list.append(px)
+            grids.append(grid)
+
+        mels: List[np.ndarray] = []
+        mel_budget = max_mel_frames_per_sample
+        for au in row.get("audios", []):
+            mel = to_mel(au)
+            if mel_budget and sum(m.shape[1] for m in mels) + mel.shape[1] > mel_budget:
+                break
+            mels.append(mel)
+
+        if "input_ids" in row:
+            text_ids = list(row["input_ids"])
+        else:
+            text_ids = tokenizer(row[text_keys], add_special_tokens=True)["input_ids"]
+        stray = {cfg.image_token_id, cfg.video_token_id, cfg.audio_token_id}
+        text_labels = list(row.get("labels", text_ids))
+        kept = [(t, l) for t, l in zip(text_ids, text_labels) if t not in stray]
+        text_ids = [t for t, _ in kept]
+        text_labels = [l for _, l in kept]
+
+        m = vcfg.spatial_merge_size
+
+        def header_len():
+            n = sum(
+                1 + t * (gh // m) * (gw // m) for t, gh, gw in grids
+            )
+            n += sum(1 + audio_output_lengths(mm.shape[1]) for mm in mels)
+            return n
+
+        while max_seq_len and (grids or mels) and header_len() >= max_seq_len:
+            if grids:
+                grids.pop()
+                patches_list.pop()
+            else:
+                mels.pop()
+
+        ids: List[int] = []
+        labels: List[int] = []
+        for mm in mels:
+            n_tok = audio_output_lengths(mm.shape[1])
+            ids += [cfg.audio_start_token_id] + [cfg.audio_token_id] * n_tok
+            labels += [IGNORE_INDEX] * (n_tok + 1)
+        for (t, gh, gw) in grids:
+            n_merged = t * (gh // m) * (gw // m)
+            ids += [cfg.vision_start_token_id] + [cfg.image_token_id] * n_merged
+            labels += [IGNORE_INDEX] * (n_merged + 1)
+        ids += text_ids
+        labels += text_labels
+        if max_seq_len:
+            ids, labels = ids[:max_seq_len], labels[:max_seq_len]
+        return {
+            "input_ids": ids,
+            "labels": labels,
+            "vis_patches": np.concatenate(patches_list)
+            if patches_list else np.zeros((0, vcfg.patch_dim), np.float32),
+            "vis_grids": grids,
+            "audio_mels": mels,
+        }
+
+    return transform
+
+
+class Qwen3OmniCollator:
+    """Batch assembly for the qwen3_omni_moe thinker: [B, S] text +
+    packed patch buffer (qwen3_vl contract) + padded audio chunk buffer
+    (audio_metadata contract) + omni 3-stream position ids."""
+
+    def __init__(self, omni_config, seq_len: int, micro_batch_size: int,
+                 max_patches: int, max_audio_chunks: int, sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError(f"seq_len {seq_len} % sp_size {sp_size} != 0")
+        unit = omni_config.vision.merge_unit
+        if max_patches % unit:
+            raise ValueError(f"max_patches {max_patches} % merge_unit {unit} != 0")
+        self.cfg = omni_config
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+        self.max_patches = max_patches
+        self.max_audio_chunks = max_audio_chunks
+
+    @property
+    def max_audio_frames(self) -> int:
+        return self.max_audio_chunks * self.cfg.audio.chunk_out_len
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        from veomni_tpu.models.qwen3_omni_moe import (
+            audio_metadata, omni_position_ids, pack_audio_chunks,
+        )
+        from veomni_tpu.models.qwen3_vl import vision_metadata
+
+        cfg, vcfg, acfg = self.cfg, self.cfg.vision, self.cfg.audio
+        b, s = self.micro_batch_size, self.seq_len
+        out: Dict[str, np.ndarray] = {
+            "input_ids": np.zeros((b, s), np.int32),
+            "labels": np.full((b, s), IGNORE_INDEX, np.int32),
+            "segment_ids": np.zeros((b, s), np.int32),
+        }
+        all_patches, all_grids, all_mels = [], [], []
+        n_patches = n_chunks = 0
+        cl = acfg.chunk_len
+        for i, sample in enumerate(samples[:b]):
+            ids = np.asarray(sample["input_ids"], np.int32)[:s]
+            lab = np.asarray(sample["labels"], np.int32)[: len(ids)]
+            # media whose placeholder run was truncated must be dropped in
+            # lockstep (transform already budgets; this guards seq_len cuts)
+            px = sample.get("vis_patches")
+            grids = list(sample.get("vis_grids", []))
+            mels = list(sample.get("audio_mels", []))
+            for mel in mels:
+                n_chunks += -(-mel.shape[1] // cl)
+            if n_chunks > self.max_audio_chunks:
+                raise ValueError(
+                    f"micro-batch exceeds max_audio_chunks={self.max_audio_chunks}"
+                )
+            if px is not None and len(px):
+                if n_patches + len(px) > self.max_patches:
+                    raise ValueError(
+                        f"micro-batch exceeds max_patches={self.max_patches}"
+                    )
+                n_patches += len(px)
+                all_patches.append(np.asarray(px))
+            all_grids += grids
+            all_mels += mels
+            shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
+            n = len(ids)
+            out["input_ids"][i, :n] = ids
+            out["labels"][i, :n] = shifted
+            out["segment_ids"][i, :n] = 1
+
+        out["position_ids"] = omni_position_ids(
+            out["input_ids"].astype(np.int64), cfg,
+            image_grid_thw=all_grids,
+            audio_lens=[m.shape[1] for m in all_mels],
+        ).astype(np.int32)
+
+        vmeta = vision_metadata(all_grids, vcfg, self.max_patches)
+        px_buf = np.zeros((self.max_patches, vcfg.patch_dim), np.float32)
+        if all_patches:
+            cat = np.concatenate(all_patches)
+            px_buf[: len(cat)] = cat
+        out["pixel_values"] = px_buf
+        out["vis_pos_hw"] = vmeta["pos_hw"]
+        out["vis_pos_interp_idx"] = vmeta["pos_interp_idx"]
+        out["vis_pos_interp_w"] = vmeta["pos_interp_w"]
+        out["vis_seg_full"] = vmeta["seg_full"]
+        out["vis_merged_mask"] = vmeta["merged_mask"]
+
+        ameta = audio_metadata(
+            [m.shape[1] for m in all_mels], acfg,
+            self.max_audio_chunks, self.max_audio_frames,
+        )
+        out["audio_chunks"] = pack_audio_chunks(all_mels, acfg, self.max_audio_chunks)
+        out["aud_frame_gather"] = ameta["frame_gather"]
+        out["aud_seg"] = ameta["seg"]
+        out["aud_frame_mask"] = ameta["frame_mask"]
+        return out

@@ -260,6 +260,25 @@ def build_config(model_type: str = "", **overrides):
             text.setdefault("expert_layout", "fused_chunked")
             kw["model_type"] = model_type
         return vl_cfg(text=text, **kw)
+    if model_type == "qwen3_omni_moe":
+        from veomni_tpu.models.qwen3_omni_moe import Qwen3OmniMoeConfig
+
+        kw = {
+            k: overrides.pop(k)
+            for k in ("vision", "audio", "image_token_id", "video_token_id",
+                      "audio_token_id", "vision_start_token_id",
+                      "audio_start_token_id", "position_id_per_seconds",
+                      "freeze_vision", "freeze_audio")
+            if k in overrides
+        }
+        text = dict(overrides.pop("text", {}) or {})
+        text.update(overrides)
+        text.setdefault("model_type", "qwen3_moe")
+        if text.get("rope_scaling"):
+            rs = dict(text["rope_scaling"])
+            rs.setdefault("mrope_interleaved", True)
+            text["rope_scaling"] = rs
+        return Qwen3OmniMoeConfig(text=text, **kw)
     if model_type in VLM_MODEL_TYPES:
         from veomni_tpu.models.vlm import VLMConfig
 

@@ -2,4 +2,26 @@ from veomni_tpu.trainer.base import BaseTrainer
 from veomni_tpu.trainer.text_trainer import TextTrainer
 from veomni_tpu.trainer.vlm_trainer import VLMTrainer
 
-__all__ = ["BaseTrainer", "TextTrainer", "VLMTrainer"]
+
+def __getattr__(name):  # lazy: the heavier trainers pull optional deps
+    if name == "OmniTrainer":
+        from veomni_tpu.trainer.omni_trainer import OmniTrainer
+
+        return OmniTrainer
+    if name == "DiTTrainer":
+        from veomni_tpu.trainer.dit_trainer import DiTTrainer
+
+        return DiTTrainer
+    if name == "DPOTrainer":
+        from veomni_tpu.trainer.dpo_trainer import DPOTrainer
+
+        return DPOTrainer
+    if name == "RLTrainer":
+        from veomni_tpu.trainer.rl_trainer import RLTrainer
+
+        return RLTrainer
+    raise AttributeError(name)
+
+
+__all__ = ["BaseTrainer", "TextTrainer", "VLMTrainer", "OmniTrainer",
+           "DiTTrainer", "DPOTrainer", "RLTrainer"]

@@ -101,7 +101,11 @@ class OmniCollator:
 class OmniTrainer(BaseTrainer):
     def _build_model(self):
         overrides = dict(self.args.model.config_overrides)
-        overrides.pop("model_type", None)
+        mt = overrides.pop("model_type", "") or self.args.model.model_type
+        if mt == "qwen3_omni_moe" or self.args.model.config_path:
+            # real thinker family: HF config / overrides via the registry path
+            super()._build_model()
+            return
         text = dict(overrides.pop("text", {}))
         text.setdefault("dtype", self.args.train.compute_dtype)
         text["remat"] = self.args.train.enable_gradient_checkpointing
@@ -118,6 +122,10 @@ class OmniTrainer(BaseTrainer):
         )
         self.model = FoundationModel(config=cfg, family=family)
         self.tokenizer = None
+
+    @property
+    def _is_qwen3_omni(self) -> bool:
+        return self.model.config.model_type == "qwen3_omni_moe"
 
     @staticmethod
     def _save_native(params, cfg, out_dir):
@@ -139,6 +147,34 @@ class OmniTrainer(BaseTrainer):
         )
 
     def _build_data_transform(self):
+        if self._is_qwen3_omni:
+            import jax as _jax
+
+            from veomni_tpu.data.data_transform import build_data_transform
+
+            d = self.args.data
+            ps = self.parallel_state
+            local_mb = max(
+                1,
+                self.args.train.micro_batch_size * ps.dp_size // _jax.process_count(),
+            )
+            acfg = self.model.config.audio
+            self.data_transform = build_data_transform(
+                "qwen3_omni",
+                tokenizer=self.tokenizer,
+                omni_config=self.model.config,
+                max_seq_len=d.max_seq_len,
+                max_patches_per_sample=max(
+                    self.model.config.vision.merge_unit,
+                    d.max_patches // local_mb,
+                ),
+                max_mel_frames_per_sample=max(
+                    acfg.chunk_len,
+                    d.max_audio_chunks * acfg.chunk_len // local_mb,
+                ),
+                text_keys=d.text_keys,
+            )
+            return
         self.data_transform = None  # rows are pretokenized + raw media
 
     def _build_dataloader(self):
@@ -147,12 +183,23 @@ class OmniTrainer(BaseTrainer):
         self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
         nproc = jax.process_count()
         local_mb = t.micro_batch_size * ps.dp_size // nproc
+        if self._is_qwen3_omni:
+            from veomni_tpu.data.omni_data import Qwen3OmniCollator
+
+            collator = Qwen3OmniCollator(
+                self.model.config, d.max_seq_len, local_mb,
+                max_patches=d.max_patches,
+                max_audio_chunks=d.max_audio_chunks,
+                sp_size=ps.sp_size,
+            )
+        else:
+            collator = OmniCollator(
+                self.model.config, d.max_seq_len, local_mb, sp_size=ps.sp_size
+            )
         self.dataloader = build_dataloader(
             d.dataloader_type,
             dataset=self.dataset,
-            collate_fn=OmniCollator(
-                self.model.config, d.max_seq_len, local_mb, sp_size=ps.sp_size
-            ),
+            collate_fn=collator,
             micro_batch_size=local_mb,
             grad_accum_steps=self.grad_accum_steps,
             samples_per_micro_batch=local_mb,
@@ -165,6 +212,25 @@ class OmniTrainer(BaseTrainer):
     def _batch_sharding_map(self):
         ps = self.parallel_state
         cfg = self.model.config
+        if self._is_qwen3_omni:
+            return {
+                "input_ids": P(None, ps.dp_axes, ps.sp_axes),
+                "labels": P(None, ps.dp_axes, ps.sp_axes),
+                "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
+                # mrope positions [A, B, 3, S]
+                "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
+                # packed media buffers replicate (towers run at sp=1)
+                "pixel_values": P(None, None, None),
+                "vis_pos_hw": P(None, None, None),
+                "vis_pos_interp_idx": P(None, None, None),
+                "vis_pos_interp_w": P(None, None, None),
+                "vis_seg_full": P(None, None),
+                "vis_merged_mask": P(None, None),
+                "audio_chunks": P(None, None, None, None),
+                "aud_frame_gather": P(None, None),
+                "aud_seg": P(None, None),
+                "aud_frame_mask": P(None, None),
+            }
         base = {k: P(None, ps.dp_axes, ps.sp_axes) for k in (
             "input_ids", "labels", "position_ids", "segment_ids")}
         if cfg.vision is not None:
