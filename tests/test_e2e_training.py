@@ -133,10 +133,14 @@ def _run_resume_case(tmp_path, *, data_kwargs=None, data_overrides=None,
         {"params": trainer_a.train_state.params,
          "opt_state": trainer_a.train_state.opt_state}
     )
-    ref_loader = (
-        trainer_a.dataloader.state_dict()
-        if hasattr(trainer_a.dataloader, "state_dict") else None
-    )
+    def _consumed_cursor(trainer):
+        # with background prefetch the raw loader runs ahead by a
+        # timing-dependent amount; the consumed-batch cursor (what a
+        # checkpoint would record) is the deterministic quantity
+        src = getattr(trainer, "_prefetcher", None) or trainer.dataloader
+        return src.state_dict() if hasattr(src, "state_dict") else None
+
+    ref_loader = _consumed_cursor(trainer_a)
     trainer_a.checkpointer.close()
     destroy_parallel_state()
 
@@ -168,8 +172,8 @@ def _run_resume_case(tmp_path, *, data_kwargs=None, data_overrides=None,
          "opt_state": trainer_b2.train_state.opt_state}
     )
     _assert_trees_identical(ref_state, got_state, "resumed train_state")
-    if ref_loader is not None and hasattr(trainer_b2.dataloader, "state_dict"):
-        assert ref_loader == trainer_b2.dataloader.state_dict(), (
+    if ref_loader is not None:
+        assert ref_loader == _consumed_cursor(trainer_b2), (
             "dataloader cursor state diverged after resume"
         )
     trainer_b2.checkpointer.close()

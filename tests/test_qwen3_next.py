@@ -119,3 +119,78 @@ def test_hf_export_roundtrip(tmp_path):
                 np.asarray(a), np.asarray(b), err_msg=str(pa))
     finally:
         destroy_parallel_state()
+
+
+def test_gated_delta_rule_segment_reset():
+    """Packed 2-document row == per-document runs (reference varlen
+    cu_seqlens semantics: no state leaks across documents). Documents are
+    sized so one boundary falls mid-chunk and one document crosses a chunk
+    boundary (exercising the in-chunk pair masks AND the carried-state
+    continuation/keep masks)."""
+    from veomni_tpu.models.qwen3_next import chunk_gated_delta_rule
+
+    rng = np.random.default_rng(7)
+    b, h, dk, dv = 2, 3, 8, 8
+    la, lb = 40, 56  # chunk=64: boundary at 40; doc B spans chunks 0->1
+    s = la + lb
+
+    def mk(*shape):
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    from veomni_tpu.models.qwen3_next import _l2norm
+
+    # q/k l2-normalized as in the model: the delta-rule in-chunk inversion
+    # is only well-conditioned for unit keys (real usage always normalizes)
+    q, k = _l2norm(mk(b, s, h, dk)), _l2norm(mk(b, s, h, dk))
+    v = mk(b, s, h, dv)
+    g = -jnp.abs(mk(b, s, h)) * 0.1
+    beta = jax.nn.sigmoid(mk(b, s, h))
+    seg = jnp.asarray([[1] * la + [2] * lb] * b, jnp.int32)
+
+    packed = chunk_gated_delta_rule(q, k, v, g, beta, segment_ids=seg)
+    out_a = chunk_gated_delta_rule(
+        q[:, :la], k[:, :la], v[:, :la], g[:, :la], beta[:, :la])
+    out_b = chunk_gated_delta_rule(
+        q[:, la:], k[:, la:], v[:, la:], g[:, la:], beta[:, la:])
+    np.testing.assert_allclose(packed[:, :la], out_a, atol=2e-4)
+    np.testing.assert_allclose(packed[:, la:], out_b, atol=2e-4)
+
+    # segment_ids=None (single doc) still matches an all-ones mask run
+    ref = chunk_gated_delta_rule(q, k, v, g, beta)
+    one = chunk_gated_delta_rule(
+        q, k, v, g, beta, segment_ids=jnp.ones((b, s), jnp.int32))
+    np.testing.assert_allclose(ref, one, atol=1e-6)
+
+
+def test_forward_packed_vs_separate_documents():
+    """Full hybrid forward: each document of a packed row equals its
+    standalone forward (conv taps, delta-rule state, and full attention all
+    boundary-isolated)."""
+    from veomni_tpu.models.qwen3_next import abstract_params  # noqa: F401
+    from veomni_tpu.models.qwen3_next import forward_hidden, init_params
+
+    cfg = _cfg(moe=False)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    rng = np.random.default_rng(3)
+    la, lb = 20, 12
+    ids_a = rng.integers(0, cfg.vocab_size, (1, la))
+    ids_b = rng.integers(0, cfg.vocab_size, (1, lb))
+
+    packed = {
+        "input_ids": jnp.asarray(np.concatenate([ids_a, ids_b], 1), jnp.int32),
+        "position_ids": jnp.asarray(
+            np.concatenate([np.arange(la)[None], np.arange(lb)[None]], 1),
+            jnp.int32),
+        "segment_ids": jnp.asarray([[1] * la + [2] * lb], jnp.int32),
+    }
+    hp, _, _ = forward_hidden(params, cfg, packed["input_ids"],
+                              packed["position_ids"], packed["segment_ids"])
+    for ids, lo, hi in ((ids_a, 0, la), (ids_b, la, la + lb)):
+        n = hi - lo
+        hs, _, _ = forward_hidden(
+            params, cfg, jnp.asarray(ids, jnp.int32),
+            jnp.asarray(np.arange(n)[None], jnp.int32),
+            jnp.ones((1, n), jnp.int32))
+        np.testing.assert_allclose(
+            np.asarray(hp[:, lo:hi]), np.asarray(hs), atol=2e-4,
+            err_msg=f"doc [{lo}:{hi}] leaked cross-document state")

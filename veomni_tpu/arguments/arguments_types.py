@@ -120,7 +120,11 @@ class TrainingArguments:
     # EvaluateCallback is a TODO stub — this one is real)
     eval_steps: int = 0               # every N steps (0 = at train end only if eval_path set)
     eval_batches: int = 32            # micro-batches per evaluation
-    # observability
+    # input pipeline: host batches assembled this many steps ahead on a
+    # worker thread (reference BackgroundPrefetcher); 0 = synchronous
+    prefetch_depth: int = 2
+    # observability. log_steps is also the host<->device sync cadence: the
+    # loop only fetches metrics (blocking on the device) every log_steps
     log_steps: int = 1
     enable_profiling: bool = False
     profile_start_step: int = 3

@@ -69,5 +69,26 @@ def build_conversation_transform(tokenizer, max_seq_len: int = 0, messages_key: 
     return transform
 
 
+# transforms registered outside this module, keyed by the module that owns
+# them. The lookup owner (this function) imports the registering module on
+# demand so callers never depend on import order (a fresh process calling
+# build_data_transform("qwen3_omni") must not KeyError just because nothing
+# imported omni_data yet).
+_LAZY_TRANSFORM_MODULES = {
+    "qwen3_omni": "veomni_tpu.data.omni_data",
+    "vlm": "veomni_tpu.data.multimodal",
+    "qwen2_5_vl": "veomni_tpu.data.multimodal",
+    "qwen3_vl": "veomni_tpu.data.multimodal",
+    "qwen2_vl": "veomni_tpu.data.multimodal",
+    "qwen2_5_vl_conversation": "veomni_tpu.data.multimodal",
+    "rl": "veomni_tpu.trainer.rl_trainer",
+    "dpo": "veomni_tpu.trainer.dpo_trainer",
+}
+
+
 def build_data_transform(data_type: str, tokenizer=None, **kwargs) -> Callable:
+    if data_type not in DATA_TRANSFORM_REGISTRY and data_type in _LAZY_TRANSFORM_MODULES:
+        import importlib
+
+        importlib.import_module(_LAZY_TRANSFORM_MODULES[data_type])
     return DATA_TRANSFORM_REGISTRY.get(data_type)(tokenizer=tokenizer, **kwargs)

@@ -1,0 +1,98 @@
+"""Background host-batch prefetch.
+
+Reference capability: ``veomni/trainer/base.py:97-199`` (BackgroundPrefetcher
+/ VeOmniIter — batch assembly on a worker thread, overlapped with the device
+step) and the non-blocking H2D transfers at ``:681-703``. On TPU the H2D
+overlap is free (``device_put`` dispatches asynchronously); what still costs
+wall-clock is the *host-side* work — tokenize/pack/collate — which this
+thread hides behind device compute.
+
+Checkpoint contract: the loader cursor saved in a checkpoint must describe
+the last batch the *trainer consumed*, not the last one the thread pulled
+(the thread runs ahead by ``depth`` batches; saving its cursor would skip
+those batches on resume). ``state_dict()`` therefore returns the snapshot
+captured right after the consumed batch was pulled from the underlying
+loader.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterator, Optional
+
+_SENTINEL = object()
+
+
+class BackgroundPrefetcher:
+    """Iterates ``dataloader`` on a daemon thread, ``depth`` batches ahead.
+
+    Propagates the underlying iterator's exceptions (incl. StopIteration) at
+    the point of consumption. ``close()`` stops the thread; it is also safe
+    to simply drop the object (daemon thread, bounded queue).
+    """
+
+    def __init__(self, dataloader, depth: int = 2):
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1")
+        self.dataloader = dataloader
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._consumed_state: Optional[Dict[str, Any]] = (
+            dataloader.state_dict() if hasattr(dataloader, "state_dict") else None
+        )
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for batch in self.dataloader:
+                snap = (
+                    self.dataloader.state_dict()
+                    if hasattr(self.dataloader, "state_dict")
+                    else None
+                )
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put((batch, snap, None), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            self._queue.put((_SENTINEL, None, None))
+        except BaseException as e:  # surface worker errors to the consumer
+            try:
+                self._queue.put((_SENTINEL, None, e))
+            except Exception:
+                pass
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        batch, snap, err = self._queue.get()
+        if batch is _SENTINEL:
+            if err is not None:
+                raise err
+            raise StopIteration
+        self._consumed_state = snap
+        return batch
+
+    def state_dict(self) -> Optional[Dict[str, Any]]:
+        return self._consumed_state
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        raise RuntimeError(
+            "restore the underlying dataloader BEFORE constructing the "
+            "prefetcher (the thread starts pulling at construction)"
+        )
+
+    def close(self):
+        self._stop.set()
+        # unblock a worker stuck on put() by draining one slot
+        try:
+            self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)

@@ -24,11 +24,13 @@ TPU-first design:
 Semantics match ``transformers`` Qwen3Next (torch fallback path:
 ``torch_chunk_gated_delta_rule``) and are parity-tested against it.
 
-Limitations (v1): packed multi-segment rows are not reset-aware in the
-linear-attention state (segment ids still mask the full-attention layers);
-use one document per row. Sequence parallelism applies to the full-attention
-layers via the ops.attention facade; linear layers compute on the gathered
-sequence (GSPMD handles the sharded scan).
+Packed multi-segment rows are fully reset-aware: ``segment_ids`` mask the
+full-attention layers (ops.attention facade), reset the delta-rule
+recurrence at document boundaries (see ``chunk_gated_delta_rule``), and
+zero conv taps crossing boundaries — matching the reference's varlen
+``ops/kernels/gated_delta_rule`` handling. Sequence parallelism applies to
+the full-attention layers via the ops.attention facade; linear layers
+compute on the gathered sequence (GSPMD handles the sharded scan).
 """
 
 from __future__ import annotations
@@ -53,25 +55,47 @@ def _l2norm(x, eps=1e-6):
     return x * jax.lax.rsqrt((x * x).sum(-1, keepdims=True) + eps)
 
 
-def chunk_gated_delta_rule(q, k, v, g, beta, chunk: int = 64):
+def chunk_gated_delta_rule(q, k, v, g, beta, chunk: int = 64, segment_ids=None):
     """q/k [B,S,H,Dk] (pre-l2norm'd, head-repeated), v [B,S,H,Dv],
     g [B,S,H] log-decay (f32), beta [B,S,H]. Returns [B,S,H,Dv] (f32).
 
     Chunkwise form of: S_t = S_{t-1}*exp(g_t) + k_t (beta_t (v_t - k_t^T
     S_{t-1}exp(g_t)))^T; o_t = q_t S_t. In-chunk inversion via triangular
     solve instead of the reference's row-by-row forward substitution.
+
+    ``segment_ids`` [B,S] (packed documents; 0 = padding) resets the
+    recurrence at document boundaries, matching the reference's varlen
+    handling (``ops/kernels/gated_delta_rule`` cu_seqlens path) without
+    re-chunking per document: because documents are contiguous, every
+    cross-document interaction is killed by masks —
+
+    * in-chunk pair masks (tril AND same-segment) on the decay matrix, the
+      UT-transform Gram matrix, and the intra-chunk attention: the
+      triangular solve becomes block-diagonal per document, so ``v_prime``/
+      ``k_cumdecay`` rows never mix documents;
+    * a continuation mask (position's segment == segment at the end of the
+      previous chunk) gates every read of the carried state S — only the
+      document that was active at the previous chunk boundary may see it;
+    * the state update keeps S only if no boundary occurred in the chunk and
+      accumulates only positions belonging to the chunk-final document.
     """
     b, s, h, dk = q.shape
     dv = v.shape[-1]
     q, k, v = (x.transpose(0, 2, 1, 3).astype(jnp.float32) for x in (q, k, v))
     g = g.transpose(0, 2, 1).astype(jnp.float32)       # [B,H,S]
     beta = beta.transpose(0, 2, 1).astype(jnp.float32)  # [B,H,S]
+    seg = (
+        jnp.ones((b, s), jnp.int32)
+        if segment_ids is None
+        else segment_ids.astype(jnp.int32)
+    )
 
     pad = (-s) % chunk
     if pad:
         q, k, v = (jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0))) for x in (q, k, v))
         g = jnp.pad(g, ((0, 0), (0, 0), (0, pad)))
         beta = jnp.pad(beta, ((0, 0), (0, 0), (0, pad)))
+        seg = jnp.pad(seg, ((0, 0), (0, pad)))
     n = (s + pad) // chunk
     c = chunk
 
@@ -80,18 +104,24 @@ def chunk_gated_delta_rule(q, k, v, g, beta, chunk: int = 64):
     v = v.reshape(b, h, n, c, dv)
     g = g.reshape(b, h, n, c).cumsum(-1)               # in-chunk cumulative decay
     beta = beta.reshape(b, h, n, c)
+    seg = seg.reshape(b, 1, n, c)                      # broadcast over heads
 
     k_beta = k * beta[..., None]
     v_beta = v * beta[..., None]
-    # decay[i,j] = exp(g_i - g_j) for j <= i. Mask the exponent BEFORE exp:
-    # upper-triangle g_i - g_j is large-positive, and where(mask, exp(big), 0)
-    # backprops 0 * inf = NaN through the exp.
+    # pair mask: j <= i AND same document (documents are contiguous, so the
+    # in-chunk cumsum g_i - g_j spans only same-document decay when i,j are
+    # in the same document)
     tril = jnp.tril(jnp.ones((c, c), bool))
-    decay = jnp.exp(jnp.where(tril, g[..., :, None] - g[..., None, :], -1e30))
+    same = seg[..., :, None] == seg[..., None, :]      # [B,1,n,c,c]
+    mask = tril & same
+    # decay[i,j] = exp(g_i - g_j) for valid pairs. Mask the exponent BEFORE
+    # exp: upper-triangle g_i - g_j is large-positive, and
+    # where(mask, exp(big), 0) backprops 0 * inf = NaN through the exp.
+    decay = jnp.exp(jnp.where(mask, g[..., :, None] - g[..., None, :], -1e30))
 
     # UT transform: T = (I + strict_tril(k_beta K^T * decay))^{-1}
     kk = jnp.einsum("bhnic,bhnjc->bhnij", k_beta, k) * decay
-    kk = jnp.where(jnp.tril(jnp.ones((c, c), bool), -1), kk, 0.0)
+    kk = jnp.where(jnp.tril(jnp.ones((c, c), bool), -1) & same, kk, 0.0)
     eye = jnp.eye(c, dtype=jnp.float32)
     T = jax.scipy.linalg.solve_triangular(
         eye + kk, jnp.broadcast_to(eye, kk.shape), lower=True, unit_diagonal=True
@@ -101,48 +131,76 @@ def chunk_gated_delta_rule(q, k, v, g, beta, chunk: int = 64):
         "bhnij,bhnjd->bhnid", T, k_beta * jnp.exp(g)[..., None]
     )
 
-    def chunk_step(S, xs):
-        q_i, k_i, v_i, g_i, kcd_i = xs
+    def chunk_step(carry, xs):
+        S, seg_prev_last = carry                       # S [B,H,dk,dv]; [B,1]
+        q_i, k_i, v_i, g_i, kcd_i, seg_i = xs          # seg_i [B,1,c]
+        cont = (seg_i == seg_prev_last[..., None]).astype(jnp.float32)
         attn = jnp.einsum("bhic,bhjc->bhij", q_i, k_i)
+        mask_i = tril & (seg_i[..., :, None] == seg_i[..., None, :])
         dec_i = jnp.exp(
-            jnp.where(tril, g_i[..., :, None] - g_i[..., None, :], -1e30)
+            jnp.where(mask_i, g_i[..., :, None] - g_i[..., None, :], -1e30)
         )
-        attn = jnp.where(tril, attn, 0.0) * dec_i
-        v_new = v_i - jnp.einsum("bhik,bhkd->bhid", kcd_i, S)
+        attn = jnp.where(mask_i, attn, 0.0) * dec_i
+        v_new = v_i - jnp.einsum(
+            "bhik,bhkd->bhid", kcd_i * cont[..., None], S
+        )
         out_i = (
             jnp.einsum("bhik,bhkd->bhid", q_i * jnp.exp(g_i)[..., None], S)
+            * cont[..., None]
             + jnp.einsum("bhij,bhjd->bhid", attn, v_new)
         )
+        seg_last = seg_i[..., -1]                      # [B,1]
+        keep = (seg_last == seg_prev_last).astype(jnp.float32)
+        accum = (seg_i == seg_last[..., None]).astype(jnp.float32)
         g_last = g_i[..., -1]
-        S = S * jnp.exp(g_last)[..., None, None] + jnp.einsum(
-            "bhik,bhid->bhkd", k_i * jnp.exp(g_last[..., None] - g_i)[..., None], v_new
-        )
-        return S, out_i
+        S = S * jnp.exp(g_last)[..., None, None] * keep[..., None, None] \
+            + jnp.einsum(
+                "bhik,bhid->bhkd",
+                k_i * jnp.exp(g_last[..., None] - g_i)[..., None]
+                * accum[..., None],
+                v_new,
+            )
+        return (S, seg_last), out_i
 
     xs = tuple(
-        jnp.moveaxis(x, 2, 0) for x in (q, k, v_prime, g, k_cumdecay)
+        jnp.moveaxis(x, 2, 0) for x in (q, k, v_prime, g, k_cumdecay, seg)
     )  # each [n, B, H, ...]
     S0 = jnp.zeros((b, h, dk, dv), jnp.float32)
-    _, out = jax.lax.scan(chunk_step, S0, xs)
+    _, out = jax.lax.scan(chunk_step, (S0, seg[:, :, 0, 0]), xs)
     out = jnp.moveaxis(out, 0, 2).reshape(b, h, n * c, dv)[:, :, :s]
     return out.transpose(0, 2, 1, 3)  # [B,S,H,Dv]
 
 
-def _causal_conv1d(x, weight):
+def _causal_conv1d(x, weight, segment_ids=None):
     """Depthwise causal conv: x [B,S,C], weight [C,K] -> [B,S,C] (silu'd).
 
     Written as K shifted multiply-adds rather than ``lax.conv``: the kernel
     is tiny (K=4), elementwise ops fuse into the surrounding projections, and
     XLA:CPU's oneDNN grouped-conv path computes in reduced precision (breaks
-    the HF-parity oracle)."""
+    the HF-parity oracle).
+
+    With ``segment_ids`` [B,S], taps reaching across a packed-document
+    boundary are zeroed (each document sees the same left-zero-padded window
+    it would see unpacked)."""
     s = x.shape[1]
     k = weight.shape[-1]
     xp = jnp.pad(x, ((0, 0), (k - 1, 0), (0, 0)))
-    out = sum(weight[None, None, :, i] * xp[:, i:i + s, :] for i in range(k))
+    if segment_ids is None:
+        return jax.nn.silu(
+            sum(weight[None, None, :, i] * xp[:, i:i + s, :] for i in range(k))
+        )
+    # pad with -1 so out-of-range taps never match a real segment id
+    segp = jnp.pad(segment_ids, ((0, 0), (k - 1, 0)), constant_values=-1)
+    out = sum(
+        weight[None, None, :, i]
+        * xp[:, i:i + s, :]
+        * (segp[:, i:i + s] == segment_ids)[..., None]
+        for i in range(k)
+    )
     return jax.nn.silu(out)
 
 
-def _gated_delta_net(x, lp, cfg: TransformerConfig):
+def _gated_delta_net(x, lp, cfg: TransformerConfig, segment_ids=None):
     """One GatedDeltaNet mixer (HF Qwen3NextGatedDeltaNet.forward)."""
     b, s, _ = x.shape
     nk, nv = cfg.linear_num_key_heads, cfg.linear_num_value_heads
@@ -167,7 +225,7 @@ def _gated_delta_net(x, lp, cfg: TransformerConfig):
         [qg.reshape(b, s, key_dim), kg.reshape(b, s, key_dim),
          vg.reshape(b, s, value_dim)], axis=-1
     )
-    mixed = _causal_conv1d(mixed, lp["conv_weight"])
+    mixed = _causal_conv1d(mixed, lp["conv_weight"], segment_ids)
     q = mixed[..., :key_dim].reshape(b, s, nk, dk)
     k = mixed[..., key_dim:2 * key_dim].reshape(b, s, nk, dk)
     v = mixed[..., 2 * key_dim:].reshape(b, s, nv, dv)
@@ -182,7 +240,9 @@ def _gated_delta_net(x, lp, cfg: TransformerConfig):
         q = jnp.repeat(q, rep, axis=2)
         k = jnp.repeat(k, rep, axis=2)
 
-    out = chunk_gated_delta_rule(q, k, v, g, beta)  # [B,S,nv,dv] f32
+    out = chunk_gated_delta_rule(
+        q, k, v, g, beta, segment_ids=segment_ids
+    )  # [B,S,nv,dv] f32
 
     # gated RMSNorm (norm before gate), f32 silu gate
     var = (out * out).mean(-1, keepdims=True)
@@ -350,7 +410,9 @@ def forward_hidden(params, cfg, input_ids, position_ids, segment_ids=None,
 
         def lin_body(h_, lp):
             h_, aux, drop = _sublayer(
-                h_, lp, lambda x, lp_: _gated_delta_net(x, lp_, cfg), cfg=cfg
+                h_, lp,
+                lambda x, lp_: _gated_delta_net(x, lp_, cfg, segment_ids),
+                cfg=cfg,
             )
             return h_, (aux, drop)
 
@@ -377,14 +439,6 @@ def forward_hidden(params, cfg, input_ids, position_ids, segment_ids=None,
 
 
 def loss_fn(params, cfg, batch):
-    if batch.get("segment_ids") is not None:
-        from veomni_tpu.utils.logging import get_logger
-
-        get_logger(__name__).warning_once(
-            "qwen3_next: linear-attention layers carry recurrent state across "
-            "packed segments (full-attention layers do mask them). For strict "
-            "isolation train with one document per row (packing off)."
-        )
     hidden, aux, dropped = forward_hidden(
         params, cfg, batch["input_ids"], batch["position_ids"],
         batch.get("segment_ids"),

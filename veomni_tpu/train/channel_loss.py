@@ -68,20 +68,35 @@ class ChannelLossCallback(Callback):
         self.log_steps = log_steps
         self._sums = [0.0] * len(self.names)
         self._counts = [0.0] * len(self.names)
+        self._acc_sums = None   # device-side (lazy) running sums
+        self._acc_counts = None
+
+    def _fold(self):
+        """Fetch the device accumulators into the host totals (one sync)."""
+        if self._acc_sums is None:
+            return
+        import numpy as np
+
+        sums = np.asarray(self._acc_sums)
+        counts = np.asarray(self._acc_counts)
+        for i in range(len(self.names)):
+            self._sums[i] += float(sums[i])
+            self._counts[i] += float(counts[i])
+        self._acc_sums = self._acc_counts = None
 
     def on_step_end(self, trainer, state):
         sums = state.metrics.pop("channel_loss_sums", None)
         counts = state.metrics.pop("channel_token_counts", None)
         if sums is None:
             return
-        import numpy as np
-
-        sums = np.asarray(sums)
-        counts = np.asarray(counts)
-        for i in range(len(self.names)):
-            self._sums[i] += float(sums[i])
-            self._counts[i] += float(counts[i])
+        # add without materializing: between log steps these are device
+        # futures and fetching them would block the async loop
+        self._acc_sums = sums if self._acc_sums is None else self._acc_sums + sums
+        self._acc_counts = (
+            counts if self._acc_counts is None else self._acc_counts + counts
+        )
         if state.global_step % self.log_steps == 0:
+            self._fold()
             parts = [
                 f"{n}={self._sums[i] / max(self._counts[i], 1):.4f}"
                 f"({int(self._counts[i])}tok)"
@@ -90,6 +105,7 @@ class ChannelLossCallback(Callback):
             logger.info_rank0("channel_loss | %s", " | ".join(parts))
 
     def state_dict(self):
+        self._fold()
         return {"sums": self._sums, "counts": self._counts}
 
     def load_state_dict(self, state):

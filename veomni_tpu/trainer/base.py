@@ -105,6 +105,12 @@ class BaseTrainer:
                 "(set platform: cpu for virtual-mesh simulation)"
             )
         if t.platform:
+            if t.platform == "cpu":
+                # entrypoints apply TPU perf flags before parsing args; the
+                # CPU backend aborts on unknown --xla_tpu_* flags
+                from veomni_tpu.utils.xla_flags import strip_tpu_flags
+
+                strip_tpu_flags()
             # must run before first backend use (the axon TPU plugin overrides
             # JAX_PLATFORMS via jax.config, so env vars alone don't stick)
             updates = [("jax_platforms", t.platform)]
@@ -389,7 +395,7 @@ class BaseTrainer:
         t = self.args.train
         self.callbacks = [
             EnvironMeterCallback(self.meter),
-            LoggingCallback(t.log_steps),
+            LoggingCallback(),
             CheckpointCallback(self.checkpointer, t.save_steps),
         ]
         if self.args.data.eval_path:
@@ -508,26 +514,55 @@ class BaseTrainer:
             getattr(cb, hook)(self, state)
 
     def train(self):
+        t = self.args.train
         ctl = TrainerControlState(train_steps=self.train_steps)
         with use_parallel_state(self.parallel_state):
             self._fire("on_train_begin", ctl)
-            data_iter = iter(self.dataloader)
-            while ctl.global_step < self.train_steps and not ctl.should_stop:
-                batch_np = next(data_iter)
-                self.current_batch = batch_np
-                self._fire("on_step_begin", ctl)
-                # each process holds [A, B_local, S]; stitch into the
-                # globally-sharded array (single-controller semantics)
-                batch = self._ship_batch(batch_np)
-                self.train_state, metrics = self.train_step(self.train_state, batch)
-                ctl.global_step += 1
-                ctl.metrics = {
-                    k: (float(v) if np.ndim(v) == 0 else np.asarray(v))
-                    for k, v in metrics.items()
-                }
-                # optax evaluated the schedule at count == step-1 for the
-                # update just applied; log that value, not the next step's
-                ctl.metrics["lr"] = float(self.lr_schedule(ctl.global_step - 1))
-                self._fire("on_step_end", ctl)
+            # prefetcher construction AFTER on_train_begin: auto-resume
+            # restores the dataloader cursor there, and the thread starts
+            # pulling at construction
+            self._prefetcher = None
+            if t.prefetch_depth > 0:
+                from veomni_tpu.data.prefetch import BackgroundPrefetcher
+
+                self._prefetcher = BackgroundPrefetcher(
+                    self.dataloader, depth=t.prefetch_depth
+                )
+            data_iter = iter(self._prefetcher or self.dataloader)
+            try:
+                while ctl.global_step < self.train_steps and not ctl.should_stop:
+                    batch_np = next(data_iter)
+                    self.current_batch = batch_np
+                    self._fire("on_step_begin", ctl)
+                    # each process holds [A, B_local, S]; stitch into the
+                    # globally-sharded array (single-controller semantics)
+                    batch = self._ship_batch(batch_np)
+                    self.train_state, metrics = self.train_step(self.train_state, batch)
+                    ctl.global_step += 1
+                    # the step dispatches asynchronously; materializing a
+                    # metric would block the host on device completion and
+                    # serialize batch assembly with compute. Fetch only on
+                    # log steps (which also bounds dispatch-ahead depth);
+                    # in between, callbacks receive device futures.
+                    ctl.synced = (
+                        ctl.global_step % t.log_steps == 0
+                        or ctl.global_step >= self.train_steps
+                    )
+                    if ctl.synced:
+                        metrics = {
+                            k: (float(v) if np.ndim(v) == 0 else np.asarray(v))
+                            for k, v in metrics.items()
+                        }
+                    ctl.metrics = dict(metrics)
+                    if ctl.synced:
+                        # optax evaluated the schedule at count == step-1 for
+                        # the update just applied; log that value, not the
+                        # next step's. Schedules are jnp programs, so this
+                        # float() is itself a device fetch — sync steps only.
+                        ctl.metrics["lr"] = float(self.lr_schedule(ctl.global_step - 1))
+                    self._fire("on_step_end", ctl)
+            finally:
+                if self._prefetcher is not None:
+                    self._prefetcher.close()
             self._fire("on_train_end", ctl)
         return ctl

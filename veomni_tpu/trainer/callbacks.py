@@ -28,6 +28,10 @@ class TrainerControlState:
     epoch: int = 0
     metrics: Dict[str, float] = field(default_factory=dict)
     should_stop: bool = False
+    # True on steps where the loop materialized metrics to host floats (log
+    # cadence + final step). On other steps metrics hold device futures;
+    # callbacks that read values must gate on this to keep the loop async.
+    synced: bool = True
 
 
 class Callback:
@@ -45,11 +49,10 @@ class Callback:
 
 
 class LoggingCallback(Callback):
-    def __init__(self, log_steps: int = 1):
-        self.log_steps = log_steps
+    """Console log on the loop's sync cadence (train.log_steps)."""
 
     def on_step_end(self, trainer, state):
-        if state.global_step % self.log_steps == 0:
+        if state.synced:
             parts = [f"step {state.global_step}/{state.train_steps}"]
             for k in ("loss", "grad_norm", "lr", "tokens_per_sec_per_chip", "mfu"):
                 if k in state.metrics:
@@ -59,7 +62,12 @@ class LoggingCallback(Callback):
 
 
 class EnvironMeterCallback(Callback):
-    """Feeds the MFU meter (reference EnvironMeterCallback)."""
+    """Feeds the MFU meter (reference EnvironMeterCallback).
+
+    With the async loop, per-step wall time measures dispatch, not compute —
+    only the fetch at a sync step absorbs the real device time. The meter is
+    therefore rolled up once per sync window (state.synced) so
+    throughput/MFU are window averages over real elapsed time."""
 
     def __init__(self, meter):
         self.meter = meter
@@ -130,7 +138,8 @@ class EnvironMeterCallback(Callback):
         return extra
 
     def on_step_end(self, trainer, state):
-        state.metrics.update(self.meter.step())
+        if state.synced:
+            state.metrics.update(self.meter.step())
 
 
 class EvaluateCallback(Callback):
@@ -179,10 +188,13 @@ class CheckpointCallback(Callback):
 
     def _rank_state(self, trainer) -> Dict[str, Any]:
         # rank-LOCAL: the dataloader cursor + packing carry-over buffer hold
-        # this process's data shard; each rank saves/restores its own
+        # this process's data shard; each rank saves/restores its own.
+        # With background prefetch the thread runs ahead of the trainer, so
+        # the cursor must come from the prefetcher (last CONSUMED batch).
+        src = getattr(trainer, "_prefetcher", None) or trainer.dataloader
         return {
-            "dataloader": trainer.dataloader.state_dict()
-            if hasattr(trainer.dataloader, "state_dict")
+            "dataloader": src.state_dict()
+            if hasattr(src, "state_dict")
             else None,
         }
 
@@ -279,10 +291,28 @@ class WandbCallback(Callback):
         except Exception as e:  # wandb not installed / no network
             logger.warning_rank0("wandb disabled: %s", e)
 
+    @staticmethod
+    def _host_floats(metrics):
+        # host scalars only: a device future here would block the async loop
+        return {
+            k: v for k, v in metrics.items() if isinstance(v, (int, float))
+        }
+
     def on_step_end(self, trainer, state):
-        if self._run is not None:
-            self._run.log(state.metrics, step=state.global_step)
+        if self._run is None:
+            return
+        # sync cadence — plus any step that produced host-side metrics
+        # outside it (e.g. EvaluateCallback's eval_loss on eval_steps)
+        if state.synced or "eval_loss" in state.metrics:
+            payload = self._host_floats(state.metrics)
+            if payload:
+                self._run.log(payload, step=state.global_step)
 
     def on_train_end(self, trainer, state):
         if self._run is not None:
+            # end-of-train metrics written by earlier on_train_end hooks
+            # (final eval) land after the last step's log
+            payload = self._host_floats(state.metrics)
+            if payload:
+                self._run.log(payload, step=state.global_step)
             self._run.finish()

@@ -69,7 +69,10 @@ class EnvironMeter:
         return metrics
 
     def state_dict(self) -> Dict[str, Any]:
-        return {"consumed_tokens": self.consumed_tokens}
+        # include tokens added but not yet folded by step(): with the
+        # log-step rollup cadence a mid-window checkpoint must not
+        # undercount trained tokens
+        return {"consumed_tokens": self.consumed_tokens + self._step_tokens}
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.consumed_tokens = int(state.get("consumed_tokens", 0))

@@ -22,9 +22,38 @@ _PERF_FLAGS = (
 )
 
 
+def enable_compilation_cache() -> str | None:
+    """Point JAX's persistent compilation cache at a repo-local directory so
+    repeat runs (notably the driver's round-end ``bench.py``) pay zero
+    compile time. First TPU compiles through the relay take 20-40s each and
+    have hit the 900s bench watchdog twice; the cache is the mitigation.
+    Disable with ``VEOMNI_COMPILATION_CACHE=0``."""
+    if os.environ.get("VEOMNI_COMPILATION_CACHE", "1") in ("0", "false"):
+        return None
+    cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache"),
+    )
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything, even fast compiles: the relay's fixed per-compile
+        # round-trip dominates small programs too
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        return None
+    return cache_dir
+
+
 def apply_performance_flags() -> bool:
-    """Append the TPU perf flags to XLA_FLAGS (idempotent). Returns whether
-    the flags are active."""
+    """Append the TPU perf flags to XLA_FLAGS (idempotent) and enable the
+    persistent compilation cache. Returns whether the flags are active."""
+    # the cache has its own kill switch (VEOMNI_COMPILATION_CACHE) and must
+    # stay on even when the perf flags are disabled for debugging
+    enable_compilation_cache()
     if os.environ.get("VEOMNI_XLA_PERF_FLAGS", "1") in ("0", "false"):
         return False
     import jax
@@ -37,3 +66,15 @@ def apply_performance_flags() -> bool:
     if added:
         os.environ["XLA_FLAGS"] = (current + " " + " ".join(added)).strip()
     return True
+
+
+def strip_tpu_flags() -> None:
+    """Remove the ``--xla_tpu_*`` perf flags from XLA_FLAGS. The CPU backend
+    ABORTS the process on unknown flags (parse_flags_from_env), so a run
+    that applied the TPU flags and then switches to ``train.platform: cpu``
+    (virtual-mesh simulation) must strip them before first backend init."""
+    current = os.environ.get("XLA_FLAGS", "")
+    if not current:
+        return
+    kept = [t for t in current.split() if not t.startswith("--xla_tpu_")]
+    os.environ["XLA_FLAGS"] = " ".join(kept)
