@@ -28,6 +28,9 @@ DEFAULT = [
 
 
 def main():
+    from veomni_tpu.utils.xla_flags import apply_performance_flags
+
+    apply_performance_flags()
     configs = json.loads(os.environ.get("SWEEP_CONFIGS", "null")) or DEFAULT
     steps = int(os.environ.get("SWEEP_STEPS", 8))
     results = []

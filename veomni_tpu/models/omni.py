@@ -1,4 +1,4 @@
-"""Omni composite: any-modality encoders + foundation LM.
+"""Omni composite: any-modality encoders + foundation LM + generation decoders.
 
 Reference: ``veomni/models/seed_omni/modeling_seed_omni.py:63-423``
 (SeedOmniModel = N encoders (vision/audio) + foundation LM + N decoders,
@@ -8,9 +8,16 @@ TPU design: like the VLM, every modality occupies *static slots* —
 ``pixel_patches [B, max_images, P, D]`` and ``audio_features
 [B, max_audio, frames, mels]`` — and encoder outputs are scattered into the
 token stream at modality-placeholder positions. Freezing is functional
-(stop_gradient per module). Image-generation decoders integrate as a DiT
-head trained separately (models/dit.py); generation-side fusion is round-2
-scope.
+(stop_gradient per module).
+
+Image GENERATION (reference ``seed_omni/decoder/movqgan``, the lm_encode /
+lm_head contract at ``decoder/base.py:63-98``): output images are VQ-encoded
+by a MoVQGAN tokenizer into codebook indices; their codebook embeddings are
+projected into the LM stream at ``image_gen_token_id`` slots, and a
+generation head (linear-GELU-linear onto the codebook vocabulary) is trained
+next-token over LM hidden states via the same fused chunked CE as the text
+head — static shapes, no dynamic gathers (non-gen positions carry IGNORE
+labels exactly like padded text).
 """
 
 from __future__ import annotations
@@ -47,17 +54,47 @@ class AudioEncoderConfig:
 
 
 @dataclass
+class ImageGenConfig:
+    """Image-generation decoder attachment (reference
+    ``seed_omni/decoder/movqgan/configuration_movqgan.py`` + GenerationHead).
+
+    ``freeze_tokenizer`` mirrors ``set_projector_trainable_only``: the VQ
+    autoencoder stays frozen while the aligner + generation head train;
+    ``freeze_codebook=False`` additionally trains the codebook embedding."""
+
+    movq: "MoVQGANConfig" = None
+    gen_loss_weight: float = 1.0
+    freeze_tokenizer: bool = True
+    freeze_codebook: bool = True
+
+    def __post_init__(self):
+        from veomni_tpu.models.movqgan import MoVQGANConfig
+
+        if self.movq is None:
+            self.movq = MoVQGANConfig()
+        elif isinstance(self.movq, dict):
+            self.movq = MoVQGANConfig(**self.movq)
+
+    @property
+    def tokens_per_image(self) -> int:
+        return self.movq.tokens_per_image
+
+
+@dataclass
 class OmniConfig:
     text: TransformerConfig = field(default_factory=TransformerConfig)
     vision: Optional[ViTConfig] = None
     audio: Optional[AudioEncoderConfig] = None
+    image_gen: Optional[ImageGenConfig] = None
     image_token_id: int = 151655
     audio_token_id: int = 151646
+    image_gen_token_id: int = 151859
     freeze_vision: bool = False
     freeze_audio: bool = False
     freeze_text: bool = False
     max_images: int = 2
     max_audio: int = 2
+    max_gen_images: int = 1
     model_type: str = "seed_omni"
 
     def __post_init__(self):
@@ -67,6 +104,8 @@ class OmniConfig:
             self.vision = ViTConfig(**self.vision)
         if isinstance(self.audio, dict):
             self.audio = AudioEncoderConfig(**self.audio)
+        if isinstance(self.image_gen, dict):
+            self.image_gen = ImageGenConfig(**self.image_gen)
         for enc in (self.vision, self.audio):
             if enc is not None:
                 enc.out_hidden_size = self.text.hidden_size
