@@ -45,7 +45,7 @@ def main():
         with open(os.path.join(args.config, "config.json")) as f:
             hf = json.load(f)
         mt = hf.get("model_type", "")
-        if mt == "qwen2_vl":  # generic VLM composite: no config_from_hf
+        if mt == "slot_vlm":  # generic VLM composite: no config_from_hf
             config = build_config(mt, text=hf.get("text_config", hf))
         else:
             # delegate to auto's per-family config_from_hf dispatch so

@@ -21,7 +21,7 @@ TEXT = dict(model_type="qwen2", vocab_size=512, hidden_size=64, intermediate_siz
 def _vlm_config():
     from veomni_tpu.models.auto import build_config
 
-    return build_config("qwen2_vl", text=dict(TEXT, dtype=jnp.float32),
+    return build_config("slot_vlm", text=dict(TEXT, dtype=jnp.float32),
                         vision=VISION, image_token_id=500)
 
 
@@ -94,7 +94,7 @@ def test_vlm_trainer_e2e(tmp_path):
 
     args = VeOmniArguments()
     args.model.config_overrides = {
-        "model_type": "qwen2_vl", "text": dict(TEXT), "vision": dict(VISION),
+        "model_type": "slot_vlm", "text": dict(TEXT), "vision": dict(VISION),
         "image_token_id": 500,
     }
     args.data.train_path = str(tmp_path / "data.jsonl")

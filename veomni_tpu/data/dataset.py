@@ -156,4 +156,6 @@ class WeightedMultiSourceDataset:
 
 def build_dataset(dataset_type: str = "mapping", **kwargs):
     """Reference ``build_dataset`` (data/dataset.py:50)."""
+    if dataset_type == "streaming":
+        import veomni_tpu.data.streaming  # noqa: F401  (registers itself)
     return DATASET_REGISTRY.get(dataset_type)(**kwargs)

@@ -180,9 +180,11 @@ def image_to_qwen_patches(img: np.ndarray, vcfg) -> "tuple[np.ndarray, tuple]":
     return frames_to_qwen_patches(frames, vcfg)
 
 
+@DATA_TRANSFORM_REGISTRY.register("qwen2_vl")  # same row contract; the
 @DATA_TRANSFORM_REGISTRY.register("qwen2_5_vl")
-@DATA_TRANSFORM_REGISTRY.register("qwen3_vl")  # same row contract; the
-# config object (Qwen3VLConfig) carries the family-specific geometry
+@DATA_TRANSFORM_REGISTRY.register("qwen3_vl")
+# config object (Qwen2VLConfig / Qwen25VLConfig / Qwen3VLConfig) carries the
+# family-specific geometry
 def build_qwen25_vl_transform(
     tokenizer=None,
     *,
@@ -434,6 +436,27 @@ class Qwen25VLCollator:
         out["vis_seg_window"] = meta["seg_window"]
         out["vis_seg_full"] = meta["seg_full"]
         out["vis_reverse"] = meta["reverse"]
+        out["vis_merged_mask"] = meta["merged_mask"]
+        return out
+
+
+class Qwen2VLCollator(Qwen25VLCollator):
+    """Qwen2-VL variant: patches stay in processor (merge-block) order and
+    every layer attends globally per frame — the plan is just (pos_hw,
+    per-frame segments, merged_mask)."""
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        from veomni_tpu.models.qwen2_vl import mrope_position_ids, vision_metadata
+
+        cfg, vcfg = self.cfg, self.cfg.vision
+        out, px, all_grids = self._assemble_text(samples)
+        out["position_ids"] = mrope_position_ids(
+            out["input_ids"].astype(np.int64), all_grids, cfg
+        ).astype(np.int32)
+        meta = vision_metadata(all_grids, vcfg, self.max_patches)
+        out["pixel_values"] = px
+        out["vis_pos_hw"] = meta["pos_hw"]
+        out["vis_seg"] = meta["seg"]
         out["vis_merged_mask"] = meta["merged_mask"]
         return out
 

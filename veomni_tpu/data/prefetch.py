@@ -41,8 +41,19 @@ class BackgroundPrefetcher:
         self._consumed_state: Optional[Dict[str, Any]] = (
             dataloader.state_dict() if hasattr(dataloader, "state_dict") else None
         )
+        self._finished: Optional[BaseException | type] = None
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Stop-aware bounded put; False if the consumer went away."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def _worker(self):
         try:
@@ -52,27 +63,23 @@ class BackgroundPrefetcher:
                     if hasattr(self.dataloader, "state_dict")
                     else None
                 )
-                while not self._stop.is_set():
-                    try:
-                        self._queue.put((batch, snap, None), timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if self._stop.is_set():
+                if not self._put((batch, snap, None)):
                     return
-            self._queue.put((_SENTINEL, None, None))
+            self._put((_SENTINEL, None, None))
         except BaseException as e:  # surface worker errors to the consumer
-            try:
-                self._queue.put((_SENTINEL, None, e))
-            except Exception:
-                pass
+            self._put((_SENTINEL, None, e))
 
     def __iter__(self) -> Iterator:
         return self
 
     def __next__(self):
+        if self._finished is not None:  # latch: exhausted iterators stay so
+            if self._finished is not StopIteration:
+                raise self._finished
+            raise StopIteration
         batch, snap, err = self._queue.get()
         if batch is _SENTINEL:
+            self._finished = err if err is not None else StopIteration
             if err is not None:
                 raise err
             raise StopIteration

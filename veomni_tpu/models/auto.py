@@ -56,7 +56,7 @@ for _mt in (
     "llama", "qwen2", "qwen3", "qwen3_moe",
     "gemma3", "gemma3_text",
     "deepseek_v2", "deepseek_v3",
-    "gpt_oss", "seed_oss", "glm_moe", "glm4_moe",
+    "gpt_oss", "seed_oss", "glm_moe", "glm4_moe", "glm_moe_dsa",
 ):
     MODEL_REGISTRY.register(_mt, ModelFamily(model_type=_mt))
 
@@ -80,6 +80,27 @@ def _register_qwen3_next():
 
 
 _register_qwen3_next()
+
+
+def _register_deepseek_v4():
+    from veomni_tpu.models import deepseek_v4 as dsv4
+
+    MODEL_REGISTRY.register(
+        "deepseek_v4",
+        ModelFamily(
+            model_type="deepseek_v4",
+            config_cls=dsv4.DeepseekV4Config,
+            init_params=dsv4.init_params,
+            abstract_params=dsv4.abstract_params,
+            loss_fn=dsv4.loss_fn,
+            forward_logits=dsv4.forward_logits,
+            hf_to_params=dsv4.hf_to_params,
+            save_hf_checkpoint=dsv4.save_hf_checkpoint,
+        ),
+    )
+
+
+_register_deepseek_v4()
 
 
 def _register_vlm_families():
@@ -110,10 +131,12 @@ def _register_vlm_families():
             host["language_model"], cfg.text, f"{out_dir}/language_model"
         )
 
+    # generic fixed-slot VLM composite (any ViT + any registered LM) — the
+    # didactic/testing baseline; real checkpoint families have their own archs
     MODEL_REGISTRY.register(
-        "qwen2_vl",
+        "slot_vlm",
         ModelFamily(
-            model_type="qwen2_vl",
+            model_type="slot_vlm",
             config_cls=VLMConfig,
             init_params=vlm_mod.init_vlm_params,
             abstract_params=vlm_mod.abstract_vlm_params,
@@ -121,6 +144,24 @@ def _register_vlm_families():
             forward_logits=None,
             hf_to_params=None,
             save_hf_checkpoint=_save_native,
+        ),
+    )
+
+    # qwen2_vl is the real architecture (full-attn LayerNorm ViT, per-frame
+    # segments, quick-GELU MLP, mrope)
+    from veomni_tpu.models import qwen2_vl as q2vl
+
+    MODEL_REGISTRY.register(
+        "qwen2_vl",
+        ModelFamily(
+            model_type="qwen2_vl",
+            config_cls=q2vl.Qwen2VLConfig,
+            init_params=q2vl.init_params,
+            abstract_params=q2vl.abstract_params,
+            loss_fn=q2vl.loss_fn,
+            forward_logits=None,
+            hf_to_params=q2vl.hf_to_params,
+            save_hf_checkpoint=q2vl.save_hf_checkpoint,
         ),
     )
 
@@ -198,11 +239,12 @@ def _register_vlm_families():
 
 
 def _register_diffusion_families():
-    from veomni_tpu.models import qwen_image as qi_mod, wan as wan_mod
+    from veomni_tpu.models import flux as flux_mod, qwen_image as qi_mod, wan as wan_mod
 
     for mt, mod, cfg_cls in (
         ("wan_t2v", wan_mod, wan_mod.WanConfig),
         ("qwen_image", qi_mod, qi_mod.QwenImageConfig),
+        ("flux", flux_mod, flux_mod.FluxConfig),
     ):
         MODEL_REGISTRY.register(
             mt,
@@ -222,7 +264,7 @@ def _register_diffusion_families():
 _register_vlm_families()
 _register_diffusion_families()
 
-VLM_MODEL_TYPES = ("qwen2_vl", "qwen2_5_vl", "qwen3_vl", "qwen3_vl_moe")
+VLM_MODEL_TYPES = ("slot_vlm", "qwen2_vl", "qwen2_5_vl", "qwen3_vl", "qwen3_vl_moe")
 
 
 def build_config(model_type: str = "", **overrides):
@@ -232,8 +274,16 @@ def build_config(model_type: str = "", **overrides):
     nested text config so the same override surface works for both.
     """
     overrides.pop("model_type", None)
-    if model_type in ("qwen2_5_vl", "qwen3_vl", "qwen3_vl_moe"):
-        if model_type == "qwen2_5_vl":
+    if model_type == "deepseek_v4":
+        from veomni_tpu.models.deepseek_v4 import DeepseekV4Config
+
+        return DeepseekV4Config(**overrides)
+    if model_type in ("qwen2_vl", "qwen2_5_vl", "qwen3_vl", "qwen3_vl_moe"):
+        if model_type == "qwen2_vl":
+            from veomni_tpu.models.qwen2_vl import Qwen2VLConfig as vl_cfg
+
+            text_mt = "qwen2"
+        elif model_type == "qwen2_5_vl":
             from veomni_tpu.models.qwen2_5_vl import Qwen25VLConfig as vl_cfg
 
             text_mt = "qwen2"
@@ -350,7 +400,15 @@ def build_foundation_model(
 
         with open(_os.path.join(config_path, "config.json")) as f:
             hf_dict = _json.load(f)
-        if hf_dict.get("model_type") == "qwen2_5_vl":
+        if hf_dict.get("model_type") == "deepseek_v4":
+            from veomni_tpu.models.deepseek_v4 import config_from_hf as dsv4_from_hf
+
+            config = dsv4_from_hf(hf_dict, **config_overrides)
+        elif hf_dict.get("model_type") == "qwen2_vl":
+            from veomni_tpu.models.qwen2_vl import config_from_hf as q2vl_from_hf
+
+            config = q2vl_from_hf(hf_dict, **config_overrides)
+        elif hf_dict.get("model_type") == "qwen2_5_vl":
             from veomni_tpu.models.qwen2_5_vl import config_from_hf
 
             config = config_from_hf(hf_dict, **config_overrides)
@@ -376,6 +434,11 @@ def build_foundation_model(
             from veomni_tpu.models.qwen_image import config_from_hf as qi_from_hf
 
             config = qi_from_hf(hf_dict, **config_overrides)
+        elif (hf_dict.get("model_type") == "flux"
+              or hf_dict.get("_class_name") == "FluxTransformer2DModel"):
+            from veomni_tpu.models.flux import config_from_hf as flux_from_hf
+
+            config = flux_from_hf(hf_dict, **config_overrides)
         else:
             config = TransformerConfig.from_hf_config(hf_dict, **config_overrides)
     if config.model_type not in MODEL_REGISTRY:

@@ -65,6 +65,13 @@ class TransformerConfig:
     qk_nope_head_dim: int = 0
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
+    # DSA lightning indexer (glm_moe_dsa / DeepSeek-V3.2 sparse attention):
+    # per-token top-k KV selection scored by a lightweight side network
+    # (reference ``glm_moe_dsa/generated/...:123`` GlmMoeDsaIndexer)
+    index_n_heads: int = 0
+    index_head_dim: int = 0
+    index_topk: int = 0            # 0 -> DSA off
+    indexer_types: Any = ()        # per-layer "full" | "shared" (reuse prev)
     # MoE (num_experts == 0 -> dense MLP)
     num_experts: int = 0
     num_experts_per_tok: int = 0
@@ -129,6 +136,10 @@ class TransformerConfig:
     @property
     def use_mla(self) -> bool:
         return self.kv_lora_rank > 0
+
+    @property
+    def use_dsa(self) -> bool:
+        return self.index_topk > 0
 
     @property
     def q_dim(self) -> int:
@@ -230,6 +241,32 @@ class TransformerConfig:
                 router_aux_loss_coef=0.0,     # bias-update balancing, no aux term
                 norm_topk_prob=hf.get("norm_topk_prob", True),
             )
+        if mt == "glm_moe_dsa":
+            # MLA (deepseek-v3.2 lineage) + DSA indexer + glm4_moe routing
+            kw.update(
+                expert_layout="fused_chunked",
+                scoring_func="sigmoid",
+                router_aux_loss_coef=hf.get(
+                    "router_aux_loss_coef", hf.get("aux_loss_alpha", 0.0)
+                ),
+                norm_topk_prob=hf.get("norm_topk_prob", True),
+                rope_interleave=hf.get("rope_interleave", True),
+                index_n_heads=hf.get("index_n_heads", 0),
+                index_head_dim=hf.get("index_head_dim", 0),
+                index_topk=hf.get("index_topk", 0),
+                indexer_types=tuple(hf.get("indexer_types") or ()),
+            )
+            mlt = hf.get("mlp_layer_types")
+            if mlt and "first_k_dense_replace" not in hf:
+                k_dense = 0
+                while k_dense < len(mlt) and mlt[k_dense] == "dense":
+                    k_dense += 1
+                if any(t == "dense" for t in mlt[k_dense:]):
+                    raise ValueError(
+                        "glm_moe_dsa mlp_layer_types with non-prefix dense "
+                        "layers is unsupported (first_k_dense layout only)"
+                    )
+                kw["first_k_dense_replace"] = k_dense
         if mt in ("qwen3_next", "qwen3_5", "qwen3_5_moe"):
             # hybrid GatedDeltaNet (models/qwen3_next.py); layer pattern comes
             # from full_attention_interval, not HF layer_types
@@ -295,6 +332,14 @@ class TransformerConfig:
                 hf["final_logit_softcapping"] = self.final_logit_softcap
         if self.model_type in ("deepseek_v2", "deepseek_v3"):
             hf["aux_loss_alpha"] = hf.pop("router_aux_loss_coef")
+        if self.use_dsa:
+            hf.update(
+                index_n_heads=self.index_n_heads,
+                index_head_dim=self.index_head_dim,
+                index_topk=self.index_topk,
+                indexer_types=list(self.indexer_types),
+                rope_interleave=self.rope_interleave,
+            )
         if self.model_type == "qwen3_next":
             hf.update(
                 linear_num_value_heads=self.linear_num_value_heads,

@@ -1,0 +1,965 @@
+"""DeepSeek-V4 dialect: sliding/CSA/HCA hybrid attention + mHC + hash/topk MoE.
+
+Reference: ``veomni/models/transformers/deepseek_v4/generated/
+patched_modeling_deepseek_v4_gpu.py`` (2,050 LoC torch; architecture per the
+V4 paper §2). Components re-derived here:
+
+* **Attention** (`DeepseekV4Attention`): q via low-rank ``q_a→RMS→q_b`` with
+  per-head unweighted-RMS on the result; shared-KV MQA (ONE kv head read as
+  both K and V); interleaved partial RoPE on the *trailing* rope slice; the
+  attention output is de-roped (rotation by ``-sin``) so each KV entry's
+  contribution depends only on relative distance; per-head learnable sinks
+  (gpt-oss style extra softmax column); grouped low-rank output projection
+  (``o_groups`` block-diagonal ``o_a`` then dense ``o_b``).
+* **HCA** (`DeepseekV4HCACompressor`): every ``compress_rate_hca`` tokens of a
+  packed segment collapse into one compressed KV entry via a channel-wise
+  softmax gate (+ per-offset position bias), RMS-normed and roped at the
+  window's first intra-segment position. Entries join the KV axis with a
+  causal block bias (entry window strictly before the query's window).
+* **CSA** (`DeepseekV4CSACompressor` + `DeepseekV4Indexer`): overlapped
+  windows (width ``2·rate``, stride ``rate``; each token contributes a "Ca"
+  slice to the NEXT window and a "Cb" slice to its own), and a Lightning
+  Indexer that scores queries against its own compressed keys with
+  ``Σ_h w_h · ReLU(q_h · k)`` and keeps ``index_topk`` entries per query.
+* **mHC** (`DeepseekV4HyperConnection`/`HyperHead`): ``hc_mult`` parallel
+  residual streams; fp32 sigmoid pre/post weights and a Sinkhorn-projected
+  doubly-stochastic stream mixer.
+* **MoE**: every layer is sparse — sigmoid top-k router with correction bias
+  (first ``hash_moe`` layers use a frozen ``tid2eid`` token→expert table
+  instead of learned selection) + clamped-SwiGLU experts (``swiglu_limit``)
+  and a clamped shared expert.
+
+TPU-first design: no CUDA/TileLang sparse kernels — the fallback sanctioned
+by SURVEY (§7.4 "eager/XLA") computes attention densely over the
+``S + n_entries`` KV axis with additive bias in one fused XLA softmax
+(compressed entries reduce to gather/segment-sum einsums, packing handled by
+segment ids — no dynamic shapes anywhere). Layers with identical
+(layer_type, mlp_type) signatures are stacked and scanned in runs, so a
+frontier-depth stack compiles one body per signature, not per layer.
+KV-cache decode is out of scope (training + teacher-forced eval).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu import ops
+from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy
+from veomni_tpu.ops.rotary import _scale_inv_freq
+
+Params = Dict[str, Any]
+
+LAYER_SLIDING = "sliding_attention"
+LAYER_CSA = "compressed_sparse_attention"
+LAYER_HCA = "heavily_compressed_attention"
+
+
+@dataclass
+class DeepseekV4Config:
+    model_type: str = "deepseek_v4"
+    vocab_size: int = 129280
+    hidden_size: int = 4096
+    intermediate_size: int = 2048
+    num_hidden_layers: int = 8
+    num_attention_heads: int = 64
+    head_dim: int = 512
+    q_lora_rank: int = 1536
+    o_groups: int = 8
+    o_lora_rank: int = 1024
+    sliding_window: int = 4096
+    # per-layer attention types; default mirrors the V4 interleave pattern
+    layer_types: Tuple[str, ...] = ()
+    # per-layer MLP types: "hash_moe" (frozen tid2eid selection) or "topk_moe"
+    mlp_layer_types: Tuple[str, ...] = ()
+    compress_rate_hca: int = 128
+    compress_rate_csa: int = 4
+    index_n_heads: int = 32
+    index_head_dim: int = 128
+    index_topk: int = 2048
+    hc_mult: int = 2
+    hc_sinkhorn_iters: int = 3
+    hc_eps: float = 1e-4
+    num_experts: int = 64
+    num_experts_per_tok: int = 8
+    scoring_func: str = "sigmoid"
+    routed_scaling_factor: float = 2.5
+    router_aux_loss_coef: float = 0.0
+    swiglu_limit: float = 7.0
+    rms_norm_eps: float = 1e-6
+    initializer_range: float = 0.02
+    max_position_embeddings: int = 131072
+    tie_word_embeddings: bool = False
+    attention_dropout: float = 0.0
+    # {"main": {...}, "compress": {...}} with rope_theta /
+    # partial_rotary_factor / optional HF rope_scaling dict ("yarn" etc.)
+    rope_parameters: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    def __post_init__(self):
+        if isinstance(self.dtype, str):
+            self.dtype = jnp.dtype(self.dtype).type
+        if isinstance(self.param_dtype, str):
+            self.param_dtype = jnp.dtype(self.param_dtype).type
+        if not self.layer_types:
+            # V4 pattern: mostly sliding, periodic CSA, sparse HCA long-range
+            lt = []
+            for i in range(self.num_hidden_layers):
+                if i % 4 == 3:
+                    lt.append(LAYER_HCA if i % 8 == 7 else LAYER_CSA)
+                else:
+                    lt.append(LAYER_SLIDING)
+            self.layer_types = tuple(lt)
+        else:
+            self.layer_types = tuple(self.layer_types)
+        if not self.mlp_layer_types:
+            self.mlp_layer_types = tuple(
+                "hash_moe" if i < 1 else "topk_moe"
+                for i in range(self.num_hidden_layers)
+            )
+        else:
+            self.mlp_layer_types = tuple(self.mlp_layer_types)
+        if len(self.layer_types) != self.num_hidden_layers:
+            raise ValueError("layer_types length != num_hidden_layers")
+        if len(self.mlp_layer_types) != self.num_hidden_layers:
+            raise ValueError("mlp_layer_types length != num_hidden_layers")
+        if not self.rope_parameters:
+            self.rope_parameters = {
+                "main": {"rope_theta": 10000.0, "partial_rotary_factor": 0.125},
+                "compress": {"rope_theta": 10000.0, "partial_rotary_factor": 0.125},
+            }
+
+    @property
+    def is_moe(self) -> bool:
+        return True
+
+    @property
+    def compress_rates(self) -> Dict[str, int]:
+        return {LAYER_HCA: self.compress_rate_hca, LAYER_CSA: self.compress_rate_csa}
+
+    def rope_dim(self, layer_type: str = "main") -> int:
+        f = self.rope_parameters[layer_type].get("partial_rotary_factor", 1.0)
+        return int(self.head_dim * f)
+
+    def runs(self) -> List[Tuple[int, int, str, str]]:
+        """(start, count, layer_type, mlp_type) for consecutive same-signature
+        layers — each run scans as one compiled body."""
+        out: List[Tuple[int, int, str, str]] = []
+        for i, sig in enumerate(zip(self.layer_types, self.mlp_layer_types)):
+            if out and (out[-1][2], out[-1][3]) == sig:
+                out[-1] = (out[-1][0], out[-1][1] + 1, *sig)
+            else:
+                out.append((i, 1, *sig))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def _init_layer_params(rng: jax.Array, cfg: DeepseekV4Config, layer_type: str,
+                       mlp_type: str) -> Params:
+    s = cfg.initializer_range
+    h, hd, nh = cfg.hidden_size, cfg.head_dim, cfg.num_attention_heads
+    qr = cfg.q_lora_rank
+    pd = cfg.param_dtype
+    keys = iter(jax.random.split(rng, 32))
+
+    def init(shape, dtype=pd):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(dtype)
+
+    attn: Params = {
+        "q_a_proj": init((h, qr)),
+        "q_a_norm": jnp.ones((qr,), jnp.float32),
+        "q_b_proj": init((qr, nh * hd)),
+        "kv_proj": init((h, hd)),
+        "kv_norm": jnp.ones((hd,), jnp.float32),
+        # block-diagonal o_a: [groups, nh*hd/groups, o_lora_rank]
+        "o_a_proj": init((cfg.o_groups, nh * hd // cfg.o_groups, cfg.o_lora_rank)),
+        "o_b_proj": init((cfg.o_groups * cfg.o_lora_rank, h)),
+        "sinks": jnp.zeros((nh,), jnp.float32),
+    }
+    if layer_type in (LAYER_HCA, LAYER_CSA):
+        width = hd if layer_type == LAYER_HCA else 2 * hd
+        attn["compressor"] = {
+            "kv_proj": init((h, width)),
+            "gate_proj": init((h, width)),
+            "position_bias": jnp.zeros((cfg.compress_rates[layer_type], width), jnp.float32),
+            "kv_norm": jnp.ones((hd,), jnp.float32),
+        }
+    if layer_type == LAYER_CSA:
+        ihd, inh = cfg.index_head_dim, cfg.index_n_heads
+        attn["indexer"] = {
+            "kv_proj": init((h, 2 * ihd)),
+            "gate_proj": init((h, 2 * ihd)),
+            "position_bias": jnp.zeros((cfg.compress_rate_csa, 2 * ihd), jnp.float32),
+            "kv_norm": jnp.ones((ihd,), jnp.float32),
+            "q_b_proj": init((qr, inh * ihd)),
+            "weights_proj": init((h, inh)),
+        }
+
+    e, im = cfg.num_experts, cfg.intermediate_size
+    mlp: Params = {
+        "router": init((h, e), jnp.float32),
+        "experts": {
+            # v5 layout transposed to right-multiply: [E, H, 2I] / [E, I, H]
+            "gate_up_proj": init((e, h, 2 * im)),
+            "down_proj": init((e, im, h)),
+        },
+        "shared_experts": {
+            "gate_proj": init((h, im)),
+            "up_proj": init((h, im)),
+            "down_proj": init((im, h)),
+        },
+    }
+    if mlp_type == "hash_moe":
+        mlp["tid2eid"] = jnp.zeros(
+            (cfg.vocab_size, cfg.num_experts_per_tok), jnp.int32
+        )
+    else:
+        mlp["e_score_correction_bias"] = jnp.zeros((e,), jnp.float32)
+
+    hc = cfg.hc_mult
+    mix = (2 + hc) * hc
+
+    def hc_params():
+        return {
+            "fn": init((mix, hc * h), jnp.float32),
+            "base": jnp.zeros((mix,), jnp.float32),
+            "scale": jnp.ones((3,), jnp.float32),
+        }
+
+    return {
+        "input_layernorm": jnp.ones((h,), jnp.float32),
+        "post_attention_layernorm": jnp.ones((h,), jnp.float32),
+        "attn": attn,
+        "mlp": mlp,
+        "attn_hc": hc_params(),
+        "ffn_hc": hc_params(),
+    }
+
+
+def init_params(rng: jax.Array, cfg: DeepseekV4Config) -> Params:
+    h = cfg.hidden_size
+    s = cfg.initializer_range
+    keys = jax.random.split(rng, cfg.num_hidden_layers + 4)
+    runs: List[Params] = []
+    for start, count, lt, mt in cfg.runs():
+        per_layer = [
+            _init_layer_params(keys[start + j], cfg, lt, mt) for j in range(count)
+        ]
+        runs.append(jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer))
+    hc = cfg.hc_mult
+    params: Params = {
+        "embed_tokens": (
+            jax.random.normal(keys[-1], (cfg.vocab_size, h), jnp.float32) * s
+        ).astype(cfg.param_dtype),
+        "runs": runs,
+        "final_norm": jnp.ones((h,), jnp.float32),
+        "hc_head": {
+            "hc_fn": (jax.random.normal(keys[-2], (hc, hc * h), jnp.float32) * s),
+            "hc_base": jnp.zeros((hc,), jnp.float32),
+            "hc_scale": jnp.ones((1,), jnp.float32),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(keys[-3], (h, cfg.vocab_size), jnp.float32) * s
+        ).astype(cfg.param_dtype)
+    return params
+
+
+def abstract_params(cfg: DeepseekV4Config) -> Params:
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+# ---------------------------------------------------------------------------
+# rope (interleaved pairs, trailing slice)
+# ---------------------------------------------------------------------------
+
+def _rope_tables(cfg: DeepseekV4Config, layer_type: str, positions: jax.Array):
+    """positions [B,S] -> (cos, sin) [B,S,rd/2] (one entry per interleaved
+    pair), with optional HF rope_scaling (yarn) on the inv_freq."""
+    rp = cfg.rope_parameters[layer_type]
+    rd = cfg.rope_dim(layer_type)
+    theta = float(rp.get("rope_theta", 10000.0))
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, rd, 2, jnp.float32) / rd))
+    scaling = 1.0
+    if rp.get("rope_scaling"):
+        inv_freq, scaling = _scale_inv_freq(inv_freq, rp["rope_scaling"], rd, theta)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [B,S,rd/2]
+    return jnp.cos(freqs) * scaling, jnp.sin(freqs) * scaling
+
+
+def _rotate_half_interleave(x):
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    return jnp.stack((-x2, x1), axis=-1).reshape(x.shape)
+
+
+def _apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [..., D] with rope on the TRAILING ``2*cos.shape[-1]`` channels;
+    cos/sin broadcast over any head axes between batch/seq and channels."""
+    cos = jnp.repeat(cos, 2, axis=-1)
+    sin = jnp.repeat(sin, 2, axis=-1)
+    rd = cos.shape[-1]
+    while cos.ndim < x.ndim:
+        cos = cos[..., None, :]
+        sin = sin[..., None, :]
+    nope, rope = x[..., :-rd], x[..., -rd:]
+    rot = (rope.astype(jnp.float32) * cos
+           + _rotate_half_interleave(rope).astype(jnp.float32) * sin)
+    return jnp.concatenate([nope, rot.astype(x.dtype)], axis=-1)
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return (y * w).astype(x.dtype)
+
+
+def _urms(x, eps):
+    xf = x.astype(jnp.float32)
+    return xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+
+
+# ---------------------------------------------------------------------------
+# compressors (packed segment-aware, static shapes)
+# ---------------------------------------------------------------------------
+
+def _entry_plan(positions: jax.Array, segments: jax.Array, rate: int, n_entries: int):
+    """Static window bookkeeping for one compression rate.
+
+    Windows align to each packed segment's own position grid (the reference
+    keeps every compression window within one packed sequence —
+    ``packed_utils.py``); window members are therefore CONTIGUOUS in the
+    token axis, so per-entry metadata is one scatter-min + gathers — no
+    [B,S,E] intermediates. Returns (entry_id [B,S] with ``n_entries`` as the
+    spill slot, first_token [B,E], window_number [B,E], segment [B,E],
+    valid [B,E])."""
+    b, s = positions.shape
+    live = segments > 0
+    start = (positions % rate == 0) & live
+    entry_raw = jnp.cumsum(start.astype(jnp.int32), axis=1) - 1  # [-1..E)
+    in_range = (entry_raw >= 0) & (entry_raw < n_entries) & live
+    entry_id = jnp.where(in_range, entry_raw, n_entries)
+
+    tok = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    bidx = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None], (b, s))
+    first = jnp.full((b, n_entries + 1), s, jnp.int32).at[bidx, entry_id].min(tok)
+    count = jnp.zeros((b, n_entries + 1), jnp.int32).at[bidx, entry_id].add(1)
+    first, count = first[:, :n_entries], count[:, :n_entries]
+    firstc = jnp.minimum(first, s - 1)
+    win = jnp.take_along_axis(positions, firstc, axis=1) // rate
+    seg = jnp.take_along_axis(segments, firstc, axis=1)
+    valid = (count == rate) & (first < s)
+    return entry_id, first, win.astype(jnp.int32), seg.astype(jnp.int32), valid
+
+
+def _gather_window(x, member, s):
+    """x [B,S,D], member [B,E,R] token indices (possibly out of range) ->
+    [B,E,R,D]."""
+    b, _, d = x.shape
+    e, r = member.shape[1], member.shape[2]
+    idx = jnp.clip(member, 0, s - 1).reshape(b, e * r)
+    return jnp.take_along_axis(x, idx[..., None], axis=1).reshape(b, e, r, d)
+
+
+def _masked_gate_sum(kv_slots, gate_slots, slot_valid):
+    """softmax over slot axis (2) per channel in f32; invalid slots -inf;
+    entries with no valid slot return 0."""
+    g = jnp.where(slot_valid[..., None], gate_slots.astype(jnp.float32), -jnp.inf)
+    m = g.max(axis=2, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    ex = jnp.exp(g - m)
+    z = ex.sum(axis=2)
+    num = (ex * kv_slots.astype(jnp.float32)).sum(axis=2)
+    return num / jnp.maximum(z, 1e-30)
+
+
+def _gated_window_sum(kv, gate, entry_id, first, rate):
+    """Channel-wise softmax-gated sum of kv over each entry's ``rate``
+    contiguous member tokens. kv/gate [B,S,D] -> [B,E,D]."""
+    b, s, _ = kv.shape
+    member = first[..., None] + jnp.arange(rate, dtype=jnp.int32)  # [B,E,R]
+    tok_entry = _gather_window(entry_id[..., None], member, s)[..., 0]
+    slot_valid = (tok_entry == jnp.arange(first.shape[1])[None, :, None]) & (member < s)
+    return _masked_gate_sum(
+        _gather_window(kv, member, s), _gather_window(gate, member, s), slot_valid
+    )
+
+
+def _gated_window_sum_overlap(kv2, gate2, entry_id, first, entry_seg, rate, hd):
+    """CSA overlap: entry ``e`` is the joint softmax over the previous
+    window's "Ca" channel slice ([..., :hd]) and its own window's "Cb" slice
+    ([..., hd:]) — width ``2·rate``, stride ``rate``. Cross-segment prior
+    windows stay -inf (the reference's empty overlap slot)."""
+    b, s, _ = kv2.shape
+    e_axis = jnp.arange(first.shape[1])[None, :, None]
+    own = first[..., None] + jnp.arange(rate, dtype=jnp.int32)
+    prev = own - rate
+    tok_e_own = _gather_window(entry_id[..., None], own, s)[..., 0]
+    tok_e_prev = _gather_window(entry_id[..., None], prev, s)[..., 0]
+    # prior window must be the immediately preceding COMPLETE window of the
+    # same packed segment
+    prev_seg_ok = jnp.take_along_axis(
+        jnp.pad(entry_seg, ((0, 0), (1, 0)), constant_values=-1),
+        jnp.arange(first.shape[1])[None, :], axis=1,
+    ) == entry_seg
+    valid_own = (tok_e_own == e_axis) & (own < s)
+    valid_prev = (tok_e_prev == e_axis - 1) & (prev >= 0) & prev_seg_ok[..., None]
+    kv_slots = jnp.concatenate(
+        [_gather_window(kv2[..., :hd], prev, s), _gather_window(kv2[..., hd:], own, s)],
+        axis=2,
+    )
+    gate_slots = jnp.concatenate(
+        [_gather_window(gate2[..., :hd], prev, s), _gather_window(gate2[..., hd:], own, s)],
+        axis=2,
+    )
+    slot_valid = jnp.concatenate([valid_prev, valid_own], axis=2)
+    return _masked_gate_sum(kv_slots, gate_slots, slot_valid)
+
+
+def _compress(lp_c, cfg, x, positions, segments, layer_type, overlap: bool):
+    """Shared compressor body -> (entries [B,E,hd] roped, win, seg, valid)."""
+    rate = cfg.compress_rate_hca if layer_type == LAYER_HCA else cfg.compress_rate_csa
+    hd = lp_c["kv_norm"].shape[-1]
+    n_entries = x.shape[1] // rate
+    kv = jnp.dot(x, lp_c["kv_proj"].astype(x.dtype))
+    gate = jnp.dot(x, lp_c["gate_proj"].astype(x.dtype))
+    gate = gate + lp_c["position_bias"].astype(gate.dtype)[positions % rate]
+    entry_id, first, win, seg, valid = _entry_plan(positions, segments, rate, n_entries)
+    if overlap:
+        comp = _gated_window_sum_overlap(kv, gate, entry_id, first, seg, rate, hd)
+    else:
+        comp = _gated_window_sum(kv, gate, entry_id, first, rate)
+    comp = _rms(comp, lp_c["kv_norm"], cfg.rms_norm_eps)
+    cos, sin = _rope_tables(cfg, "compress", win * rate)
+    comp = _apply_rope(comp.astype(x.dtype), cos, sin)
+    return comp, win, seg, valid
+
+
+def _block_causal_bias(positions, segments, win, entry_seg, entry_valid, rate):
+    """[B,S,E] additive bias: 0 where the entry's window fully precedes the
+    query token within the same packed segment, else -inf."""
+    same_seg = segments[:, :, None] == entry_seg[:, None, :]
+    before = win[:, None, :] < (positions[:, :, None] + 1) // rate
+    ok = same_seg & before & entry_valid[:, None, :] & (segments > 0)[:, :, None]
+    return jnp.where(ok, 0.0, -jnp.inf).astype(jnp.float32)
+
+
+def _indexer_bias(lp_i, cfg, x, q_residual, positions, segments):
+    """Lightning Indexer -> additive bias [B,S,E] keeping top-k entries."""
+    ihd, inh = cfg.index_head_dim, cfg.index_n_heads
+    rate = cfg.compress_rate_csa
+    n_entries = x.shape[1] // rate
+    kv = jnp.dot(x, lp_i["kv_proj"].astype(x.dtype))
+    gate = jnp.dot(x, lp_i["gate_proj"].astype(x.dtype))
+    gate = gate + lp_i["position_bias"].astype(gate.dtype)[positions % rate]
+    entry_id, first, win, seg, valid = _entry_plan(positions, segments, rate, n_entries)
+    keys = _gated_window_sum_overlap(kv, gate, entry_id, first, seg, rate, ihd)
+    keys = _rms(keys, lp_i["kv_norm"], cfg.rms_norm_eps)
+    cos_k, sin_k = _rope_tables(cfg, "compress", win * rate)
+    keys = _apply_rope(keys, cos_k, sin_k)                   # [B,E,ihd] f32
+
+    b, s, _ = x.shape
+    q = jnp.dot(q_residual, lp_i["q_b_proj"].astype(q_residual.dtype))
+    q = q.reshape(b, s, inh, ihd)
+    cos_q, sin_q = _rope_tables(cfg, "compress", positions)
+    q = _apply_rope(q, cos_q, sin_q)
+    scores = jax.nn.relu(
+        jnp.einsum("bshd,bed->bshe", q.astype(jnp.float32), keys)
+    ) * (ihd ** -0.5)
+    w = jnp.dot(x, lp_i["weights_proj"].astype(x.dtype)).astype(jnp.float32)
+    w = w * (inh ** -0.5)
+    index_scores = jnp.einsum("bshe,bsh->bse", scores, w)
+
+    causal = _block_causal_bias(positions, segments, win, seg, valid, rate)
+    index_scores = jnp.where(jnp.isfinite(causal), index_scores, -jnp.inf)
+    top_k = min(cfg.index_topk, n_entries)
+    kth = jax.lax.top_k(index_scores, top_k)[0][..., -1:]    # [B,S,1]
+    keep = (index_scores >= kth) & jnp.isfinite(causal)
+    return jnp.where(keep, 0.0, -jnp.inf).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+def _dsv4_attention(lp, cfg: DeepseekV4Config, x, positions, segments,
+                    layer_type: str):
+    b, s, _ = x.shape
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    dt = x.dtype
+    rope_type = "main" if layer_type == LAYER_SLIDING else "compress"
+    cos, sin = _rope_tables(cfg, rope_type, positions)
+
+    q_residual = _rms(jnp.dot(x, lp["q_a_proj"].astype(dt)), lp["q_a_norm"],
+                      cfg.rms_norm_eps)
+    q = jnp.dot(q_residual, lp["q_b_proj"].astype(dt)).reshape(b, s, nh, hd)
+    q = (_urms(q, cfg.rms_norm_eps)).astype(dt)              # per-head unweighted RMS
+    q = _apply_rope(q, cos, sin)
+    kv = _rms(jnp.dot(x, lp["kv_proj"].astype(dt)), lp["kv_norm"], cfg.rms_norm_eps)
+    kv = _apply_rope(kv, cos, sin)                           # [B,S,hd]
+
+    scale = hd ** -0.5
+    logits = jnp.einsum("bqhd,bkd->bhqk", q.astype(jnp.float32),
+                        kv.astype(jnp.float32)) * scale
+
+    qpos = jnp.arange(s)[:, None]
+    kpos = jnp.arange(s)[None, :]
+    same_seg = (segments[:, :, None] == segments[:, None, :]) & (segments > 0)[:, :, None]
+    local_ok = (kpos <= qpos)[None] & same_seg
+    if cfg.sliding_window:
+        local_ok = local_ok & (qpos - kpos < cfg.sliding_window)[None]
+    logits = jnp.where(local_ok[:, None], logits, -jnp.inf)
+
+    comp = None
+    if layer_type != LAYER_SLIDING:
+        comp, win, cseg, cvalid = _compress(
+            lp["compressor"], cfg, x, positions, segments, layer_type,
+            overlap=(layer_type == LAYER_CSA),
+        )
+        rate = cfg.compress_rates[layer_type]
+        bias = _block_causal_bias(positions, segments, win, cseg, cvalid, rate)
+        if layer_type == LAYER_CSA:
+            bias = bias + _indexer_bias(lp["indexer"], cfg, x, q_residual,
+                                        positions, segments)
+        clogits = jnp.einsum("bqhd,bed->bhqe", q.astype(jnp.float32),
+                             comp.astype(jnp.float32)) * scale
+        clogits = clogits + bias[:, None]
+        logits = jnp.concatenate([logits, clogits], axis=-1)
+
+    # gpt-oss-style sinks: extra softmax column per head
+    sink_col = jnp.broadcast_to(
+        lp["sinks"].astype(jnp.float32)[None, :, None, None], (b, nh, s, 1)
+    )
+    joint = jnp.concatenate([logits, sink_col], axis=-1)
+    joint = joint - jax.lax.stop_gradient(joint.max(axis=-1, keepdims=True))
+    probs = jax.nn.softmax(joint, axis=-1)[..., :-1].astype(dt)
+
+    out = jnp.einsum("bhqk,bkd->bqhd", probs[..., :s], kv)
+    if comp is not None:
+        out = out + jnp.einsum("bhqe,bed->bqhd", probs[..., s:], comp)
+
+    out = _apply_rope(out, cos, -sin)                        # relative de-rope
+    grouped = out.reshape(b, s, cfg.o_groups, nh * hd // cfg.o_groups)
+    grouped = jnp.einsum("bsgi,gir->bsgr", grouped, lp["o_a_proj"].astype(dt))
+    return jnp.dot(grouped.reshape(b, s, -1), lp["o_b_proj"].astype(dt))
+
+
+# ---------------------------------------------------------------------------
+# MoE
+# ---------------------------------------------------------------------------
+
+def _clamped_swiglu(gate, up, limit):
+    gate = jnp.clip(gate.astype(jnp.float32), max=limit)
+    up = jnp.clip(up.astype(jnp.float32), min=-limit, max=limit)
+    return (jax.nn.silu(gate) * up)
+
+
+def _dsv4_moe(lp, cfg: DeepseekV4Config, x, input_ids, mlp_type: str):
+    """x [T,H] -> (out [T,H], aux). Sigmoid router w/ correction bias, or
+    frozen hash selection; clamped-SwiGLU experts via grouped GEMM."""
+    t, h = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    dt = x.dtype
+    logits = jnp.dot(x.astype(jnp.float32), lp["router"])
+    scores = jax.nn.sigmoid(logits) if cfg.scoring_func == "sigmoid" else \
+        jax.nn.softmax(logits, axis=-1)
+    if mlp_type == "hash_moe":
+        topk_idx = lp["tid2eid"][input_ids.reshape(-1)]
+        aux = jnp.zeros((), jnp.float32)
+    else:
+        choice = scores + lp["e_score_correction_bias"]
+        _, topk_idx = jax.lax.top_k(choice, k)
+        probs = scores / jnp.maximum(scores.sum(-1, keepdims=True), 1e-20)
+        aux = ops.load_balancing_loss(probs, topk_idx, e)
+    topk_w = jnp.take_along_axis(scores, topk_idx, axis=-1)
+    topk_w = topk_w / (topk_w.sum(-1, keepdims=True) + 1e-20)
+    topk_w = (topk_w * cfg.routed_scaling_factor).astype(dt)
+
+    flat_expert = topk_idx.reshape(-1)
+    sort_idx = jnp.argsort(flat_expert)
+    token_idx = sort_idx // k
+    xs = x[token_idx]
+    group_sizes = jnp.bincount(flat_expert, length=e)
+    gu = ops.group_gemm(xs, lp["experts"]["gate_up_proj"].astype(dt), group_sizes)
+    gate, up = jnp.split(gu, 2, axis=-1)
+    act = _clamped_swiglu(gate, up, cfg.swiglu_limit).astype(dt)
+    out = ops.group_gemm(act, lp["experts"]["down_proj"].astype(dt), group_sizes)
+    weight = topk_w.reshape(-1)[sort_idx][:, None]
+    combined = jnp.zeros((t, h), dt).at[token_idx].add(out * weight)
+
+    se = lp["shared_experts"]
+    shared = _clamped_swiglu(
+        jnp.dot(x, se["gate_proj"].astype(dt)), jnp.dot(x, se["up_proj"].astype(dt)),
+        cfg.swiglu_limit,
+    ).astype(dt)
+    return combined + jnp.dot(shared, se["down_proj"].astype(dt)), aux
+
+
+# ---------------------------------------------------------------------------
+# mHC
+# ---------------------------------------------------------------------------
+
+def _hyper_connection(lp_hc, cfg: DeepseekV4Config, streams):
+    """streams [B,S,hc,H] -> (post [B,S,hc], comb [B,S,hc,hc], collapsed
+    [B,S,H]); fp32 like the reference's _keep_in_fp32_modules."""
+    hc, eps = cfg.hc_mult, cfg.hc_eps
+    b, s, _, h = streams.shape
+    flat = _urms(streams.reshape(b, s, hc * h), cfg.rms_norm_eps)  # f32
+    mix = jnp.dot(flat, lp_hc["fn"].T)
+    pre_w, post_w, comb_w = jnp.split(mix, [hc, 2 * hc], axis=-1)
+    pre_b, post_b, comb_b = (lp_hc["base"][:hc], lp_hc["base"][hc:2 * hc],
+                             lp_hc["base"][2 * hc:])
+    s0, s1, s2 = lp_hc["scale"][0], lp_hc["scale"][1], lp_hc["scale"][2]
+    pre = jax.nn.sigmoid(pre_w * s0 + pre_b) + eps
+    post = 2.0 * jax.nn.sigmoid(post_w * s1 + post_b)
+    comb = jax.nn.softmax(
+        comb_w.reshape(b, s, hc, hc) * s2 + comb_b.reshape(hc, hc), axis=-1
+    ) + eps
+    comb = comb / (comb.sum(axis=-2, keepdims=True) + eps)
+    for _ in range(cfg.hc_sinkhorn_iters - 1):
+        comb = comb / (comb.sum(axis=-1, keepdims=True) + eps)
+        comb = comb / (comb.sum(axis=-2, keepdims=True) + eps)
+    collapsed = (pre[..., None] * streams.astype(jnp.float32)).sum(axis=2)
+    return post, comb, collapsed.astype(streams.dtype)
+
+
+def _hc_merge(block_out, streams, post, comb):
+    """post⊗out + combᵀ·streams (the mHC residual update)."""
+    dt = streams.dtype
+    return (post.astype(jnp.float32)[..., None] * block_out.astype(jnp.float32)[..., None, :]
+            + jnp.einsum("bsji,bsjh->bsih", comb, streams.astype(jnp.float32))
+            ).astype(dt)
+
+
+def _hc_head(lp, cfg: DeepseekV4Config, streams):
+    hc = cfg.hc_mult
+    b, s, _, h = streams.shape
+    flat = _urms(streams.reshape(b, s, hc * h), cfg.rms_norm_eps)
+    mixes = jnp.dot(flat, lp["hc_fn"].T)
+    pre = jax.nn.sigmoid(mixes * lp["hc_scale"] + lp["hc_base"]) + cfg.hc_eps
+    return (pre[..., None] * streams.astype(jnp.float32)).sum(axis=2).astype(streams.dtype)
+
+
+# ---------------------------------------------------------------------------
+# forward / loss
+# ---------------------------------------------------------------------------
+
+def _layer_body(streams, lp, cfg: DeepseekV4Config, positions, segments,
+                input_ids, layer_type: str, mlp_type: str):
+    post, comb, collapsed = _hyper_connection(lp["attn_hc"], cfg, streams)
+    attn_in = _rms(collapsed, lp["input_layernorm"], cfg.rms_norm_eps)
+    attn_out = _dsv4_attention(lp["attn"], cfg, attn_in, positions, segments,
+                               layer_type)
+    streams = _hc_merge(attn_out, streams, post, comb)
+
+    post, comb, collapsed = _hyper_connection(lp["ffn_hc"], cfg, streams)
+    mlp_in = _rms(collapsed, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+    b, s, h = mlp_in.shape
+    mlp_out, aux = _dsv4_moe(lp["mlp"], cfg, mlp_in.reshape(b * s, h),
+                             input_ids, mlp_type)
+    streams = _hc_merge(mlp_out.reshape(b, s, h), streams, post, comb)
+    return streams, aux
+
+
+def forward_hidden(params: Params, cfg: DeepseekV4Config, input_ids,
+                   position_ids, segment_ids=None):
+    b, s = input_ids.shape
+    if segment_ids is None:
+        segment_ids = jnp.ones((b, s), jnp.int32)
+    dt = cfg.dtype
+    embeds = params["embed_tokens"].astype(dt)[input_ids]
+    streams = jnp.broadcast_to(
+        embeds[:, :, None, :], (b, s, cfg.hc_mult, embeds.shape[-1])
+    )
+    auxes = []
+    for run_params, (start, count, lt, mt) in zip(params["runs"], cfg.runs()):
+        body = partial(_layer_body, cfg=cfg, positions=position_ids,
+                       segments=segment_ids, input_ids=input_ids,
+                       layer_type=lt, mlp_type=mt)
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        streams, aux = jax.lax.scan(
+            lambda c, lp: body(c, lp), streams, run_params
+        )
+        auxes.append(aux.sum())
+    hidden = _rms(_hc_head(params["hc_head"], cfg, streams),
+                  params["final_norm"], cfg.rms_norm_eps)
+    n_topk_layers = sum(1 for t in cfg.mlp_layer_types if t != "hash_moe")
+    moe_aux = sum(auxes) / max(n_topk_layers, 1)
+    return hidden, moe_aux
+
+
+def loss_fn(params: Params, cfg: DeepseekV4Config, batch) -> Tuple[jax.Array, Dict]:
+    hidden, moe_aux = forward_hidden(
+        params, cfg, batch["input_ids"], batch["position_ids"],
+        batch.get("segment_ids"),
+    )
+    b, s, h = hidden.shape
+    kernel = (params["embed_tokens"].T if cfg.tie_word_embeddings
+              else params["lm_head"]).astype(cfg.dtype)
+    loss_sum, ntokens = fused_linear_cross_entropy(
+        hidden.reshape(b * s, h), kernel, batch["labels"].reshape(b * s)
+    )
+    metrics = {"loss_sum": loss_sum, "ntokens": ntokens, "moe_aux_loss": moe_aux}
+    total = loss_sum
+    if cfg.router_aux_loss_coef:
+        total = total + cfg.router_aux_loss_coef * moe_aux * ntokens
+    return total, metrics
+
+
+def forward_logits(params: Params, cfg: DeepseekV4Config, input_ids,
+                   position_ids=None, segment_ids=None):
+    b, s = input_ids.shape
+    if position_ids is None:
+        position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    hidden, _ = forward_hidden(params, cfg, input_ids, position_ids, segment_ids)
+    kernel = (params["embed_tokens"].T if cfg.tie_word_embeddings
+              else params["lm_head"]).astype(cfg.dtype)
+    return jnp.dot(hidden, kernel)
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint io (reference layout: checkpoint_tensor_converter.py +
+# module tree of patched_modeling_deepseek_v4_gpu.py)
+# ---------------------------------------------------------------------------
+
+_ATTN_MAP = [
+    # (ours, hf suffix, transpose 2d)
+    ("q_a_proj", "q_a_proj.weight", True),
+    ("q_a_norm", "q_a_norm.weight", False),
+    ("q_b_proj", "q_b_proj.weight", True),
+    ("kv_proj", "kv_proj.weight", True),
+    ("kv_norm", "kv_norm.weight", False),
+    ("o_b_proj", "o_b_proj.weight", True),
+    ("sinks", "sinks", False),
+]
+_COMP_MAP = [
+    ("kv_proj", "kv_proj.weight", True),
+    ("gate_proj", "gate_proj.weight", True),
+    ("position_bias", "position_bias", False),
+    ("kv_norm", "kv_norm.weight", False),
+]
+_IDX_MAP = _COMP_MAP + [
+    ("q_b_proj", "q_b_proj.weight", True),
+    ("weights_proj", "weights_proj.weight", True),
+]
+
+
+def hf_to_params(model_dir: str, cfg: DeepseekV4Config, target_shardings=None):
+    from veomni_tpu.models.hf_io import LazyHFTensors
+
+    src = LazyHFTensors(model_dir)
+
+    def read(name):
+        return np.asarray(src.read(name))
+
+    def t2(name):
+        return jnp.asarray(np.ascontiguousarray(read(name).T))
+
+    def t0(name):
+        return jnp.asarray(read(name))
+
+    def layer_params(i: int, lt: str, mt: str) -> Params:
+        pfx = f"model.layers.{i}"
+        attn: Params = {}
+        for ours, suffix, tr in _ATTN_MAP:
+            attn[ours] = (t2 if tr else t0)(f"{pfx}.self_attn.{suffix}")
+        # GroupedLinear weight [g*r, in_g] -> [g, in_g, r]
+        oa = read(f"{pfx}.self_attn.o_a_proj.weight")
+        g, r = cfg.o_groups, cfg.o_lora_rank
+        attn["o_a_proj"] = jnp.asarray(
+            np.ascontiguousarray(oa.reshape(g, r, -1).transpose(0, 2, 1))
+        )
+        if lt in (LAYER_HCA, LAYER_CSA):
+            attn["compressor"] = {
+                ours: (t2 if tr else t0)(f"{pfx}.self_attn.compressor.{suffix}")
+                for ours, suffix, tr in _COMP_MAP
+            }
+        if lt == LAYER_CSA:
+            attn["indexer"] = {
+                ours: (t2 if tr else t0)(f"{pfx}.self_attn.compressor.indexer.{suffix}")
+                for ours, suffix, tr in _IDX_MAP
+            }
+        mlp: Params = {
+            "router": t2(f"{pfx}.mlp.gate.weight"),
+            "experts": {
+                # reference v5 layout: gate_up [E, 2I, H], down [E, H, I]
+                "gate_up_proj": jnp.asarray(np.ascontiguousarray(
+                    read(f"{pfx}.mlp.experts.gate_up_proj").transpose(0, 2, 1))),
+                "down_proj": jnp.asarray(np.ascontiguousarray(
+                    read(f"{pfx}.mlp.experts.down_proj").transpose(0, 2, 1))),
+            },
+            "shared_experts": {
+                "gate_proj": t2(f"{pfx}.mlp.shared_experts.gate_proj.weight"),
+                "up_proj": t2(f"{pfx}.mlp.shared_experts.up_proj.weight"),
+                "down_proj": t2(f"{pfx}.mlp.shared_experts.down_proj.weight"),
+            },
+        }
+        if mt == "hash_moe":
+            mlp["tid2eid"] = jnp.asarray(read(f"{pfx}.mlp.gate.tid2eid").astype(np.int32))
+        else:
+            mlp["e_score_correction_bias"] = t0(f"{pfx}.mlp.gate.e_score_correction_bias")
+        out: Params = {
+            "input_layernorm": t0(f"{pfx}.input_layernorm.weight"),
+            "post_attention_layernorm": t0(f"{pfx}.post_attention_layernorm.weight"),
+            "attn": attn,
+            "mlp": mlp,
+        }
+        for site in ("attn_hc", "ffn_hc"):
+            out[site] = {
+                "fn": t0(f"{pfx}.{site}.fn"),
+                "base": t0(f"{pfx}.{site}.base"),
+                "scale": t0(f"{pfx}.{site}.scale"),
+            }
+        return out
+
+    runs: List[Params] = []
+    for start, count, lt, mt in cfg.runs():
+        per = [layer_params(start + j, lt, mt) for j in range(count)]
+        runs.append(jax.tree.map(lambda *xs: jnp.stack(xs), *per))
+    params: Params = {
+        "embed_tokens": jnp.asarray(read("model.embed_tokens.weight"), cfg.param_dtype),
+        "runs": runs,
+        "final_norm": t0("model.norm.weight"),
+        "hc_head": {
+            "hc_fn": t0("model.hc_head.hc_fn"),
+            "hc_base": t0("model.hc_head.hc_base"),
+            "hc_scale": t0("model.hc_head.hc_scale"),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(
+            np.ascontiguousarray(read("lm_head.weight").T), cfg.param_dtype
+        )
+    return params
+
+
+def params_to_hf(params: Params, cfg: DeepseekV4Config) -> Dict[str, np.ndarray]:
+    from veomni_tpu.models import hf_io
+
+    host = hf_io.gather_to_host(params)
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(host["embed_tokens"]),
+        "model.norm.weight": np.asarray(host["final_norm"]),
+        "model.hc_head.hc_fn": np.asarray(host["hc_head"]["hc_fn"]),
+        "model.hc_head.hc_base": np.asarray(host["hc_head"]["hc_base"]),
+        "model.hc_head.hc_scale": np.asarray(host["hc_head"]["hc_scale"]),
+    }
+    if "lm_head" in host:
+        out["lm_head.weight"] = np.ascontiguousarray(np.asarray(host["lm_head"]).T)
+
+    def put(name, x, transpose=False):
+        x = np.asarray(x)
+        out[name] = np.ascontiguousarray(x.T if transpose else x)
+
+    for run_params, (start, count, lt, mt) in zip(host["runs"], cfg.runs()):
+        for j in range(count):
+            i = start + j
+            lp = jax.tree.map(lambda x: x[j], run_params)
+            pfx = f"model.layers.{i}"
+            put(f"{pfx}.input_layernorm.weight", lp["input_layernorm"])
+            put(f"{pfx}.post_attention_layernorm.weight", lp["post_attention_layernorm"])
+            for ours, suffix, tr in _ATTN_MAP:
+                put(f"{pfx}.self_attn.{suffix}", lp["attn"][ours], tr)
+            g, r = cfg.o_groups, cfg.o_lora_rank
+            put(f"{pfx}.self_attn.o_a_proj.weight",
+                np.asarray(lp["attn"]["o_a_proj"]).transpose(0, 2, 1).reshape(g * r, -1))
+            if lt in (LAYER_HCA, LAYER_CSA):
+                for ours, suffix, tr in _COMP_MAP:
+                    put(f"{pfx}.self_attn.compressor.{suffix}",
+                        lp["attn"]["compressor"][ours], tr)
+            if lt == LAYER_CSA:
+                for ours, suffix, tr in _IDX_MAP:
+                    put(f"{pfx}.self_attn.compressor.indexer.{suffix}",
+                        lp["attn"]["indexer"][ours], tr)
+            put(f"{pfx}.mlp.gate.weight", lp["mlp"]["router"], True)
+            if mt == "hash_moe":
+                put(f"{pfx}.mlp.gate.tid2eid",
+                    np.asarray(lp["mlp"]["tid2eid"]).astype(np.int64))
+            else:
+                put(f"{pfx}.mlp.gate.e_score_correction_bias",
+                    lp["mlp"]["e_score_correction_bias"])
+            put(f"{pfx}.mlp.experts.gate_up_proj",
+                np.asarray(lp["mlp"]["experts"]["gate_up_proj"]).transpose(0, 2, 1))
+            put(f"{pfx}.mlp.experts.down_proj",
+                np.asarray(lp["mlp"]["experts"]["down_proj"]).transpose(0, 2, 1))
+            for k in ("gate_proj", "up_proj", "down_proj"):
+                put(f"{pfx}.mlp.shared_experts.{k}.weight",
+                    lp["mlp"]["shared_experts"][k], True)
+            for site in ("attn_hc", "ffn_hc"):
+                put(f"{pfx}.{site}.fn", lp[site]["fn"])
+                put(f"{pfx}.{site}.base", lp[site]["base"])
+                put(f"{pfx}.{site}.scale", lp[site]["scale"])
+    return out
+
+
+def save_hf_checkpoint(params: Params, cfg: DeepseekV4Config, out_dir: str) -> None:
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    tensors = params_to_hf(params, cfg)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    save_file(tensors, os.path.join(out_dir, "model.safetensors"))
+    hf_cfg = {
+        "model_type": "deepseek_v4",
+        "architectures": ["DeepseekV4ForCausalLM"],
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_hidden_layers,
+        "num_attention_heads": cfg.num_attention_heads,
+        "head_dim": cfg.head_dim,
+        "q_lora_rank": cfg.q_lora_rank,
+        "o_groups": cfg.o_groups,
+        "o_lora_rank": cfg.o_lora_rank,
+        "sliding_window": cfg.sliding_window,
+        "layer_types": list(cfg.layer_types),
+        "mlp_layer_types": list(cfg.mlp_layer_types),
+        "compress_rates": {LAYER_HCA: cfg.compress_rate_hca,
+                           LAYER_CSA: cfg.compress_rate_csa},
+        "index_n_heads": cfg.index_n_heads,
+        "index_head_dim": cfg.index_head_dim,
+        "index_topk": cfg.index_topk,
+        "hc_mult": cfg.hc_mult,
+        "hc_sinkhorn_iters": cfg.hc_sinkhorn_iters,
+        "hc_eps": cfg.hc_eps,
+        "num_local_experts": cfg.num_experts,
+        "num_experts_per_tok": cfg.num_experts_per_tok,
+        "scoring_func": cfg.scoring_func,
+        "routed_scaling_factor": cfg.routed_scaling_factor,
+        "swiglu_limit": cfg.swiglu_limit,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+        "rope_parameters": cfg.rope_parameters,
+    }
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> DeepseekV4Config:
+    fields = set(DeepseekV4Config.__dataclass_fields__)
+    kw = {k: v for k, v in hf.items() if k in fields}
+    if "num_local_experts" in hf:
+        kw["num_experts"] = hf["num_local_experts"]
+    if "compress_rates" in hf:
+        kw["compress_rate_hca"] = hf["compress_rates"].get(LAYER_HCA, 128)
+        kw["compress_rate_csa"] = hf["compress_rates"].get(LAYER_CSA, 4)
+    kw.pop("model_type", None)
+    kw.update(overrides)
+    return DeepseekV4Config(**kw)

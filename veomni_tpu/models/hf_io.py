@@ -47,6 +47,12 @@ _LAYER_MAP: List[Tuple[str, str, bool]] = [
     ("kv_a_proj_with_mqa", "self_attn.kv_a_proj_with_mqa.weight", True),
     ("kv_a_layernorm", "self_attn.kv_a_layernorm.weight", False),
     ("kv_b_proj", "self_attn.kv_b_proj.weight", True),
+    # DSA lightning indexer (glm_moe_dsa)
+    ("indexer.wq_b", "self_attn.indexer.wq_b.weight", True),
+    ("indexer.wk", "self_attn.indexer.wk.weight", True),
+    ("indexer.k_norm_w", "self_attn.indexer.k_norm.weight", False),
+    ("indexer.k_norm_b", "self_attn.indexer.k_norm.bias", False),
+    ("indexer.weights_proj", "self_attn.indexer.weights_proj.weight", True),
     # norms
     ("post_attention_layernorm", "post_attention_layernorm.weight", False),
     ("pre_feedforward_layernorm", "pre_feedforward_layernorm.weight", False),

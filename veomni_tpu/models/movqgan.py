@@ -201,9 +201,11 @@ def init_params(rng: jax.Array, cfg: MoVQGANConfig) -> Params:
     enc["conv_out_b"] = jnp.zeros((cfg.z_channels,), jnp.float32)
 
     zq = cfg.embed_dim
-    # ---- decoder (spatially-normed on zq)
+    # ---- decoder (spatially-normed on zq); conv_in consumes the
+    # post_quant_conv output, which has z_channels channels (reference
+    # modeling_movqgan.py:413,594 — embed_dim->z_channels then conv_in)
     dec: Params = {
-        "conv_in_w": _conv_init(next(keys), 3, 3, cfg.embed_dim, cin, s),
+        "conv_in_w": _conv_init(next(keys), 3, 3, cfg.z_channels, cin, s),
         "conv_in_b": jnp.zeros((cin,), jnp.float32),
         "mid_res1": _res_params(keys, cin, cin, s, True, zq),
         "mid_attn": _attn_params(keys, cin, s, True, zq),
@@ -290,7 +292,11 @@ def _decoder(p, cfg, z, zq):
 
 
 def quantize(codebook: jax.Array, z: jax.Array, beta: float):
-    """z [N,h,w,e] -> (z_q straight-through, indices [N,h,w], vq_loss)."""
+    """z [N,h,w,e] -> (z_q straight-through, indices [N,h,w], vq_loss [N]).
+
+    The VQ/commit loss is PER IMAGE so callers with padded image slots can
+    mask before reducing (``omni_loss_fn``); ``autoencode_loss`` takes the
+    mean."""
     zf = z.astype(jnp.float32)
     cb = codebook.astype(jnp.float32)
     d = (
@@ -300,9 +306,9 @@ def quantize(codebook: jax.Array, z: jax.Array, beta: float):
     )
     idx = jnp.argmin(d, axis=-1)
     e = cb[idx]
-    vq_loss = ((jax.lax.stop_gradient(zf) - e) ** 2).mean() + beta * (
+    vq_loss = ((jax.lax.stop_gradient(zf) - e) ** 2).mean((1, 2, 3)) + beta * (
         (zf - jax.lax.stop_gradient(e)) ** 2
-    ).mean()
+    ).mean((1, 2, 3))
     z_q = zf + jax.lax.stop_gradient(e - zf)  # straight-through
     return z_q.astype(z.dtype), idx, vq_loss
 
@@ -328,10 +334,140 @@ def decode_code(params: Params, cfg: MoVQGANConfig, indices: jax.Array) -> jax.A
     return decode(params, cfg, z_q)
 
 
+# --------------------------------------------------------------------------
+# HF checkpoint import (ai-forever/MoVQGAN torch layout — reference module
+# tree at ``veomni/models/transformers/movqgan/modeling_movqgan.py:216,413``)
+# --------------------------------------------------------------------------
+def hf_to_params(model_dir: str, cfg: MoVQGANConfig) -> Params:
+    """Map a torch MoVQGAN state dict onto the functional param tree.
+
+    Torch convs are OIHW; ours are HWIO. Decoder norms are SpatialNorms
+    (``norm_layer`` + ``conv_y``/``conv_b``); ``add_conv`` checkpoints are
+    rejected (we don't carry the extra 3x3 on zq)."""
+    import numpy as np
+
+    from veomni_tpu.models.hf_io import LazyHFTensors
+
+    src = LazyHFTensors(model_dir)
+    if any(".norm1.conv.weight" in k for k in src.keys()):
+        raise NotImplementedError("MoVQGAN add_conv checkpoints not supported")
+
+    def t(name):
+        return np.asarray(src.read(name))
+
+    def conv(name):
+        return jnp.asarray(t(f"{name}.weight").transpose(2, 3, 1, 0))
+
+    def bias(name):
+        return jnp.asarray(t(f"{name}.bias"))
+
+    def norm(prefix, spatial):
+        if spatial:
+            return {
+                "gn_w": jnp.asarray(t(f"{prefix}.norm_layer.weight")),
+                "gn_b": jnp.asarray(t(f"{prefix}.norm_layer.bias")),
+                "conv_y_w": conv(f"{prefix}.conv_y"),
+                "conv_y_b": bias(f"{prefix}.conv_y"),
+                "conv_b_w": conv(f"{prefix}.conv_b"),
+                "conv_b_b": bias(f"{prefix}.conv_b"),
+            }
+        return {
+            "gn_w": jnp.asarray(t(f"{prefix}.weight")),
+            "gn_b": jnp.asarray(t(f"{prefix}.bias")),
+        }
+
+    def res_block(prefix, cin, cout, spatial):
+        p = {
+            "norm1": norm(f"{prefix}.norm1", spatial),
+            "conv1_w": conv(f"{prefix}.conv1"),
+            "conv1_b": bias(f"{prefix}.conv1"),
+            "norm2": norm(f"{prefix}.norm2", spatial),
+            "conv2_w": conv(f"{prefix}.conv2"),
+            "conv2_b": bias(f"{prefix}.conv2"),
+        }
+        if cin != cout:
+            p["shortcut_w"] = conv(f"{prefix}.nin_shortcut")
+            p["shortcut_b"] = bias(f"{prefix}.nin_shortcut")
+        return p
+
+    def attn_block(prefix, spatial):
+        p = {"norm": norm(f"{prefix}.norm", spatial)}
+        for mine, theirs in (("q", "q"), ("k", "k"), ("v", "v"), ("proj", "proj_out")):
+            p[f"{mine}_w"] = conv(f"{prefix}.{theirs}")
+            p[f"{mine}_b"] = bias(f"{prefix}.{theirs}")
+        return p
+
+    levels = len(cfg.ch_mult)
+    chs = [cfg.ch * m for m in cfg.ch_mult]
+
+    enc: Params = {
+        "conv_in_w": conv("encoder.conv_in"),
+        "conv_in_b": bias("encoder.conv_in"),
+        "down": [],
+    }
+    res = cfg.resolution
+    cin = chs[0]
+    for i in range(levels):
+        level: Params = {"res": [], "attn": []}
+        for j in range(cfg.num_res_blocks):
+            level["res"].append(res_block(f"encoder.down.{i}.block.{j}", cin, chs[i], False))
+            cin = chs[i]
+            if res in cfg.attn_resolutions:
+                level["attn"].append(attn_block(f"encoder.down.{i}.attn.{j}", False))
+        if i != levels - 1:
+            level["down_w"] = conv(f"encoder.down.{i}.downsample.conv")
+            level["down_b"] = bias(f"encoder.down.{i}.downsample.conv")
+            res //= 2
+        enc["down"].append(level)
+    enc["mid_res1"] = res_block("encoder.mid.block_1", cin, cin, False)
+    enc["mid_attn"] = attn_block("encoder.mid.attn_1", False)
+    enc["mid_res2"] = res_block("encoder.mid.block_2", cin, cin, False)
+    enc["norm_out"] = norm("encoder.norm_out", False)
+    enc["conv_out_w"] = conv("encoder.conv_out")
+    enc["conv_out_b"] = bias("encoder.conv_out")
+
+    dec: Params = {
+        "conv_in_w": conv("decoder.conv_in"),
+        "conv_in_b": bias("decoder.conv_in"),
+        "mid_res1": res_block("decoder.mid.block_1", cin, cin, True),
+        "mid_attn": attn_block("decoder.mid.attn_1", True),
+        "mid_res2": res_block("decoder.mid.block_2", cin, cin, True),
+        "up": [],
+    }
+    # torch ``up`` is prepended (up[i] = resolution level i); our list runs
+    # deepest-first, so our up[j] reads torch up[levels-1-j]
+    for i in reversed(range(levels)):
+        level = {"res": [], "attn": []}
+        for j in range(cfg.num_res_blocks + 1):
+            level["res"].append(res_block(f"decoder.up.{i}.block.{j}", cin, chs[i], True))
+            cin = chs[i]
+            if res in cfg.attn_resolutions:
+                level["attn"].append(attn_block(f"decoder.up.{i}.attn.{j}", True))
+        if i != 0:
+            level["up_w"] = conv(f"decoder.up.{i}.upsample.conv")
+            level["up_b"] = bias(f"decoder.up.{i}.upsample.conv")
+            res *= 2
+        dec["up"].append(level)
+    dec["norm_out"] = norm("decoder.norm_out", True)
+    dec["conv_out_w"] = conv("decoder.conv_out")
+    dec["conv_out_b"] = bias("decoder.conv_out")
+
+    return {
+        "encoder": enc,
+        "decoder": dec,
+        "codebook": jnp.asarray(t("quantize.embedding.weight")),
+        "quant_conv_w": conv("quant_conv"),
+        "quant_conv_b": bias("quant_conv"),
+        "post_quant_conv_w": conv("post_quant_conv"),
+        "post_quant_conv_b": bias("post_quant_conv"),
+    }
+
+
 def autoencode_loss(params: Params, cfg: MoVQGANConfig, pixels: jax.Array):
     """Tokenizer training objective: reconstruction MSE + VQ/commit loss
     (reference MoVQGANDecoder.forward)."""
-    z_q, idx, vq_loss = encode(params, cfg, pixels)
+    z_q, idx, vq_per = encode(params, cfg, pixels)
+    vq_loss = vq_per.mean()
     rec = decode(params, cfg, z_q)
     rec_loss = ((rec.astype(jnp.float32) - pixels.astype(jnp.float32)) ** 2).mean()
     return rec_loss + vq_loss, {

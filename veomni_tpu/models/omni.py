@@ -171,8 +171,38 @@ def audio_forward(params, cfg: AudioEncoderConfig, features: jax.Array) -> jax.A
     return jnp.dot(x, params["out_proj"])
 
 
+def init_image_gen_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
+    """MoVQ tokenizer + gen_aligner (codebook -> LM stream, Linear-GELU-Linear
+    like reference ``seed_omni/projector.py:20-33``) + generation head
+    (Linear-GELU-Linear onto the codebook vocab, ``GenerationHead`` at
+    ``decoder/movqgan/modeling_movqgan.py:40-52``)."""
+    from veomni_tpu.models import movqgan
+
+    icfg = cfg.image_gen
+    h = cfg.text.hidden_size
+    e = icfg.movq.embed_dim
+    v = icfg.movq.n_embed
+    s = icfg.movq.initializer_range
+    r1, r2, r3, r4, r5 = jax.random.split(rng, 5)
+
+    def init(key, shape):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    return {
+        "movq": movqgan.init_params(r1, icfg.movq),
+        "aligner": {
+            "fc1": init(r2, (e, h)), "fc1_b": jnp.zeros((h,), jnp.float32),
+            "fc2": init(r3, (h, h)), "fc2_b": jnp.zeros((h,), jnp.float32),
+        },
+        "gen_head": {
+            "fc1": init(r4, (h, h)), "fc1_b": jnp.zeros((h,), jnp.float32),
+            "fc2": init(r5, (h, v)), "fc2_b": jnp.zeros((v,), jnp.float32),
+        },
+    }
+
+
 def init_omni_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
-    r1, r2, r3 = jax.random.split(rng, 3)
+    r1, r2, r3, r4 = jax.random.split(rng, 4)
     params: Dict[str, Any] = {
         "language_model": transformer.init_params(r1, cfg.text),
     }
@@ -180,6 +210,8 @@ def init_omni_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
         params["vision_tower"] = init_vit_params(r2, cfg.vision, cfg.text.param_dtype)
     if cfg.audio is not None:
         params["audio_tower"] = init_audio_params(r3, cfg.audio, cfg.text.param_dtype)
+    if cfg.image_gen is not None:
+        params["image_gen"] = init_image_gen_params(r4, cfg)
     return params
 
 
@@ -221,10 +253,95 @@ def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
             embeds, input_ids, feats, batch["audio_mask"], cfg.audio_token_id
         )
 
+    # ---- image generation: VQ-tokenize target images, inject aligned
+    # codebook embeddings at image_gen_token_id slots, build next-token
+    # codebook labels (reference MoVQGANDecoder.lm_encode/lm_head contract,
+    # ``seed_omni/decoder/movqgan/modeling_movqgan.py:97-151``)
+    gen_labels = None
+    vq_loss = None
+    if cfg.image_gen is not None and "gen_pixels" in batch:
+        from veomni_tpu.data.data_collator import IGNORE_INDEX
+        from veomni_tpu.models import movqgan
+
+        icfg = cfg.image_gen
+        gp = params["image_gen"]
+        movq_p = gp["movq"]
+        if icfg.freeze_tokenizer:
+            movq_p = jax.lax.stop_gradient(movq_p)
+        codebook = movq_p["codebook"]
+        if icfg.freeze_codebook:
+            codebook = jax.lax.stop_gradient(codebook)
+        px = batch["gen_pixels"]                     # [B, max_gen, H, W, C]
+        bi, mg = px.shape[:2]
+        gen_mask = batch["gen_image_mask"]
+        _, idx, vq_per = movqgan.encode(
+            movq_p, icfg.movq, px.reshape(bi * mg, *px.shape[2:])
+        )
+        if not icfg.freeze_tokenizer:
+            # mask zero-filled dummy slots out of the VQ/commit objective
+            m = gen_mask.reshape(-1).astype(jnp.float32)
+            vq_loss = (vq_per * m).sum() / jnp.maximum(m.sum(), 1.0)
+        t_gen = icfg.tokens_per_image
+        idx = idx.reshape(bi, mg, t_gen)             # codebook index per slot
+        cb = codebook[idx]                           # [B, mg, T, e] f32
+        al = jax.tree.map(lambda p: p.astype(tcfg.dtype), gp["aligner"])
+        feats = jax.nn.gelu(
+            jnp.dot(cb.astype(tcfg.dtype), al["fc1"]) + al["fc1_b"]
+        )
+        feats = jnp.dot(feats, al["fc2"]) + al["fc2_b"]  # [B, mg, T, H]
+        embeds = merge_image_features(
+            embeds, input_ids, feats, gen_mask, cfg.image_gen_token_id
+        )
+        # per-position codebook code (IGNORE off gen slots), then the usual
+        # next-token shift: position p is trained to predict the code at p+1
+        is_gen = input_ids == cfg.image_gen_token_id
+        ordinal = jnp.cumsum(is_gen.astype(jnp.int32), axis=1) - 1
+        img_i_raw = ordinal // t_gen
+        img_i = jnp.clip(img_i_raw, 0, mg - 1)
+        tok_i = jnp.clip(ordinal % t_gen, 0, t_gen - 1)
+        code_at = jnp.take_along_axis(
+            idx.reshape(bi, mg * t_gen), img_i * t_gen + tok_i, axis=1
+        )
+        valid = (
+            is_gen
+            & (img_i_raw < mg)
+            & jnp.take_along_axis(gen_mask, img_i, axis=1)
+        )
+        code_at = jnp.where(valid, code_at, IGNORE_INDEX)
+        gen_labels = jnp.concatenate(
+            [code_at[:, 1:], jnp.full((bi, 1), IGNORE_INDEX, code_at.dtype)], axis=1
+        )
+        seg = batch.get("segment_ids")
+        if seg is not None:  # no cross-segment prediction under packing
+            same = jnp.concatenate(
+                [seg[:, 1:] == seg[:, :-1], jnp.zeros((bi, 1), bool)], axis=1
+            )
+            gen_labels = jnp.where(same, gen_labels, IGNORE_INDEX)
+
     hidden, moe_aux, moe_dropped = transformer.forward_hidden(
         lm_params, tcfg, input_ids, batch["position_ids"],
         batch.get("segment_ids"), inputs_embeds=embeds,
     )
-    return transformer.head_loss(
+    total, metrics = transformer.head_loss(
         lm_params, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
     )
+    if gen_labels is not None:
+        from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy
+
+        gh = jax.tree.map(lambda p: p.astype(tcfg.dtype), params["image_gen"]["gen_head"])
+        b, s, h = hidden.shape
+        g = jax.nn.gelu(jnp.dot(hidden.reshape(b * s, h), gh["fc1"]) + gh["fc1_b"])
+        # fold the head bias into the fused chunked CE via a ones column
+        g1 = jnp.concatenate([g, jnp.ones((b * s, 1), g.dtype)], axis=1)
+        k1 = jnp.concatenate([gh["fc2"], gh["fc2_b"][None, :]], axis=0)
+        gen_sum, gen_n = fused_linear_cross_entropy(g1, k1, gen_labels.reshape(-1))
+        total = total + cfg.image_gen.gen_loss_weight * gen_sum
+        # gen tokens join the token-sum normalization space (train_step
+        # divides by ntokens after the dp/sp psum)
+        metrics["ntokens"] = metrics["ntokens"] + gen_n
+        metrics["gen_loss_sum"] = gen_sum
+        metrics["gen_ntokens"] = gen_n
+        if vq_loss is not None:  # sum-space like the router aux loss
+            total = total + vq_loss * gen_n
+            metrics["vq_loss"] = vq_loss
+    return total, metrics

@@ -1,0 +1,490 @@
+"""Qwen2-VL: the real architecture — full-attention ViT, mrope, merger.
+
+Reference: ``veomni/models/transformers/qwen2_vl/`` (2.8k LoC generated
+modeling; upstream contract = HF ``Qwen2VLForConditionalGeneration``).
+Differences from Qwen2.5-VL (which shares our collator row contract):
+
+* vision blocks use **LayerNorm** (with bias) and a **quick-GELU fc1/fc2
+  MLP** (``mlp_ratio``×) instead of RMSNorm + biased-SwiGLU;
+* **no window attention**: every layer attends globally *within a frame*
+  (HF builds ``cu_seqlens`` per (h·w) frame — our packed-segment contract
+  reproduces that with one segment id per frame);
+* patches stay in processor (merge-block) order — no window permutation,
+  so merged 2×2 groups are contiguous and no inverse gather is needed;
+* video mrope t-positions are plain frame indices (no ``tokens_per_second``
+  scaling — that arrived with Qwen2.5-VL).
+
+TPU-first design mirrors qwen2_5_vl.py: one statically padded packed patch
+sequence per micro-batch, host-precomputed (h, w) rope positions + frame
+segment ids, pure gathers + dense math inside jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu import ops
+from veomni_tpu.models import transformer
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.models.qwen2_5_vl import (
+    _is_visual_key,
+    _per_image_pos_hw,
+    _text_key_map,
+    merge_vision_features,
+)
+from veomni_tpu.models.qwen2_5_vl import (
+    mrope_position_ids as _mrope_q25,
+)
+
+
+@dataclass
+class Qwen2VisionConfig:
+    """HF ``Qwen2VLVisionConfig`` surface (defaults = 7B checkpoint)."""
+
+    depth: int = 32
+    embed_dim: int = 1280
+    hidden_size: int = 3584          # LM width (merger output)
+    hidden_act: str = "quick_gelu"
+    mlp_ratio: int = 4
+    num_heads: int = 16
+    in_channels: int = 3
+    patch_size: int = 14
+    spatial_merge_size: int = 2
+    temporal_patch_size: int = 2
+    initializer_range: float = 0.02
+    # qwen2_vl has no time scaling; fixed 1.0 makes the shared qwen2_5_vl
+    # mrope walker produce plain frame indices for video grids
+    tokens_per_second: float = 1.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return self.embed_dim * self.mlp_ratio
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.temporal_patch_size * self.patch_size ** 2
+
+    @property
+    def merge_unit(self) -> int:
+        return self.spatial_merge_size ** 2
+
+    @property
+    def out_hidden_size(self) -> int:  # trainer/collator shared surface
+        return self.hidden_size
+
+
+@dataclass
+class Qwen2VLConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    vision: Qwen2VisionConfig = field(default_factory=Qwen2VisionConfig)
+    image_token_id: int = 151655
+    video_token_id: int = 151656
+    vision_start_token_id: int = 151652
+    freeze_vision: bool = False
+    model_type: str = "qwen2_vl"
+
+    def __post_init__(self):
+        if isinstance(self.text, dict):
+            self.text = TransformerConfig(**self.text)
+        if isinstance(self.vision, dict):
+            self.vision = Qwen2VisionConfig(**self.vision)
+
+    def __getattr__(self, name):  # FlopsCounter / trainer surface
+        return getattr(object.__getattribute__(self, "text"), name)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_vision_params(rng: jax.Array, cfg: Qwen2VisionConfig, dtype=jnp.float32):
+    s = cfg.initializer_range
+    d, i, L = cfg.embed_dim, cfg.intermediate_size, cfg.depth
+    merge_dim = d * cfg.merge_unit
+    keys = iter(jax.random.split(rng, 12))
+
+    def init(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(dtype)
+
+    return {
+        "patch_embed": init(next(keys), (cfg.patch_dim, d)),
+        "blocks": {
+            "norm1_w": jnp.ones((L, d), dtype),
+            "norm1_b": jnp.zeros((L, d), dtype),
+            "norm2_w": jnp.ones((L, d), dtype),
+            "norm2_b": jnp.zeros((L, d), dtype),
+            "qkv_w": init(next(keys), (L, d, 3 * d)),
+            "qkv_b": jnp.zeros((L, 3 * d), dtype),
+            "proj_w": init(next(keys), (L, d, d)),
+            "proj_b": jnp.zeros((L, d), dtype),
+            "fc1_w": init(next(keys), (L, d, i)),
+            "fc1_b": jnp.zeros((L, i), dtype),
+            "fc2_w": init(next(keys), (L, i, d)),
+            "fc2_b": jnp.zeros((L, d), dtype),
+        },
+        "merger": {
+            "ln_q_w": jnp.ones((d,), dtype),
+            "ln_q_b": jnp.zeros((d,), dtype),
+            "fc1_w": init(next(keys), (merge_dim, merge_dim)),
+            "fc1_b": jnp.zeros((merge_dim,), dtype),
+            "fc2_w": init(next(keys), (merge_dim, cfg.hidden_size)),
+            "fc2_b": jnp.zeros((cfg.hidden_size,), dtype),
+        },
+    }
+
+
+def init_params(rng: jax.Array, cfg: Qwen2VLConfig) -> Dict[str, Any]:
+    r1, r2 = jax.random.split(rng)
+    return {
+        "language_model": transformer.init_params(r1, cfg.text),
+        "vision_tower": init_vision_params(r2, cfg.vision, dtype=cfg.text.param_dtype),
+    }
+
+
+def abstract_params(cfg: Qwen2VLConfig):
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+# ---------------------------------------------------------------------------
+# host-side index plan
+# ---------------------------------------------------------------------------
+
+def vision_metadata(
+    grid_thw: Sequence[Tuple[int, int, int]],
+    cfg: Qwen2VisionConfig,
+    n_pad_patches: int,
+) -> Dict[str, np.ndarray]:
+    """Static index plan for a packed patch sequence in processor order:
+    ``pos_hw`` [N, 2] rope positions, ``seg`` [N] per-frame attention
+    segments (0 = padding; HF ``cu_seqlens = repeat_interleave(h*w, t)``),
+    ``merged_mask`` [N / merge_unit]."""
+    pos_list, segs = [], []
+    seg_id = 0
+    n = 0
+    for (t, h, w) in grid_thw:
+        pos_list.append(_per_image_pos_hw(t, h, w, cfg.spatial_merge_size))
+        for _ in range(t):
+            seg_id += 1
+            segs.append(np.full(h * w, seg_id, np.int32))
+        n += t * h * w
+    if n > n_pad_patches:
+        raise ValueError(
+            f"{n} patches exceed the static budget {n_pad_patches}; raise "
+            "data.max_patches or drop images upstream"
+        )
+    unit = cfg.merge_unit
+    m_pad = n_pad_patches // unit
+
+    def pad_to(x, size, fill=0):
+        out = np.full((size,) + x.shape[1:], fill, x.dtype)
+        out[: len(x)] = x
+        return out
+
+    return {
+        "pos_hw": pad_to(
+            np.concatenate(pos_list).astype(np.int32) if pos_list
+            else np.zeros((0, 2), np.int32), n_pad_patches),
+        "seg": pad_to(
+            np.concatenate(segs) if segs else np.zeros((0,), np.int32),
+            n_pad_patches),
+        "merged_mask": pad_to(np.ones(n // unit, bool), m_pad, fill=False),
+    }
+
+
+def mrope_position_ids(
+    input_ids: np.ndarray,
+    grid_thw: Sequence[Tuple[int, int, int]],
+    cfg: Qwen2VLConfig,
+    video: Optional[Sequence[bool]] = None,
+) -> np.ndarray:
+    """HF ``get_rope_index`` (modeling_qwen2_vl.py:925): identical walk to
+    qwen2_5_vl except t-indices are plain frame numbers for EVERY grid
+    (qwen2_vl predates ``second_per_grid_ts``; its image/video branches are
+    the same ``arange(t)``) — delegated with interval pinned to 1."""
+    del video  # no image/video distinction in the qwen2_vl walk
+    return _mrope_q25(
+        input_ids, grid_thw, cfg,
+        second_per_grid_ts=[1.0] * len(grid_thw), video=[True] * len(grid_thw),
+    )
+
+
+# ---------------------------------------------------------------------------
+# vision tower forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, w, b, eps=1e-6):
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    return (x * w + b).astype(dt)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def _vision_block(x, lp, cfg: Qwen2VisionConfig, cos, sin, seg):
+    n, d = x.shape
+    hd = cfg.head_dim
+    y = _layer_norm(x, lp["norm1_w"], lp["norm1_b"])
+    qkv = jnp.dot(y, lp["qkv_w"]) + lp["qkv_b"]
+    q, k, v = jnp.split(qkv.reshape(1, n, 3 * cfg.num_heads, hd), 3, axis=2)
+    q, k = ops.apply_rotary(q, k, cos, sin)
+    attn = ops.attention(q, k, v, segment_ids=seg, causal=False)
+    x = x + jnp.dot(attn.reshape(n, d), lp["proj_w"]) + lp["proj_b"]
+    y = _layer_norm(x, lp["norm2_w"], lp["norm2_b"])
+    x = x + jnp.dot(_quick_gelu(jnp.dot(y, lp["fc1_w"]) + lp["fc1_b"]),
+                    lp["fc2_w"]) + lp["fc2_b"]
+    return x
+
+
+def vision_forward(
+    params, cfg: Qwen2VisionConfig, pixel_values, pos_hw, seg,
+    dtype=jnp.bfloat16,
+):
+    """pixel_values [N, patch_dim] (processor order, padded); returns merged
+    features [N / merge_unit, hidden_size] in image order. Scoped to sp=1
+    like the qwen2_5_vl tower (per-module heterogeneous SP)."""
+    from veomni_tpu.parallel.parallel_state import (
+        get_parallel_state_or_none, use_parallel_state,
+    )
+
+    ps = get_parallel_state_or_none()
+    if ps is not None and ps.sp_enabled:
+        with use_parallel_state(ps.without_sp()):
+            return vision_forward(params, cfg, pixel_values, pos_hw, seg, dtype=dtype)
+    p = jax.tree.map(lambda t: t.astype(dtype), params)
+    x = jnp.dot(pixel_values.astype(dtype), p["patch_embed"])  # [N, D]
+
+    # 2D rope: head_dim/2 split across (h, w) — HF Qwen2VisionRotaryEmbedding
+    hd = cfg.head_dim
+    inv_freq = 1.0 / (10000.0 ** (jnp.arange(0, hd // 2, 2, jnp.float32) / (hd // 2)))
+    fh = pos_hw[:, 0:1].astype(jnp.float32) * inv_freq
+    fw = pos_hw[:, 1:2].astype(jnp.float32) * inv_freq
+    freqs = jnp.concatenate([fh, fw], -1)
+    emb = jnp.concatenate([freqs, freqs], -1)[None]
+    cos, sin = jnp.cos(emb), jnp.sin(emb)
+
+    body = partial(_vision_block, cfg=cfg, cos=cos, sin=sin, seg=seg[None])
+    x, _ = jax.lax.scan(
+        lambda c, lp: (jax.checkpoint(body)(c, lp), None), x, p["blocks"]
+    )
+
+    # 2x2 merger (merge-block groups are contiguous in processor order)
+    mg = p["merger"]
+    y = _layer_norm(x, mg["ln_q_w"], mg["ln_q_b"])
+    y = y.reshape(x.shape[0] // cfg.merge_unit, cfg.merge_unit * cfg.embed_dim)
+    y = jax.nn.gelu(jnp.dot(y, mg["fc1_w"]) + mg["fc1_b"])
+    return jnp.dot(y, mg["fc2_w"]) + mg["fc2_b"]
+
+
+# ---------------------------------------------------------------------------
+# loss
+# ---------------------------------------------------------------------------
+
+def loss_fn(params, cfg: Qwen2VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: input_ids/labels/segment_ids [B,S]; position_ids [B,3,S]
+    (mrope); pixel_values [N, patch_dim]; vis_pos_hw [N,2]; vis_seg [N];
+    vis_merged_mask [M]."""
+    tcfg = cfg.text
+    vp = params["vision_tower"]
+    if cfg.freeze_vision:
+        vp = jax.lax.stop_gradient(vp)
+    feats = vision_forward(
+        vp, cfg.vision, batch["pixel_values"], batch["vis_pos_hw"],
+        batch["vis_seg"], dtype=tcfg.dtype,
+    )
+    lm = params["language_model"]
+    embeds = lm["embed_tokens"].astype(tcfg.dtype)[batch["input_ids"]]
+    embeds = merge_vision_features(
+        embeds, batch["input_ids"], feats, batch["vis_merged_mask"],
+        cfg.image_token_id, cfg.video_token_id,
+    )
+    hidden, moe_aux, moe_dropped = transformer.forward_hidden(
+        lm, tcfg, batch["input_ids"], batch["position_ids"],
+        batch.get("segment_ids"), inputs_embeds=embeds,
+    )
+    return transformer.head_loss(
+        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+    )
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint io
+# ---------------------------------------------------------------------------
+
+_VIS_BLOCK_MAP = [
+    ("norm1_w", "norm1.weight", False),
+    ("norm1_b", "norm1.bias", False),
+    ("norm2_w", "norm2.weight", False),
+    ("norm2_b", "norm2.bias", False),
+    ("qkv_w", "attn.qkv.weight", True),
+    ("qkv_b", "attn.qkv.bias", False),
+    ("proj_w", "attn.proj.weight", True),
+    ("proj_b", "attn.proj.bias", False),
+    ("fc1_w", "mlp.fc1.weight", True),
+    ("fc1_b", "mlp.fc1.bias", False),
+    ("fc2_w", "mlp.fc2.weight", True),
+    ("fc2_b", "mlp.fc2.bias", False),
+]
+
+
+def hf_to_params(model_dir: str, cfg: Qwen2VLConfig, target_shardings=None):
+    """Load an HF Qwen2-VL checkpoint (visual.* + model.* text tree) into our
+    composite pytree; text stays on hf_io's streamed shard-aligned path."""
+    from veomni_tpu.models import hf_io
+
+    pd = cfg.text.param_dtype
+    ts_lm = target_shardings["language_model"] if target_shardings else None
+    ts_vis = target_shardings["vision_tower"] if target_shardings else None
+
+    language_model = hf_io.hf_to_params(
+        model_dir, cfg.text, target_shardings=ts_lm, key_map=_text_key_map
+    )
+
+    lazy = hf_io.LazyHFTensors(model_dir)
+    vis_alias = {}
+    for k in lazy.keys():
+        if _is_visual_key(k):
+            vis_alias[k[k.index("visual.") + len("visual."):]] = k
+
+    def read(name: str) -> np.ndarray:
+        return np.asarray(lazy.read(vis_alias[name]))
+
+    def place(path_in_vis, arr):
+        arr = jnp.asarray(np.ascontiguousarray(arr), pd)
+        if ts_vis is None:
+            return arr
+        sh = ts_vis
+        for p in path_in_vis:
+            sh = sh[p]
+        return jax.device_put(arr, sh)
+
+    vcfg = cfg.vision
+    blocks: Dict[str, Any] = {}
+    for ours, suffix, transpose in _VIS_BLOCK_MAP:
+        stacked = np.stack([
+            read(f"blocks.{i}.{suffix}").T if transpose
+            else read(f"blocks.{i}.{suffix}")
+            for i in range(vcfg.depth)
+        ])
+        blocks[ours] = place(("blocks", ours), stacked)
+    vision_tower = {
+        "patch_embed": place(
+            ("patch_embed",),
+            read("patch_embed.proj.weight").reshape(vcfg.embed_dim, -1).T,
+        ),
+        "blocks": blocks,
+        "merger": {
+            "ln_q_w": place(("merger", "ln_q_w"), read("merger.ln_q.weight")),
+            "ln_q_b": place(("merger", "ln_q_b"), read("merger.ln_q.bias")),
+            "fc1_w": place(("merger", "fc1_w"), read("merger.mlp.0.weight").T),
+            "fc1_b": place(("merger", "fc1_b"), read("merger.mlp.0.bias")),
+            "fc2_w": place(("merger", "fc2_w"), read("merger.mlp.2.weight").T),
+            "fc2_b": place(("merger", "fc2_b"), read("merger.mlp.2.bias")),
+        },
+    }
+    return {"language_model": language_model, "vision_tower": vision_tower}
+
+
+def params_to_hf(params, cfg: Qwen2VLConfig) -> Dict[str, np.ndarray]:
+    from veomni_tpu.models import hf_io
+
+    out: Dict[str, np.ndarray] = {}
+    text = hf_io.params_to_hf(params["language_model"], cfg.text)
+    for k, v in text.items():
+        if k == "lm_head.weight":
+            out[k] = v
+        else:
+            out[k.replace("model.", "model.language_model.", 1)] = v
+    vt = hf_io.gather_to_host(params["vision_tower"])
+    vcfg = cfg.vision
+    pfx = "model.visual"
+    out[f"{pfx}.patch_embed.proj.weight"] = vt["patch_embed"].T.reshape(
+        vcfg.embed_dim, vcfg.in_channels, vcfg.temporal_patch_size,
+        vcfg.patch_size, vcfg.patch_size,
+    )
+    for ours, suffix, transpose in _VIS_BLOCK_MAP:
+        for i in range(vcfg.depth):
+            x = vt["blocks"][ours][i]
+            out[f"{pfx}.blocks.{i}.{suffix}"] = x.T if transpose else x
+    out[f"{pfx}.merger.ln_q.weight"] = vt["merger"]["ln_q_w"]
+    out[f"{pfx}.merger.ln_q.bias"] = vt["merger"]["ln_q_b"]
+    out[f"{pfx}.merger.mlp.0.weight"] = vt["merger"]["fc1_w"].T
+    out[f"{pfx}.merger.mlp.0.bias"] = vt["merger"]["fc1_b"]
+    out[f"{pfx}.merger.mlp.2.weight"] = vt["merger"]["fc2_w"].T
+    out[f"{pfx}.merger.mlp.2.bias"] = vt["merger"]["fc2_b"]
+    return out
+
+
+def save_hf_checkpoint(params, cfg: Qwen2VLConfig, out_dir: str) -> None:
+    import json
+    import os
+
+    from safetensors.flax import save_file
+
+    tensors = params_to_hf(params, cfg)  # collective gather
+    if jax.process_index() != 0:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    save_file({k: jnp.asarray(v) for k, v in tensors.items()},
+              os.path.join(out_dir, "model.safetensors"))
+    hf_cfg = {
+        "model_type": "qwen2_vl",
+        "architectures": ["Qwen2VLForConditionalGeneration"],
+        "image_token_id": cfg.image_token_id,
+        "video_token_id": cfg.video_token_id,
+        "vision_start_token_id": cfg.vision_start_token_id,
+        "text_config": {**cfg.text.to_hf_config(), "model_type": "qwen2_vl_text"},
+        "vision_config": {
+            "model_type": "qwen2_vl",
+            "depth": cfg.vision.depth,
+            "embed_dim": cfg.vision.embed_dim,
+            "hidden_size": cfg.vision.hidden_size,
+            "hidden_act": cfg.vision.hidden_act,
+            "mlp_ratio": cfg.vision.mlp_ratio,
+            "num_heads": cfg.vision.num_heads,
+            "in_channels": cfg.vision.in_channels,
+            "patch_size": cfg.vision.patch_size,
+            "spatial_merge_size": cfg.vision.spatial_merge_size,
+            "temporal_patch_size": cfg.vision.temporal_patch_size,
+        },
+    }
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> Qwen2VLConfig:
+    """Build from an HF Qwen2VLConfig dict (config.json)."""
+    text_hf = dict(hf.get("text_config") or {})
+    for key in ("vocab_size", "hidden_size", "intermediate_size",
+                "num_hidden_layers", "num_attention_heads",
+                "num_key_value_heads", "rope_theta", "rms_norm_eps",
+                "tie_word_embeddings", "rope_scaling", "max_position_embeddings"):
+        if key not in text_hf and key in hf:
+            text_hf[key] = hf[key]
+    text = TransformerConfig.from_hf_config(
+        {**text_hf, "model_type": "qwen2"}, **overrides
+    )
+    vis_hf = dict(hf.get("vision_config") or {})
+    vis_fields = {f for f in Qwen2VisionConfig.__dataclass_fields__}
+    vision = Qwen2VisionConfig(**{k: v for k, v in vis_hf.items() if k in vis_fields})
+    return Qwen2VLConfig(
+        text=text,
+        vision=vision,
+        image_token_id=hf.get("image_token_id", 151655),
+        video_token_id=hf.get("video_token_id", 151656),
+        vision_start_token_id=hf.get("vision_start_token_id", 151652),
+    )

@@ -75,6 +75,16 @@ def _attn_params(keys, cfg: TransformerConfig, L: int, pd) -> Params:
             next(keys), (L, cfg.kv_lora_rank, nh * (cfg.qk_nope_head_dim + vd)), pd, s
         )
         p["o_proj"] = _dense_init(next(keys), (L, nh * vd, h), pd, s)
+        if cfg.use_dsa:
+            # DSA lightning indexer (glm_moe_dsa): lightweight side scorer
+            inh, ihd = cfg.index_n_heads, cfg.index_head_dim
+            p["indexer"] = {
+                "wq_b": _dense_init(next(keys), (L, cfg.q_lora_rank, inh * ihd), pd, s),
+                "wk": _dense_init(next(keys), (L, h, ihd), pd, s),
+                "k_norm_w": jnp.ones((L, ihd), pd),
+                "k_norm_b": jnp.zeros((L, ihd), pd),
+                "weights_proj": _dense_init(next(keys), (L, h, inh), pd, s),
+            }
     else:
         qd, kvd = cfg.q_dim, cfg.kv_dim
         p["q_proj"] = _dense_init(next(keys), (L, h, qd), pd, s)
@@ -375,10 +385,57 @@ def _standard_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, wi
     return out
 
 
-def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window):
+def _dsa_bias(x, lp, cfg: TransformerConfig, cos, sin, segment_ids):
+    """DSA lightning-indexer top-k additive mask [B,S,S] (glm_moe_dsa;
+    reference ``GlmMoeDsaIndexer`` at ``glm_moe_dsa/generated/...:123``).
+
+    The indexer runs no-grad (``@torch.no_grad`` upstream): token selection
+    is non-differentiable and its params train separately. Rope on the
+    leading ``qk_rope_head_dim`` channels, NON-interleaved (NeoX) regardless
+    of the main attention's interleave."""
+    b, s, _ = x.shape
+    inh, ihd, dr = cfg.index_n_heads, cfg.index_head_dim, cfg.qk_rope_head_dim
+    idx = lp["indexer"]
+    q_resid = _norm(jnp.dot(x, lp["q_a_proj"]), lp["q_a_layernorm"], cfg)
+    q = jnp.dot(q_resid, idx["wq_b"]).reshape(b, s, inh, ihd)
+    k = jnp.dot(x, idx["wk"])
+    kf = k.astype(jnp.float32)
+    kf = (kf - kf.mean(-1, keepdims=True)) * jax.lax.rsqrt(
+        kf.var(-1, keepdims=True) + 1e-6
+    )
+    k = (kf * idx["k_norm_w"] + idx["k_norm_b"]).astype(x.dtype)
+    q_pe, k_pe = ops.apply_rotary(
+        q[..., :dr], k[..., :dr].reshape(b, s, 1, dr), cos, sin, interleaved=False
+    )
+    q = jnp.concatenate([q_pe, q[..., dr:]], axis=-1)
+    k = jnp.concatenate([k_pe[:, :, 0], k[..., dr:]], axis=-1)
+    scores = jax.nn.relu(
+        jnp.einsum("bshd,btd->bsht", q.astype(jnp.float32), k.astype(jnp.float32))
+    ) * (ihd ** -0.5)
+    w = jnp.dot(x, idx["weights_proj"]).astype(jnp.float32) * (inh ** -0.5)
+    index_scores = jnp.einsum("bsht,bsh->bst", scores, w)
+
+    qi = jnp.arange(s)[:, None]
+    ki = jnp.arange(s)[None, :]
+    allowed = (ki <= qi)[None]
+    if segment_ids is not None:
+        allowed = allowed & (segment_ids[:, :, None] == segment_ids[:, None, :])
+    index_scores = jnp.where(allowed, index_scores, -jnp.inf)
+    top_k = min(cfg.index_topk, s)
+    kth = jax.lax.top_k(index_scores, top_k)[0][..., -1:]
+    keep = (index_scores >= kth) & allowed
+    return jax.lax.stop_gradient(
+        jnp.where(keep, 0.0, -jnp.inf).astype(jnp.float32)
+    )
+
+
+def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window,
+                   dsa_bias=None):
     """DeepSeek MLA (training form): materialize per-head k/v from the
     low-rank kv latent; rope applies to the shared rope-part only.
-    (Reference: deepseek_v3 generated modeling.)"""
+    (Reference: deepseek_v3 generated modeling.) With ``dsa_bias`` the
+    top-k-sparse selection applies as an additive mask on the dense XLA
+    path — the TPU fallback for the reference's flashmla_cudnn kernel."""
     b, s, _ = x.shape
     nh = cfg.num_attention_heads
     dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
@@ -405,24 +462,54 @@ def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window)
     from veomni_tpu.ops.rotary import yarn_attention_factor
 
     scale = (dn + dr) ** -0.5 * yarn_attention_factor(cfg.rope_scaling, dr)
-    attn = ops.attention(
-        q, k, v, segment_ids=segment_ids, causal=True,
-        softmax_scale=scale, sliding_window=window,
-    )
+    if dsa_bias is not None:
+        from veomni_tpu.ops.attention import _attention_dense
+        from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
+
+        ps = get_parallel_state_or_none()
+        if ps is not None and (ps.ulysses_size > 1 or ps.cp_size > 1):
+            raise NotImplementedError(
+                "DSA sparse attention under ulysses/ring SP: gather-based "
+                "bias plumbing is a follow-up; run DSA models with sp=1"
+            )
+        attn = _attention_dense(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            softmax_scale=scale, sliding_window=window, bias=dsa_bias,
+        )
+    else:
+        attn = ops.attention(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            softmax_scale=scale, sliding_window=window,
+        )
     return jnp.dot(attn.reshape(b, s, nh * dv), lp["o_proj"])
 
 
 def _decoder_layer(
-    hidden, lp, *, cfg: TransformerConfig, cos, sin, segment_ids,
-    window=None, is_moe_segment=None,
+    hidden, lp, dsa_prev=None, dsa_shared=None, *, cfg: TransformerConfig,
+    cos, sin, segment_ids, window=None, is_moe_segment=None,
 ):
     b, s, h = hidden.shape
     is_moe = cfg.is_moe if is_moe_segment is None else is_moe_segment
     constrain = _activation_constraint()
     hidden = constrain(hidden)
     x = _norm(hidden, lp["input_layernorm"], cfg)
+    dsa_bias = None
+    if cfg.use_dsa:
+        # "shared" layers reuse the previous layer's top-k selection
+        # (reference skip_topk, arXiv:2603.12201); lax.cond skips the
+        # indexer compute at runtime on those layers. The [B,S,S] carry only
+        # exists when the config actually has shared layers.
+        if dsa_shared is None:
+            dsa_bias = _dsa_bias(x, lp, cfg, cos, sin, segment_ids)
+        else:
+            dsa_bias = jax.lax.cond(
+                dsa_shared,
+                lambda: dsa_prev,
+                lambda: _dsa_bias(x, lp, cfg, cos, sin, segment_ids),
+            )
     if cfg.use_mla:
-        attn_out = _mla_attention(x, lp, cfg, cos, sin, segment_ids, window)
+        attn_out = _mla_attention(x, lp, cfg, cos, sin, segment_ids, window,
+                                  dsa_bias=dsa_bias)
     else:
         attn_out = _standard_attention(
             x, lp, cfg, cos, sin, segment_ids, window, lp.get("sinks")
@@ -479,6 +566,8 @@ def _decoder_layer(
         aux = jnp.float32(0.0)
     if cfg.sandwich_norms:
         out = _norm(out, lp["post_feedforward_layernorm"], cfg)
+    if dsa_prev is not None:  # carry mode (configs with "shared" layers)
+        return constrain(hidden + out), (aux, dropped), dsa_bias
     return constrain(hidden + out), (aux, dropped)
 
 
@@ -526,7 +615,7 @@ def forward_hidden(
     L = cfg.num_hidden_layers
     k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
 
-    def run_segment(hidden, layer_tree, offset, count, is_moe_seg):
+    def run_segment(hidden, layer_tree, offset, count, is_moe_seg, dsa_carry):
         """Scan consecutive layers; *static* per-run window/rope signature so
         full-attention layers keep the flash-kernel fast path (per-layer
         patterns like gemma3's 5:1 sliding:full become a few short scans)."""
@@ -557,14 +646,40 @@ def forward_hidden(
             )
             if cfg.remat:
                 body = jax.checkpoint(body, policy=_remat_policy(cfg))
-            hidden, (auxes, drops) = jax.lax.scan(lambda c, lp: body(c, lp), hidden, sub)
+            if dsa_carry is not None:
+                flags = jnp.asarray([
+                    cfg.indexer_types[offset + start + i] == "shared"
+                    for i in range(n)
+                ])
+
+                def scan_body(carry, xs_):
+                    lp, fl = xs_
+                    h2, aux_drop, new_bias = body(carry[0], lp, carry[1], fl)
+                    return (h2, new_bias), aux_drop
+
+                (hidden, dsa_carry), (auxes, drops) = jax.lax.scan(
+                    scan_body, (hidden, dsa_carry), (sub, flags)
+                )
+            else:
+                hidden, (auxes, drops) = jax.lax.scan(
+                    lambda c, lp: body(c, lp), hidden, sub
+                )
             aux_total = aux_total + auxes.sum()
             drop_total = drop_total + drops.sum()
-        return hidden, aux_total, drop_total
+        return hidden, aux_total, drop_total, dsa_carry
 
     auxes_total = jnp.float32(0.0)
     drops_total = jnp.float32(0.0)
     K_inject = 0 if post_layer_residuals is None else post_layer_residuals.shape[0]
+    # DSA "shared" layers reuse the previous layer's selection; the [B,S,S]
+    # carry (threaded across run/segment boundaries, zeros before the first
+    # indexer) only exists when the config actually has shared layers —
+    # all-"full" DSA configs keep the plain scan
+    dsa_carry = (
+        jnp.zeros((hidden.shape[0], hidden.shape[1], hidden.shape[1]), jnp.float32)
+        if cfg.use_dsa and "shared" in tuple(cfg.indexer_types or ())
+        else None
+    )
 
     segments = []
     if k_dense:
@@ -580,7 +695,9 @@ def forward_hidden(
                 tree if (start == 0 and n == count)
                 else jax.tree.map(lambda t: t[start:start + n], tree)
             )
-            hidden, auxes, drops = run_segment(hidden, sub, g, n, is_moe_seg)
+            hidden, auxes, drops, dsa_carry = run_segment(
+                hidden, sub, g, n, is_moe_seg, dsa_carry
+            )
             auxes_total = auxes_total + auxes
             drops_total = drops_total + drops
             if g < K_inject:

@@ -36,7 +36,7 @@ class VLMConfig:
     image_token_id: int = 151655  # qwen-vl convention
     freeze_vision: bool = False
     max_images: int = 4  # image slots per sample (static shape contract)
-    model_type: str = "qwen2_vl"
+    model_type: str = "slot_vlm"
 
     def __post_init__(self):
         if isinstance(self.text, dict):

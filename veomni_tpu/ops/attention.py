@@ -299,6 +299,7 @@ def _attention_dense(
     softmax_scale: Optional[float] = None,
     sliding_window=None,
     sinks: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,  # [B, Sq, Sk] additive (DSA top-k mask)
 ):
     b, sq, hq, d = q.shape
     sk = k.shape[1]
@@ -308,6 +309,9 @@ def _attention_dense(
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores * scale
+    if bias is not None:
+        # clamp -inf bias to a finite floor so fully-masked rows stay NaN-free
+        scores = scores + jnp.maximum(bias[:, None], -1e30)
     mask = None
     if causal:
         qi = jnp.arange(sq)[:, None]

@@ -36,7 +36,7 @@ def build_optimizer(
     if optimizer in ("adamw", "anyprecision_adamw"):
         import jax.numpy as jnp
 
-        return optax.adamw(
+        base = optax.adamw(
             learning_rate=lr,
             b1=betas[0],
             b2=betas[1],
@@ -45,15 +45,36 @@ def build_optimizer(
             mask=_decay_mask(params_or_abstract) if weight_decay else None,
             mu_dtype=getattr(jnp, mu_dtype) if isinstance(mu_dtype, str) else mu_dtype,
         )
-    if optimizer == "adafactor":
-        return optax.adafactor(learning_rate=lr)
-    if optimizer == "sgd":
-        return optax.sgd(learning_rate=lr)
-    if optimizer == "muon":
+    elif optimizer == "adafactor":
+        base = optax.adafactor(learning_rate=lr)
+    elif optimizer == "sgd":
+        base = optax.sgd(learning_rate=lr)
+    elif optimizer == "muon":
         from veomni_tpu.optim.muon import build_muon
 
-        return build_muon(params_or_abstract, lr=lr, weight_decay=weight_decay)
-    raise ValueError(f"unknown optimizer {optimizer!r}")
+        base = build_muon(params_or_abstract, lr=lr, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+    return _guard_non_float(base, params_or_abstract)
+
+
+def _guard_non_float(
+    base: optax.GradientTransformation, params_or_abstract
+) -> optax.GradientTransformation:
+    """Route non-float leaves (frozen lookup tables, e.g. deepseek_v4's
+    hash-router tid2eid buffer) to set_to_zero — they are checkpointed state,
+    not trainable parameters (the reference registers them as buffers)."""
+    import jax.numpy as jnp
+
+    labels = jax.tree.map(
+        lambda p: "train" if jnp.issubdtype(p.dtype, jnp.inexact) else "frozen",
+        params_or_abstract,
+    )
+    if not any(lbl == "frozen" for lbl in jax.tree.leaves(labels)):
+        return base
+    return optax.multi_transform(
+        {"train": base, "frozen": optax.set_to_zero()}, labels
+    )
 
 
 def with_param_groups(

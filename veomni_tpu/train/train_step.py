@@ -83,7 +83,18 @@ def build_train_step(
     """
 
     def grads_one_micro(params, micro):
-        (loss_sum, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, micro)
+        (loss_sum, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True, allow_int=True
+        )(params, micro)
+        # non-differentiable leaves (frozen int lookup tables, e.g. the
+        # deepseek_v4 hash-router tid2eid) produce float0 grads; zero-fill
+        # so the f32 accumulation tree stays uniform (build_optimizer routes
+        # these leaves to set_to_zero)
+        grads = jax.tree.map(
+            lambda g, p: jnp.zeros(p.shape, jnp.float32)
+            if g.dtype == jax.dtypes.float0 else g,
+            grads, params,
+        )
         extras = {
             k: v.astype(jnp.float32)
             for k, v in metrics.items()

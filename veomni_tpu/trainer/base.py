@@ -529,6 +529,15 @@ class BaseTrainer:
                     self.dataloader, depth=t.prefetch_depth
                 )
             data_iter = iter(self._prefetcher or self.dataloader)
+            # dispatch-depth bound, independent of log cadence: with a large
+            # log_steps the host could otherwise run arbitrarily far ahead,
+            # keeping every shipped batch + queued execution live in HBM
+            # (and on the axon TPU hung work can't be timeout-killed). A
+            # scalar fetch on the oldest in-flight loss is the only sync
+            # guaranteed through the relay.
+            from collections import deque
+
+            inflight: deque = deque()
             try:
                 while ctl.global_step < self.train_steps and not ctl.should_stop:
                     batch_np = next(data_iter)
@@ -539,11 +548,14 @@ class BaseTrainer:
                     batch = self._ship_batch(batch_np)
                     self.train_state, metrics = self.train_step(self.train_state, batch)
                     ctl.global_step += 1
+                    if "loss" in metrics:
+                        inflight.append(metrics["loss"])
+                        if len(inflight) > 4:
+                            np.asarray(jax.device_get(inflight.popleft()))
                     # the step dispatches asynchronously; materializing a
                     # metric would block the host on device completion and
                     # serialize batch assembly with compute. Fetch only on
-                    # log steps (which also bounds dispatch-ahead depth);
-                    # in between, callbacks receive device futures.
+                    # log steps; in between, callbacks receive device futures.
                     ctl.synced = (
                         ctl.global_step % t.log_steps == 0
                         or ctl.global_step >= self.train_steps

@@ -75,15 +75,19 @@ class WanCollator:
         x0 = np.zeros((b,) + self.latent_shape, np.float32)
         text = np.zeros((b, self.text_len, self.text_dim), np.float32)
         mask = np.zeros((b, self.text_len), np.int32)
+        pooled_dim = int(getattr(self.cfg, "pooled_projection_dim", 0) or 0)
+        pooled = np.zeros((b, pooled_dim), np.float32) if pooled_dim else None
         for i, s in enumerate(samples[:b]):
             x0[i] = np.asarray(s["latents"], np.float32).reshape(self.latent_shape)
             ts = np.asarray(s["text_states"], np.float32).reshape(-1, self.text_dim)
             n = min(len(ts), self.text_len)
             text[i, :n] = ts[:n]
             mask[i, :n] = 1
+            if pooled is not None and "pooled_text" in s:
+                pooled[i] = np.asarray(s["pooled_text"], np.float32)
         t = self.scheduler.sample_timesteps(self._rng, b)
         noise = self._rng.standard_normal(x0.shape).astype(np.float32)
-        return {
+        out = {
             "latents": FlowMatchScheduler.add_noise(x0, noise, t),
             "timestep": (t * 1000.0).astype(np.float32),
             "text_states": text,
@@ -92,6 +96,9 @@ class WanCollator:
             "text_mask": mask,
             "target": FlowMatchScheduler.velocity_target(x0, noise),
         }
+        if pooled is not None:  # flux: pooled-CLIP conditioning stream
+            out["pooled_text"] = pooled
+        return out
 
     def state_dict(self):
         return {"rng_state": self._rng.bit_generator.state}
@@ -110,7 +117,7 @@ class DiTTrainer(BaseTrainer):
         from veomni_tpu.models.auto import FoundationModel, ModelFamily
 
         req_mt = mt or self.args.model.model_type
-        if req_mt in ("wan_t2v", "qwen_image"):
+        if req_mt in ("wan_t2v", "qwen_image", "flux"):
             from veomni_tpu.models.auto import MODEL_REGISTRY
 
             # collator geometry knobs, not model-config fields
@@ -136,7 +143,7 @@ class DiTTrainer(BaseTrainer):
 
     @property
     def _is_wan(self) -> bool:
-        return self.model.config.model_type in ("wan_t2v", "qwen_image")
+        return self.model.config.model_type in ("wan_t2v", "qwen_image", "flux")
 
     @staticmethod
     def _save_native(params, cfg, out_dir):
@@ -189,13 +196,16 @@ class DiTTrainer(BaseTrainer):
         ps = self.parallel_state
         if self._is_wan:
             lat = (None,) * len(self._latent_shape)
-            return {
+            m = {
                 "latents": P(None, ps.dp_axes, *lat),
                 "timestep": P(None, ps.dp_axes),
                 "text_states": P(None, ps.dp_axes, None, None),
                 "text_mask": P(None, ps.dp_axes, None),
                 "target": P(None, ps.dp_axes, *lat),
             }
+            if getattr(self.model.config, "pooled_projection_dim", 0):
+                m["pooled_text"] = P(None, ps.dp_axes, None)
+            return m
         return {
             "latents": P(None, ps.dp_axes, None, None, None),
             "noise": P(None, ps.dp_axes, None, None, None),

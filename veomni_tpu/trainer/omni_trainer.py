@@ -58,12 +58,17 @@ class OmniCollator:
                 (b, cfg.max_audio, cfg.audio.max_frames, cfg.audio.n_mels), np.float32
             )
             out["audio_mask"] = np.zeros((b, cfg.max_audio), bool)
+        if cfg.image_gen is not None:
+            r = cfg.image_gen.movq.resolution
+            out["gen_pixels"] = np.zeros((b, cfg.max_gen_images, r, r, 3), np.float32)
+            out["gen_image_mask"] = np.zeros((b, cfg.max_gen_images), bool)
 
         for i, sample in enumerate(samples[:b]):
             ids: list = []
             labels: list = []
             images = sample.get("images", [])[: cfg.max_images]
             audios = sample.get("audio", [])[: cfg.max_audio]
+            gen_images = sample.get("gen_images", [])[: cfg.max_gen_images]
             if cfg.vision is not None:
                 for k, im in enumerate(images):
                     t_img = cfg.vision.tokens_per_image
@@ -86,6 +91,16 @@ class OmniCollator:
             text = list(sample["input_ids"])
             ids += text
             labels += list(sample.get("labels", text))
+            if cfg.image_gen is not None:
+                # generated images follow the text (the LM predicts their VQ
+                # codes next-token; codebook labels built inside the loss)
+                t_gen = cfg.image_gen.tokens_per_image
+                for k, gi in enumerate(gen_images):
+                    ids += [cfg.image_gen_token_id] * t_gen
+                    labels += [IGNORE_INDEX] * t_gen
+                    arr = load_image(gi, cfg.image_gen.movq.resolution)
+                    out["gen_pixels"][i, k] = arr * 2.0 - 1.0  # [0,1] -> [-1,1]
+                    out["gen_image_mask"][i, k] = True
             ids, labels = ids[:s], labels[:s]
             shifted = np.concatenate(
                 [np.asarray(labels[1:], np.int32), [IGNORE_INDEX]]
@@ -110,6 +125,16 @@ class OmniTrainer(BaseTrainer):
         text.setdefault("dtype", self.args.train.compute_dtype)
         text["remat"] = self.args.train.enable_gradient_checkpointing
         cfg = OmniConfig(text=text, **overrides)
+
+        def omni_plan(_cfg):
+            from veomni_tpu.parallel.parallel_plan import ParallelPlan
+
+            # replicate the MoVQ tokenizer: GSPMD-partitioned conv kernels
+            # gain nothing (the tokenizer is small and usually frozen) and
+            # the partitioned conv programs have deadlocked XLA:CPU's
+            # collective rendezvous in the 4-device test harness
+            return ParallelPlan(rules={r"(^|\.)image_gen\.movq\.": ()})
+
         family = ModelFamily(
             model_type="seed_omni",
             config_cls=OmniConfig,
@@ -119,6 +144,7 @@ class OmniTrainer(BaseTrainer):
             forward_logits=None,
             hf_to_params=None,
             save_hf_checkpoint=self._save_native,
+            parallel_plan_fn=omni_plan,
         )
         self.model = FoundationModel(config=cfg, family=family)
         self.tokenizer = None
@@ -239,4 +265,7 @@ class OmniTrainer(BaseTrainer):
         if cfg.audio is not None:
             base["audio_features"] = P(None, ps.dp_axes, None, None, None)
             base["audio_mask"] = P(None, ps.dp_axes, None)
+        if cfg.image_gen is not None:
+            base["gen_pixels"] = P(None, ps.dp_axes, None, None, None, None)
+            base["gen_image_mask"] = P(None, ps.dp_axes, None)
         return base

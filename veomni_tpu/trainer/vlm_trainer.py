@@ -6,9 +6,9 @@ collator is shape-uniform (see data/multimodal.py), so no dummy-forward or
 per-group LR machinery is needed; vision freezing happens functionally via
 ``stop_gradient`` (VLMConfig.freeze_vision).
 
-Real-architecture families (qwen2_5_vl, qwen3_vl, qwen3_vl_moe) use the
-packed-patch collators + per-family index plans; the generic ``qwen2_vl``
-composite keeps the fixed-slot VLMCollator.
+Real-architecture families (qwen2_vl, qwen2_5_vl, qwen3_vl, qwen3_vl_moe)
+use the packed-patch collators + per-family index plans; the generic
+``slot_vlm`` composite keeps the fixed-slot VLMCollator.
 """
 
 from __future__ import annotations
@@ -23,6 +23,7 @@ from veomni_tpu.trainer.base import BaseTrainer
 
 # model_type -> (transform/collator key, collator class name)
 _REAL_VL = {
+    "qwen2_vl": "qwen2_vl",
     "qwen2_5_vl": "qwen2_5_vl",
     "qwen3_vl": "qwen3_vl",
     "qwen3_vl_moe": "qwen3_vl",  # same tower + data contract as qwen3_vl
@@ -91,10 +92,11 @@ class VLMTrainer(BaseTrainer):
                     "patch budget variant"
                 )
             from veomni_tpu.data.multimodal import (
-                Qwen3VLCollator, Qwen25VLCollator,
+                Qwen2VLCollator, Qwen3VLCollator, Qwen25VLCollator,
             )
 
-            cls = Qwen25VLCollator if key == "qwen2_5_vl" else Qwen3VLCollator
+            cls = {"qwen2_vl": Qwen2VLCollator,
+                   "qwen2_5_vl": Qwen25VLCollator}.get(key, Qwen3VLCollator)
             collator = cls(
                 seq_len=d.max_seq_len,
                 micro_batch_size=local_mb,
@@ -132,6 +134,15 @@ class VLMTrainer(BaseTrainer):
             "labels": P(None, ps.dp_axes, ps.sp_axes),
             "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
         }
+        if key == "qwen2_vl":
+            return {
+                **text,
+                "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
+                "pixel_values": P(None, None, None),
+                "vis_pos_hw": P(None, None, None),
+                "vis_seg": P(None, None),
+                "vis_merged_mask": P(None, None),
+            }
         if key == "qwen2_5_vl":
             return {
                 **text,

@@ -64,12 +64,14 @@ def capture_router_stats(model, params, batch) -> Dict[str, np.ndarray]:
             tree = compute[name]
             for i in range(count):
                 lp = jax.tree.map(lambda t: t[i], tree)
+                # non-carry call: 2-tuple always (DSA layers compute their
+                # own selection; "shared" reuse is a train-path optimization)
                 hidden, _ = transformer._decoder_layer(
                     hidden, lp, cfg=cfg, cos=cos, sin=sin,
                     segment_ids=batch.get("segment_ids"),
                     window=cfg.window_for_layer(offset + i) or None,
                     is_moe_segment=is_moe,
-                )
+                )[:2]
             offset += count
     loads = []
     for topk in caps:
