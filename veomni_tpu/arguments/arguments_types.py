@@ -124,8 +124,11 @@ class TrainingArguments:
     # worker thread (reference BackgroundPrefetcher); 0 = synchronous
     prefetch_depth: int = 2
     # observability. log_steps is also the host<->device sync cadence: the
-    # loop only fetches metrics (blocking on the device) every log_steps
-    log_steps: int = 1
+    # loop only fetches metrics (blocking on the device) every log_steps —
+    # default 10 so the async loop's lazy sync is ON out of the box (a
+    # per-step device fetch serializes batch assembly with compute; the
+    # dispatch-depth bound in the trainer caps run-ahead independently)
+    log_steps: int = 10
     enable_profiling: bool = False
     profile_start_step: int = 3
     profile_end_step: int = 5

@@ -47,18 +47,13 @@ _SHARD_EXTS = (".jsonl", ".parquet", ".tar")
 class _JsonlShard:
     def __init__(self, path: str):
         self.path = path
-        offsets = [0]
-        with open(path, "rb") as f:
-            for line in f:
-                offsets.append(offsets[-1] + len(line))
-        # drop trailing blank lines from the index
         self._offsets = []
-        with open(path, "rb") as f:
-            data_ends = offsets
-            for i in range(len(data_ends) - 1):
-                f.seek(data_ends[i])
-                if f.read(data_ends[i + 1] - data_ends[i]).strip():
-                    self._offsets.append(data_ends[i])
+        off = 0
+        with open(path, "rb") as f:  # single pass: index + blank-line filter
+            for line in f:
+                if line.strip():
+                    self._offsets.append(off)
+                off += len(line)
 
     def __len__(self) -> int:
         return len(self._offsets)
@@ -189,17 +184,21 @@ class StreamingShardDataset:
         # records stride over ranks instead when shards can't
         self._stride_records = len(shards) < self.dp_size
         self._lens: Dict[str, int] = {}
-        self._open: Tuple[str, Any] = ("", None)  # 1-shard LRU
+        # readers (with their record indexes) cache per path — re-opening a
+        # shard each epoch / on mixing-driven shard switches must not rebuild
+        # the index (readers hold offsets/member tables, not file handles)
+        self._readers: Dict[str, Any] = {}
         self._epoch = 0
         self._shard_pos = 0
         self._rec_pos = 0
 
     # -- index helpers ------------------------------------------------------
     def _reader(self, shard: str):
-        if self._open[0] != shard:
-            self._open = (shard, _open_shard(shard))
-            self._lens[shard] = len(self._open[1])
-        return self._open[1]
+        r = self._readers.get(shard)
+        if r is None:
+            r = self._readers[shard] = _open_shard(shard)
+            self._lens[shard] = len(r)
+        return r
 
     def _shard_len(self, shard: str) -> int:
         if shard not in self._lens:

@@ -201,6 +201,23 @@ def _register_vlm_families():
         ),
     )
 
+    # janus: unified understanding (SigLIP ViT) + generation (llamagen VQ)
+    from veomni_tpu.models import janus as janus_mod
+
+    MODEL_REGISTRY.register(
+        "janus",
+        ModelFamily(
+            model_type="janus",
+            config_cls=janus_mod.JanusConfig,
+            init_params=janus_mod.init_params,
+            abstract_params=janus_mod.abstract_params,
+            loss_fn=janus_mod.loss_fn,
+            forward_logits=None,
+            hf_to_params=janus_mod.hf_to_params,
+            save_hf_checkpoint=janus_mod.save_hf_checkpoint,
+        ),
+    )
+
     # qwen2_5_omni thinker: real audio tower + qwen2_5_vl vision/LM
     from veomni_tpu.models import qwen2_5_omni as q25o
 
@@ -239,12 +256,18 @@ def _register_vlm_families():
 
 
 def _register_diffusion_families():
-    from veomni_tpu.models import flux as flux_mod, qwen_image as qi_mod, wan as wan_mod
+    from veomni_tpu.models import (
+        flux as flux_mod,
+        ltx2 as ltx2_mod,
+        qwen_image as qi_mod,
+        wan as wan_mod,
+    )
 
     for mt, mod, cfg_cls in (
         ("wan_t2v", wan_mod, wan_mod.WanConfig),
         ("qwen_image", qi_mod, qi_mod.QwenImageConfig),
         ("flux", flux_mod, flux_mod.FluxConfig),
+        ("ltx2", ltx2_mod, ltx2_mod.LTX2Config),
     ):
         MODEL_REGISTRY.register(
             mt,
@@ -416,6 +439,10 @@ def build_foundation_model(
             from veomni_tpu.models.qwen3_vl import config_from_hf as q3vl_from_hf
 
             config = q3vl_from_hf(hf_dict, **config_overrides)
+        elif hf_dict.get("model_type") == "janus":
+            from veomni_tpu.models.janus import config_from_hf as janus_from_hf
+
+            config = janus_from_hf(hf_dict, **config_overrides)
         elif hf_dict.get("model_type") in ("qwen2_5_omni", "qwen2_5_omni_thinker"):
             from veomni_tpu.models.qwen2_5_omni import config_from_hf as omni_from_hf
 
@@ -439,6 +466,11 @@ def build_foundation_model(
             from veomni_tpu.models.flux import config_from_hf as flux_from_hf
 
             config = flux_from_hf(hf_dict, **config_overrides)
+        elif (hf_dict.get("model_type") == "ltx2"
+              or hf_dict.get("_class_name") == "LTXVideoTransformerModel"):
+            from veomni_tpu.models.ltx2 import config_from_hf as ltx2_from_hf
+
+            config = ltx2_from_hf(hf_dict, **config_overrides)
         else:
             config = TransformerConfig.from_hf_config(hf_dict, **config_overrides)
     if config.model_type not in MODEL_REGISTRY:

@@ -1,0 +1,834 @@
+"""Janus(-Pro): unified understanding + generation composite.
+
+Reference: ``veomni/models/transformers/janus/modeling_janus.py:1183-1320``
+(Janus = timm/SigLIP ViT understanding tower + MlpProjector aligner + llama
+LM + llamagen VQ-GAN generation tokenizer + gen_embed/gen_aligner/gen_head).
+The two image pathways are decoupled: understanding images enter as ViT
+features at input-image placeholder tokens; generated images are VQ-encoded
+into codebook ids whose *separate* ``gen_embed`` table (not the VQ codebook)
+feeds the LM stream, and a generation head predicts the next code.
+
+TPU-first: fixed image slots (``pixel_values [B, max_images, H, W, C]`` /
+``gen_pixels [B, max_gen, H, W, C]``) with ordered-slot merges, the shared
+``build_gen_labels``/``gen_head_ce`` machinery from the omni composite, and
+the MoVQGAN functional conv primitives for the (plain-GroupNorm) llamagen
+VQ — the whole loss jits as one program with static shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu import ops
+from veomni_tpu.models import transformer
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.models.movqgan import (
+    _attn_block,
+    _attn_params,
+    _conv,
+    _conv_init,
+    _group_norm,
+    _norm_params,
+    _res_block,
+    _res_params,
+    _swish,
+)
+from veomni_tpu.models.omni import build_gen_labels, gen_head_ce
+from veomni_tpu.models.vlm import merge_image_features
+
+Params = Dict[str, Any]
+
+
+@dataclass
+class JanusVisionConfig:
+    """timm/SigLIP ViT surface (reference ``JanusVisionConfig`` defaults =
+    SigLIP-L/16-384 with select_layer truncation already applied)."""
+
+    width: int = 1024
+    layers: int = 24
+    heads: int = 16
+    patch_size: int = 16
+    image_size: int = 384
+    mlp_ratio: float = 4.0
+    class_token: bool = False
+    qkv_bias: bool = True
+    init_values: float = 0.0      # 0 = no LayerScale
+    layer_norm_eps: float = 1e-6
+    initializer_range: float = 0.02
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def tokens_per_image(self) -> int:
+        return self.grid ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.patch_size ** 2
+
+    @property
+    def mlp_dim(self) -> int:
+        return int(self.width * self.mlp_ratio)
+
+
+@dataclass
+class JanusGenVisionConfig:
+    """llamagen VQ-16 surface (reference ``JanusGenVisionConfig``)."""
+
+    codebook_size: int = 16384
+    codebook_embed_dim: int = 8
+    codebook_l2_norm: bool = True
+    commit_loss_beta: float = 0.25
+    ch: int = 128
+    encoder_ch_mult: Tuple[int, ...] = (1, 1, 2, 2, 4)
+    decoder_ch_mult: Tuple[int, ...] = (1, 1, 2, 2, 4)
+    num_res_blocks: int = 2
+    z_channels: int = 256
+    image_size: int = 384
+    num_groups: int = 32
+    initializer_range: float = 0.02
+
+    def __post_init__(self):
+        self.encoder_ch_mult = tuple(self.encoder_ch_mult)
+        self.decoder_ch_mult = tuple(self.decoder_ch_mult)
+
+    @property
+    def token_grid(self) -> int:
+        return self.image_size // (2 ** (len(self.encoder_ch_mult) - 1))
+
+    @property
+    def tokens_per_image(self) -> int:
+        return self.token_grid ** 2
+
+
+@dataclass
+class JanusConfig:
+    text: TransformerConfig = field(default_factory=TransformerConfig)
+    vision: JanusVisionConfig = field(default_factory=JanusVisionConfig)
+    gen_vision: JanusGenVisionConfig = field(default_factory=JanusGenVisionConfig)
+    aligner_depth: int = 2
+    gen_aligner_depth: int = 2
+    gen_head_embed: int = 2048
+    image_token_id: int = 100581
+    image_gen_token_id: int = 100594
+    gen_loss_weight: float = 1.0
+    freeze_vision: bool = False
+    freeze_gen_vision: bool = True   # VQ tokenizer stays frozen (reference)
+    max_images: int = 1
+    max_gen_images: int = 1
+    model_type: str = "janus"
+
+    def __post_init__(self):
+        if isinstance(self.text, dict):
+            self.text = TransformerConfig(**self.text)
+        if isinstance(self.vision, dict):
+            self.vision = JanusVisionConfig(**self.vision)
+        if isinstance(self.gen_vision, dict):
+            self.gen_vision = JanusGenVisionConfig(**self.gen_vision)
+
+    def __getattr__(self, name):  # trainer surface
+        return getattr(object.__getattribute__(self, "text"), name)
+
+
+# ---------------------------------------------------------------------------
+# understanding tower (timm ViT)
+# ---------------------------------------------------------------------------
+
+def init_vision_params(rng: jax.Array, cfg: JanusVisionConfig, dtype=jnp.float32):
+    s = cfg.initializer_range
+    d, L, m = cfg.width, cfg.layers, cfg.mlp_dim
+    keys = iter(jax.random.split(rng, 8))
+
+    def init(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(dtype)
+
+    n_tok = cfg.tokens_per_image + (1 if cfg.class_token else 0)
+    p: Params = {
+        "patch_embed": init((cfg.patch_dim, d)),
+        "patch_embed_b": jnp.zeros((d,), dtype),
+        "pos_embed": init((n_tok, d)),
+        "blocks": {
+            "norm1_w": jnp.ones((L, d), dtype), "norm1_b": jnp.zeros((L, d), dtype),
+            "qkv_w": init((L, d, 3 * d)),
+            "proj_w": init((L, d, d)), "proj_b": jnp.zeros((L, d), dtype),
+            "norm2_w": jnp.ones((L, d), dtype), "norm2_b": jnp.zeros((L, d), dtype),
+            "fc1_w": init((L, d, m)), "fc1_b": jnp.zeros((L, m), dtype),
+            "fc2_w": init((L, m, d)), "fc2_b": jnp.zeros((L, d), dtype),
+        },
+        "norm_w": jnp.ones((d,), dtype),
+        "norm_b": jnp.zeros((d,), dtype),
+    }
+    if cfg.qkv_bias:
+        p["blocks"]["qkv_b"] = jnp.zeros((L, 3 * d), dtype)
+    if cfg.init_values:
+        p["blocks"]["ls1"] = jnp.full((L, d), cfg.init_values, dtype)
+        p["blocks"]["ls2"] = jnp.full((L, d), cfg.init_values, dtype)
+    if cfg.class_token:
+        p["cls_token"] = jnp.zeros((1, d), dtype)
+    return p
+
+
+def _ln(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    xf = (xf - xf.mean(-1, keepdims=True)) * jax.lax.rsqrt(
+        xf.var(-1, keepdims=True) + eps
+    )
+    return (xf * w + b).astype(x.dtype)
+
+
+def _janus_vit_block(x, lp, cfg: JanusVisionConfig):
+    n, t, d = x.shape
+    hd = d // cfg.heads
+    y = _ln(x, lp["norm1_w"], lp["norm1_b"], cfg.layer_norm_eps)
+    qkv = jnp.dot(y, lp["qkv_w"])
+    if "qkv_b" in lp:
+        qkv = qkv + lp["qkv_b"]
+    q, k, v = jnp.split(qkv.reshape(n, t, 3 * cfg.heads, hd), 3, axis=2)
+    attn = ops.attention(q, k, v, causal=False).reshape(n, t, d)
+    attn = jnp.dot(attn, lp["proj_w"]) + lp["proj_b"]
+    if "ls1" in lp:
+        attn = attn * lp["ls1"]
+    x = x + attn
+    y = _ln(x, lp["norm2_w"], lp["norm2_b"], cfg.layer_norm_eps)
+    y = jax.nn.gelu(jnp.dot(y, lp["fc1_w"]) + lp["fc1_b"])
+    y = jnp.dot(y, lp["fc2_w"]) + lp["fc2_b"]
+    if "ls2" in lp:
+        y = y * lp["ls2"]
+    return x + y
+
+
+def vision_forward(params, cfg: JanusVisionConfig, pixels: jax.Array) -> jax.Array:
+    """pixels [N, H, W, 3] -> patch features [N, tokens_per_image, width]
+    (cls token dropped — reference select_feature='patch'). Runs at sp=1
+    like the other towers."""
+    from veomni_tpu.parallel.parallel_state import (
+        get_parallel_state_or_none, use_parallel_state,
+    )
+
+    ps = get_parallel_state_or_none()
+    if ps is not None and ps.sp_enabled:
+        with use_parallel_state(ps.without_sp()):
+            return vision_forward(params, cfg, pixels)
+    n = pixels.shape[0]
+    p_sz, g = cfg.patch_size, cfg.grid
+    x = pixels.reshape(n, g, p_sz, g, p_sz, 3).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(n, g * g, cfg.patch_dim).astype(params["patch_embed"].dtype)
+    x = jnp.dot(x, params["patch_embed"]) + params["patch_embed_b"]
+    if "cls_token" in params:
+        x = jnp.concatenate(
+            [jnp.broadcast_to(params["cls_token"], (n, 1, x.shape[-1])), x], axis=1
+        )
+    x = x + params["pos_embed"]
+    body = partial(_janus_vit_block, cfg=cfg)
+    x, _ = jax.lax.scan(
+        lambda c, lp: (jax.checkpoint(body)(c, lp), None), x, params["blocks"]
+    )
+    x = _ln(x, params["norm_w"], params["norm_b"], cfg.layer_norm_eps)
+    return x[:, 1:] if "cls_token" in params else x
+
+
+# ---------------------------------------------------------------------------
+# generation tokenizer (llamagen VQ: plain-GroupNorm VQ-GAN, l2 codebook)
+# ---------------------------------------------------------------------------
+
+def init_gen_vision_params(rng: jax.Array, cfg: JanusGenVisionConfig) -> Params:
+    s = cfg.initializer_range
+    keys = iter(jax.random.split(rng, 512))
+    levels = len(cfg.encoder_ch_mult)
+
+    enc: Params = {
+        "conv_in_w": _conv_init(next(keys), 3, 3, 3, cfg.ch, s),
+        "conv_in_b": jnp.zeros((cfg.ch,), jnp.float32),
+        "down": [],
+    }
+    in_mult = (1,) + cfg.encoder_ch_mult
+    for i in range(levels):
+        cin = cfg.ch * in_mult[i]
+        cout = cfg.ch * cfg.encoder_ch_mult[i]
+        level: Params = {"res": [], "attn": []}
+        for _ in range(cfg.num_res_blocks):
+            level["res"].append(_res_params(keys, cin, cout, s))
+            cin = cout
+            if i == levels - 1:  # llamagen: attention only at the deepest level
+                level["attn"].append(_attn_params(keys, cin, s))
+        if i != levels - 1:
+            level["down_w"] = _conv_init(next(keys), 3, 3, cin, cin, s)
+            level["down_b"] = jnp.zeros((cin,), jnp.float32)
+        enc["down"].append(level)
+    cin = cfg.ch * cfg.encoder_ch_mult[-1]
+    enc["mid_res1"] = _res_params(keys, cin, cin, s)
+    enc["mid_attn"] = _attn_params(keys, cin, s)
+    enc["mid_res2"] = _res_params(keys, cin, cin, s)
+    enc["norm_out"] = _norm_params(cin, False)
+    enc["conv_out_w"] = _conv_init(next(keys), 3, 3, cin, cfg.z_channels, s)
+    enc["conv_out_b"] = jnp.zeros((cfg.z_channels,), jnp.float32)
+
+    dec: Params = {
+        "conv_in_w": _conv_init(next(keys), 3, 3, cfg.z_channels, cin, s),
+        "conv_in_b": jnp.zeros((cin,), jnp.float32),
+        "mid_res1": _res_params(keys, cin, cin, s),
+        "mid_attn": _attn_params(keys, cin, s),
+        "mid_res2": _res_params(keys, cin, cin, s),
+        "up": [],
+    }
+    for j, i in enumerate(reversed(range(levels))):
+        cout = cfg.ch * cfg.decoder_ch_mult[i]
+        level = {"res": [], "attn": []}
+        for _ in range(cfg.num_res_blocks + 1):
+            level["res"].append(_res_params(keys, cin, cout, s))
+            cin = cout
+            if i == levels - 1:
+                level["attn"].append(_attn_params(keys, cin, s))
+        if i != 0:
+            level["up_w"] = _conv_init(next(keys), 3, 3, cin, cin, s)
+            level["up_b"] = jnp.zeros((cin,), jnp.float32)
+        dec["up"].append(level)
+    dec["norm_out"] = _norm_params(cin, False)
+    dec["conv_out_w"] = _conv_init(next(keys), 3, 3, cin, 3, s)
+    dec["conv_out_b"] = jnp.zeros((3,), jnp.float32)
+
+    e = cfg.codebook_embed_dim
+    return {
+        "encoder": enc,
+        "decoder": dec,
+        "codebook": jax.random.uniform(
+            next(keys), (cfg.codebook_size, e), jnp.float32,
+            -1.0 / cfg.codebook_size, 1.0 / cfg.codebook_size,
+        ),
+        "quant_conv_w": _conv_init(next(keys), 1, 1, cfg.z_channels, e, s),
+        "quant_conv_b": jnp.zeros((e,), jnp.float32),
+        "post_quant_conv_w": _conv_init(next(keys), 1, 1, e, cfg.z_channels, s),
+        "post_quant_conv_b": jnp.zeros((cfg.z_channels,), jnp.float32),
+    }
+
+
+def _l2norm(x, eps=1e-12):
+    return x * jax.lax.rsqrt(jnp.maximum((x * x).sum(-1, keepdims=True), eps))
+
+
+def gen_vision_encode(params: Params, cfg: JanusGenVisionConfig, pixels: jax.Array):
+    """pixels [N,H,W,3] -> (z_q [N,h,w,e] straight-through, indices [N,h,w],
+    per-image vq loss [N]). llamagen quantizer: l2-normalized z AND codebook."""
+    g = cfg.num_groups
+    p = params["encoder"]
+    h = _conv(pixels, p["conv_in_w"], p["conv_in_b"])
+    for level in p["down"]:
+        attn_iter = iter(level["attn"])
+        for rp in level["res"]:
+            h = _res_block(h, rp, g)
+            if level["attn"]:
+                h = _attn_block(h, next(attn_iter), g)
+        if "down_w" in level:
+            h = _conv(
+                jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0))),
+                level["down_w"], level["down_b"], stride=2, padding="VALID",
+            )
+    h = _res_block(h, p["mid_res1"], g)
+    h = _attn_block(h, p["mid_attn"], g)
+    h = _res_block(h, p["mid_res2"], g)
+    h = _swish(_group_norm(h, p["norm_out"]["gn_w"], p["norm_out"]["gn_b"], g))
+    z = _conv(h, p["conv_out_w"], p["conv_out_b"])
+    z = _conv(z, params["quant_conv_w"], params["quant_conv_b"])
+
+    zf = z.astype(jnp.float32)
+    cb = params["codebook"].astype(jnp.float32)
+    if cfg.codebook_l2_norm:
+        zf = _l2norm(zf)
+        cb = _l2norm(cb)
+    d = (
+        (zf * zf).sum(-1, keepdims=True)
+        - 2.0 * jnp.einsum("nhwe,ke->nhwk", zf, cb)
+        + (cb * cb).sum(-1)[None, None, None, :]
+    )
+    idx = jnp.argmin(d, axis=-1)
+    e = cb[idx]
+    vq = ((jax.lax.stop_gradient(zf) - e) ** 2).mean((1, 2, 3)) + \
+        cfg.commit_loss_beta * ((zf - jax.lax.stop_gradient(e)) ** 2).mean((1, 2, 3))
+    z_q = zf + jax.lax.stop_gradient(e - zf)
+    return z_q.astype(z.dtype), idx, vq
+
+
+def gen_vision_decode(params: Params, cfg: JanusGenVisionConfig, z_q: jax.Array):
+    g = cfg.num_groups
+    z = _conv(z_q, params["post_quant_conv_w"], params["post_quant_conv_b"])
+    p = params["decoder"]
+    h = _conv(z, p["conv_in_w"], p["conv_in_b"])
+    h = _res_block(h, p["mid_res1"], g)
+    h = _attn_block(h, p["mid_attn"], g)
+    h = _res_block(h, p["mid_res2"], g)
+    for level in p["up"]:
+        attn_iter = iter(level["attn"])
+        for rp in level["res"]:
+            h = _res_block(h, rp, g)
+            if level["attn"]:
+                h = _attn_block(h, next(attn_iter), g)
+        if "up_w" in level:
+            n, hh, ww, c = h.shape
+            h = jax.image.resize(h, (n, hh * 2, ww * 2, c), "nearest")
+            h = _conv(h, level["up_w"], level["up_b"])
+    h = _swish(_group_norm(h, p["norm_out"]["gn_w"], p["norm_out"]["gn_b"], g))
+    return _conv(h, p["conv_out_w"], p["conv_out_b"])
+
+
+def decode_code(params: Params, cfg: JanusGenVisionConfig, indices: jax.Array):
+    """indices [N, T] or [N, h, w] -> pixels (codebook lookup is l2-normed
+    like the reference get_codebook_entry)."""
+    if indices.ndim == 2:
+        grid = cfg.token_grid
+        indices = indices.reshape(indices.shape[0], grid, grid)
+    cb = params["codebook"].astype(jnp.float32)
+    if cfg.codebook_l2_norm:
+        cb = _l2norm(cb)
+    return gen_vision_decode(params, cfg, cb[indices])
+
+
+# ---------------------------------------------------------------------------
+# composite params / loss
+# ---------------------------------------------------------------------------
+
+def _mlp_proj_params(keys, in_dim, n_embed, depth, s, dtype):
+    def init(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(dtype)
+
+    layers = [{"w": init((in_dim, n_embed)), "b": jnp.zeros((n_embed,), dtype)}]
+    for _ in range(1, depth):
+        layers.append({"w": init((n_embed, n_embed)), "b": jnp.zeros((n_embed,), dtype)})
+    return layers
+
+
+def _mlp_proj(x, layers):
+    x = jnp.dot(x, layers[0]["w"].astype(x.dtype)) + layers[0]["b"].astype(x.dtype)
+    for lp in layers[1:]:
+        x = jax.nn.gelu(x)
+        x = jnp.dot(x, lp["w"].astype(x.dtype)) + lp["b"].astype(x.dtype)
+    return x
+
+
+def init_params(rng: jax.Array, cfg: JanusConfig) -> Params:
+    r1, r2, r3, r4, r5, r6, r7 = jax.random.split(rng, 7)
+    pd = cfg.text.param_dtype
+    s = cfg.text.initializer_range
+    h = cfg.text.hidden_size
+    e = cfg.gen_vision.codebook_embed_dim
+    keys_a = iter(jax.random.split(r4, 8))
+    keys_g = iter(jax.random.split(r5, 8))
+
+    def init(key, shape):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(pd)
+
+    return {
+        "language_model": transformer.init_params(r1, cfg.text),
+        "vision_tower": init_vision_params(r2, cfg.vision, pd),
+        "gen_vision": init_gen_vision_params(r3, cfg.gen_vision),
+        "aligner": _mlp_proj_params(keys_a, cfg.vision.width, h,
+                                    cfg.aligner_depth, s, pd),
+        "gen_aligner": _mlp_proj_params(keys_g, e, h, cfg.gen_aligner_depth, s, pd),
+        "gen_embed": init(r6, (cfg.gen_vision.codebook_size, e)),
+        "gen_head": {
+            "fc1": init(jax.random.split(r7)[0], (h, cfg.gen_head_embed)),
+            "fc1_b": jnp.zeros((cfg.gen_head_embed,), pd),
+            "fc2": init(jax.random.split(r7)[1],
+                        (cfg.gen_head_embed, cfg.gen_vision.codebook_size)),
+            "fc2_b": jnp.zeros((cfg.gen_vision.codebook_size,), pd),
+        },
+    }
+
+
+def abstract_params(cfg: JanusConfig):
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+def loss_fn(params, cfg: JanusConfig, batch) -> Tuple[jax.Array, Dict]:
+    """batch: input_ids/labels/position_ids/segment_ids [B,S];
+    pixel_values [B, max_images, H, W, 3] + image_mask [B, max_images]
+    (understanding); gen_pixels [B, max_gen, h, w, 3] + gen_image_mask
+    (generation targets, [-1, 1])."""
+    tcfg = cfg.text
+    lm = params["language_model"]
+    input_ids = batch["input_ids"]
+    embeds = lm["embed_tokens"].astype(tcfg.dtype)[input_ids]
+
+    if "pixel_values" in batch:
+        vp = params["vision_tower"]
+        if cfg.freeze_vision:
+            vp = jax.lax.stop_gradient(vp)
+        px = batch["pixel_values"]
+        bi, mi = px.shape[:2]
+        feats = vision_forward(
+            jax.tree.map(lambda t: t.astype(tcfg.dtype), vp), cfg.vision,
+            px.reshape(bi * mi, *px.shape[2:]),
+        )
+        feats = _mlp_proj(feats, params["aligner"])
+        feats = feats.reshape(bi, mi, *feats.shape[1:])
+        embeds = merge_image_features(
+            embeds, input_ids, feats, batch["image_mask"], cfg.image_token_id
+        )
+
+    gen_labels = None
+    if "gen_pixels" in batch:
+        gvp = params["gen_vision"]
+        if cfg.freeze_gen_vision:
+            gvp = jax.lax.stop_gradient(gvp)
+        gp = batch["gen_pixels"]
+        bi, mg = gp.shape[:2]
+        t_gen = cfg.gen_vision.tokens_per_image
+        _, idx, _ = gen_vision_encode(gvp, cfg.gen_vision,
+                                      gp.reshape(bi * mg, *gp.shape[2:]))
+        idx = idx.reshape(bi, mg, t_gen)
+        # the LM-side code embedding is its own table (NOT the VQ codebook)
+        cb_embeds = params["gen_embed"].astype(tcfg.dtype)[idx]
+        feats = _mlp_proj(cb_embeds, params["gen_aligner"])
+        gen_mask = batch["gen_image_mask"]
+        embeds = merge_image_features(
+            embeds, input_ids, feats, gen_mask, cfg.image_gen_token_id
+        )
+        gen_labels = build_gen_labels(
+            input_ids, idx.reshape(bi, mg * t_gen), gen_mask,
+            cfg.image_gen_token_id, t_gen, batch.get("segment_ids"),
+        )
+
+    hidden, moe_aux, moe_dropped = transformer.forward_hidden(
+        lm, tcfg, input_ids, batch["position_ids"],
+        batch.get("segment_ids"), inputs_embeds=embeds,
+    )
+    total, metrics = transformer.head_loss(
+        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+    )
+    if gen_labels is not None:
+        gh = jax.tree.map(lambda p: p.astype(tcfg.dtype), params["gen_head"])
+        gen_sum, gen_n = gen_head_ce(hidden, gh, gen_labels)
+        total = total + cfg.gen_loss_weight * gen_sum
+        metrics["ntokens"] = metrics["ntokens"] + gen_n
+        metrics["gen_loss_sum"] = gen_sum
+        metrics["gen_ntokens"] = gen_n
+    return total, metrics
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint io (deepseek-ai/Janus layout via the reference module tree)
+# ---------------------------------------------------------------------------
+
+_VIT_BLOCK_MAP = [
+    ("norm1_w", "norm1.weight", False), ("norm1_b", "norm1.bias", False),
+    ("qkv_w", "attn.qkv.weight", True), ("qkv_b", "attn.qkv.bias", False),
+    ("proj_w", "attn.proj.weight", True), ("proj_b", "attn.proj.bias", False),
+    ("norm2_w", "norm2.weight", False), ("norm2_b", "norm2.bias", False),
+    ("fc1_w", "mlp.fc1.weight", True), ("fc1_b", "mlp.fc1.bias", False),
+    ("fc2_w", "mlp.fc2.weight", True), ("fc2_b", "mlp.fc2.bias", False),
+    ("ls1", "ls1.gamma", False), ("ls2", "ls2.gamma", False),
+]
+
+
+def _vq_tree_maps(cfg: JanusGenVisionConfig):
+    """[(our dotted path, hf name, kind)] for the whole VQ tree; kind in
+    conv|tensor. Mirrors init_gen_vision_params' structural loops."""
+    out = []
+    levels = len(cfg.encoder_ch_mult)
+
+    def norm(ours, hf):
+        out.append((f"{ours}.gn_w", f"{hf}.weight", "tensor"))
+        out.append((f"{ours}.gn_b", f"{hf}.bias", "tensor"))
+
+    def conv(ours, hf):
+        out.append((f"{ours}_w", f"{hf}.weight", "conv"))
+        out.append((f"{ours}_b", f"{hf}.bias", "tensor"))
+
+    def res(ours, hf, cin, cout):
+        norm(f"{ours}.norm1", f"{hf}.norm1")
+        conv(f"{ours}.conv1", f"{hf}.conv1")
+        norm(f"{ours}.norm2", f"{hf}.norm2")
+        conv(f"{ours}.conv2", f"{hf}.conv2")
+        if cin != cout:
+            conv(f"{ours}.shortcut", f"{hf}.nin_shortcut")
+
+    def attn(ours, hf):
+        norm(f"{ours}.norm", f"{hf}.norm")
+        for mine, theirs in (("q", "q"), ("k", "k"), ("v", "v"), ("proj", "proj_out")):
+            conv(f"{ours}.{mine}", f"{hf}.{theirs}")
+
+    # encoder
+    conv("encoder.conv_in", "gen_vision_model.encoder.conv_in")
+    in_mult = (1,) + cfg.encoder_ch_mult
+    for i in range(levels):
+        cin = cfg.ch * in_mult[i]
+        cout = cfg.ch * cfg.encoder_ch_mult[i]
+        for j in range(cfg.num_res_blocks):
+            res(f"encoder.down.{i}.res.{j}",
+                f"gen_vision_model.encoder.conv_blocks.{i}.res.{j}", cin, cout)
+            cin = cout
+            if i == levels - 1:
+                attn(f"encoder.down.{i}.attn.{j}",
+                     f"gen_vision_model.encoder.conv_blocks.{i}.attn.{j}")
+        if i != levels - 1:
+            conv(f"encoder.down.{i}.down",
+                 f"gen_vision_model.encoder.conv_blocks.{i}.downsample.conv")
+    top = cfg.ch * cfg.encoder_ch_mult[-1]
+    res("encoder.mid_res1", "gen_vision_model.encoder.mid.0", top, top)
+    attn("encoder.mid_attn", "gen_vision_model.encoder.mid.1")
+    res("encoder.mid_res2", "gen_vision_model.encoder.mid.2", top, top)
+    norm("encoder.norm_out", "gen_vision_model.encoder.norm_out")
+    conv("encoder.conv_out", "gen_vision_model.encoder.conv_out")
+
+    # decoder (our up[j] reads reference conv_blocks[j]; both run deep->shallow)
+    conv("decoder.conv_in", "gen_vision_model.decoder.conv_in")
+    res("decoder.mid_res1", "gen_vision_model.decoder.mid.0", top, top)
+    attn("decoder.mid_attn", "gen_vision_model.decoder.mid.1")
+    res("decoder.mid_res2", "gen_vision_model.decoder.mid.2", top, top)
+    cin = top
+    for j, i in enumerate(reversed(range(levels))):
+        cout = cfg.ch * cfg.decoder_ch_mult[i]
+        for k in range(cfg.num_res_blocks + 1):
+            res(f"decoder.up.{j}.res.{k}",
+                f"gen_vision_model.decoder.conv_blocks.{j}.res.{k}", cin, cout)
+            cin = cout
+            if i == levels - 1:
+                attn(f"decoder.up.{j}.attn.{k}",
+                     f"gen_vision_model.decoder.conv_blocks.{j}.attn.{k}")
+        if i != 0:
+            conv(f"decoder.up.{j}.up",
+                 f"gen_vision_model.decoder.conv_blocks.{j}.upsample.conv")
+    norm("decoder.norm_out", "gen_vision_model.decoder.norm_out")
+    conv("decoder.conv_out", "gen_vision_model.decoder.conv_out")
+
+    out.append(("codebook", "gen_vision_model.quantize.embedding.weight", "tensor"))
+    conv("quant_conv", "gen_vision_model.quant_conv")
+    conv("post_quant_conv", "gen_vision_model.post_quant_conv")
+    return out
+
+
+def _vq_get(tree, dotted):
+    cur = tree
+    for part in dotted.split("."):
+        # our res params use "shortcut_w"/"conv1_w" flat names inside dicts
+        if isinstance(cur, list):
+            cur = cur[int(part)]
+        elif part in cur:
+            cur = cur[part]
+        else:
+            return None
+    return cur
+
+
+def _vq_set(tree, dotted, value):
+    parts = dotted.split(".")
+    cur = tree
+    for part in parts[:-1]:
+        cur = cur[int(part)] if isinstance(cur, list) else cur[part]
+    if isinstance(cur, list):
+        cur[int(parts[-1])] = value
+    else:
+        cur[parts[-1]] = value
+
+
+def hf_to_params(model_dir: str, cfg: JanusConfig, target_shardings=None):
+    from veomni_tpu.models import hf_io
+    from veomni_tpu.models.qwen2_5_vl import _text_key_map
+
+    pd = cfg.text.param_dtype
+    def text_key_map(k):
+        if not k.startswith(("language_model.", "model.", "lm_head")):
+            return None
+        return _text_key_map(k.replace("language_model.lm_head.", "lm_head.", 1))
+
+    language_model = hf_io.hf_to_params(
+        model_dir, cfg.text,
+        target_shardings=target_shardings["language_model"] if target_shardings else None,
+        key_map=text_key_map,
+    )
+    lazy = hf_io.LazyHFTensors(model_dir)
+
+    def read(name):
+        return np.asarray(lazy.read(name))
+
+    def t2(name):
+        return jnp.asarray(np.ascontiguousarray(read(name).T), pd)
+
+    def t0(name, dtype=pd):
+        return jnp.asarray(read(name), dtype)
+
+    vcfg = cfg.vision
+    pfx = "vision_model.vision_tower"
+    blocks: Params = {}
+    for ours, suffix, tr in _VIT_BLOCK_MAP:
+        if f"{pfx}.blocks.0.{suffix}" not in lazy and ours in ("ls1", "ls2", "qkv_b"):
+            continue
+        blocks[ours] = jnp.asarray(np.stack([
+            read(f"{pfx}.blocks.{i}.{suffix}").T if tr
+            else read(f"{pfx}.blocks.{i}.{suffix}")
+            for i in range(vcfg.layers)
+        ]), pd)
+    vision_tower: Params = {
+        "patch_embed": jnp.asarray(np.ascontiguousarray(
+            read(f"{pfx}.patch_embed.proj.weight")
+            .transpose(2, 3, 1, 0).reshape(-1, vcfg.width)), pd),
+        "patch_embed_b": t0(f"{pfx}.patch_embed.proj.bias"),
+        "pos_embed": t0(f"{pfx}.pos_embed")[0],
+        "blocks": blocks,
+        "norm_w": t0(f"{pfx}.norm.weight"),
+        "norm_b": t0(f"{pfx}.norm.bias"),
+    }
+    if f"{pfx}.cls_token" in lazy:
+        vision_tower["cls_token"] = t0(f"{pfx}.cls_token")[0]
+
+    gen_vision = init_gen_vision_params(jax.random.PRNGKey(0), cfg.gen_vision)
+    for ours, hf, kind in _vq_tree_maps(cfg.gen_vision):
+        arr = read(hf)
+        if kind == "conv":
+            arr = np.ascontiguousarray(arr.transpose(2, 3, 1, 0))
+        _vq_set(gen_vision, ours, jnp.asarray(arr, jnp.float32))
+
+    def proj(prefix, depth):
+        layers = []
+        idxs = [0] + [2 * i for i in range(1, depth)]
+        for li in idxs:
+            layers.append({"w": t2(f"{prefix}.layers.{li}.weight"),
+                           "b": t0(f"{prefix}.layers.{li}.bias")})
+        return layers
+
+    return {
+        "language_model": language_model,
+        "vision_tower": vision_tower,
+        "gen_vision": gen_vision,
+        "aligner": proj("aligner", cfg.aligner_depth),
+        "gen_aligner": proj("gen_aligner", cfg.gen_aligner_depth),
+        "gen_embed": t0("gen_embed.weight"),
+        "gen_head": {
+            "fc1": t2("gen_head.output_mlp_projector.weight"),
+            "fc1_b": t0("gen_head.output_mlp_projector.bias"),
+            "fc2": t2("gen_head.vision_head.weight"),
+            "fc2_b": t0("gen_head.vision_head.bias"),
+        },
+    }
+
+
+def params_to_hf(params, cfg: JanusConfig) -> Dict[str, np.ndarray]:
+    from veomni_tpu.models import hf_io
+
+    host = hf_io.gather_to_host(params)
+    out: Dict[str, np.ndarray] = {}
+    text = hf_io.params_to_hf(host["language_model"], cfg.text)
+    for k, v in text.items():
+        if k == "lm_head.weight":
+            out["language_model.lm_head.weight"] = v
+        else:
+            out[f"language_model.{k}"] = v
+
+    vcfg = cfg.vision
+    pfx = "vision_model.vision_tower"
+    vt = host["vision_tower"]
+    out[f"{pfx}.patch_embed.proj.weight"] = np.ascontiguousarray(
+        vt["patch_embed"].reshape(vcfg.patch_size, vcfg.patch_size, 3, vcfg.width)
+        .transpose(3, 2, 0, 1)
+    )
+    out[f"{pfx}.patch_embed.proj.bias"] = vt["patch_embed_b"]
+    out[f"{pfx}.pos_embed"] = vt["pos_embed"][None]
+    out[f"{pfx}.norm.weight"] = vt["norm_w"]
+    out[f"{pfx}.norm.bias"] = vt["norm_b"]
+    if "cls_token" in vt:
+        out[f"{pfx}.cls_token"] = vt["cls_token"][None]
+    for ours, suffix, tr in _VIT_BLOCK_MAP:
+        if ours not in vt["blocks"]:
+            continue
+        for i in range(vcfg.layers):
+            x = vt["blocks"][ours][i]
+            out[f"{pfx}.blocks.{i}.{suffix}"] = np.ascontiguousarray(
+                x.T if tr else x
+            )
+
+    for ours, hf, kind in _vq_tree_maps(cfg.gen_vision):
+        arr = np.asarray(_vq_get(host["gen_vision"], ours))
+        if kind == "conv":
+            arr = np.ascontiguousarray(arr.transpose(3, 2, 0, 1))
+        out[hf] = arr
+
+    for name, depth in (("aligner", cfg.aligner_depth),
+                        ("gen_aligner", cfg.gen_aligner_depth)):
+        idxs = [0] + [2 * i for i in range(1, depth)]
+        for layer, li in zip(host[name], idxs):
+            out[f"{name}.layers.{li}.weight"] = np.ascontiguousarray(layer["w"].T)
+            out[f"{name}.layers.{li}.bias"] = layer["b"]
+    out["gen_embed.weight"] = host["gen_embed"]
+    out["gen_head.output_mlp_projector.weight"] = np.ascontiguousarray(
+        host["gen_head"]["fc1"].T)
+    out["gen_head.output_mlp_projector.bias"] = host["gen_head"]["fc1_b"]
+    out["gen_head.vision_head.weight"] = np.ascontiguousarray(
+        host["gen_head"]["fc2"].T)
+    out["gen_head.vision_head.bias"] = host["gen_head"]["fc2_b"]
+    return out
+
+
+def save_hf_checkpoint(params, cfg: JanusConfig, out_dir: str) -> None:
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    tensors = params_to_hf(params, cfg)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    save_file({k: np.ascontiguousarray(v) for k, v in tensors.items()},
+              os.path.join(out_dir, "model.safetensors"))
+    gv = cfg.gen_vision
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "janus",
+            "architectures": ["Janus"],
+            "language_config": {**cfg.text.to_hf_config(), "model_type": "llama"},
+            "vision_config": {
+                "width": cfg.vision.width, "layers": cfg.vision.layers,
+                "heads": cfg.vision.heads, "patch_size": cfg.vision.patch_size,
+                "image_size": cfg.vision.image_size,
+                "mlp_ratio": cfg.vision.mlp_ratio,
+                "class_token": cfg.vision.class_token,
+            },
+            "gen_vision_config": {
+                "codebook_size": gv.codebook_size,
+                "codebook_embed_dim": gv.codebook_embed_dim,
+                "codebook_l2_norm": gv.codebook_l2_norm,
+                "encoder_ch_mult": list(gv.encoder_ch_mult),
+                "decoder_ch_mult": list(gv.decoder_ch_mult),
+                "z_channels": gv.z_channels,
+                "image_size": gv.image_size,
+                "ch": gv.ch,
+                "num_res_blocks": gv.num_res_blocks,
+            },
+            "aligner_depth": cfg.aligner_depth,
+            "gen_aligner_depth": cfg.gen_aligner_depth,
+            "gen_head_embed": cfg.gen_head_embed,
+            "image_token_id": cfg.image_token_id,
+            "image_gen_token_id": cfg.image_gen_token_id,
+        }, f, indent=2)
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> JanusConfig:
+    text = TransformerConfig.from_hf_config(
+        {**(hf.get("language_config") or {}), "model_type": "llama"}
+    )
+    vis_fields = set(JanusVisionConfig.__dataclass_fields__)
+    gen_fields = set(JanusGenVisionConfig.__dataclass_fields__)
+    kw: Dict[str, Any] = {
+        "text": text,
+        "vision": JanusVisionConfig(**{
+            k: v for k, v in (hf.get("vision_config") or {}).items()
+            if k in vis_fields
+        }),
+        "gen_vision": JanusGenVisionConfig(**{
+            k: v for k, v in (hf.get("gen_vision_config") or {}).items()
+            if k in gen_fields
+        }),
+    }
+    for key in ("aligner_depth", "gen_aligner_depth", "gen_head_embed",
+                "image_token_id", "image_gen_token_id"):
+        if key in hf:
+            kw[key] = hf[key]
+    kw.update(overrides)
+    return JanusConfig(**kw)

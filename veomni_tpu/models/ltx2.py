@@ -1,0 +1,678 @@
+"""LTX-2 audio-video DiT (the trainable transformer core).
+
+Reference: ``veomni/models/diffusers/ltx2_3/`` — ``ltx_core/model/transformer/
+{model,transformer,attention,rope,adaln}.py`` (LTXModel / BasicAVTransformerBlock)
+wrapped by ``ltx_transformer/modeling_ltx2_3_transformer.py``. Defining
+features re-derived here:
+
+* **dual streams**: video tokens and audio tokens run symmetric per-block
+  pipelines — adaLN-zero self-attention (per-TOKEN timestep modulation: the
+  6·dim coefficients come from a PixArt-style adaln-single evaluated per
+  token, so conditioning frames can carry different sigmas), ungated text
+  cross-attention over rms-normed queries, gated **audio↔video cross
+  attention** (both directions read the pre-exchange snapshot; q/k carry
+  temporal-axis rope so alignment is time-relative), then adaLN-zero FFs;
+* **LTX SPLIT rope**: fractional positions ``pos/max_pos`` mapped to
+  [-1, 1], multiplied by a log-spaced ``θ^linspace·π/2`` frequency ladder,
+  distributed ACROSS heads (each head sees a different frequency slice,
+  front-padded with identity rotation), applied as a half-split rotation;
+* PixArt adaln-single stacks (per modality + 3 extra for the A/V cross
+  scale/shift/gate), 2-row scale-shift output head per stream.
+
+Scope: the transformer (what trains); the video/audio VAEs + vocoder are
+frozen inference tooling — training consumes cached latents, matching the
+reference trainer contract and our wan/qwen_image/flux DiT pattern.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu import ops
+from veomni_tpu.models.diffusion_common import (
+    ln_noaffine as _ln_noaffine,
+    timestep_embedding as _ts_embed,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass
+class LTX2Config:
+    """``LTXVideoTransformerModelConfig`` surface (defaults ~ LTX-2 13B)."""
+
+    num_attention_heads: int = 32
+    attention_head_dim: int = 128
+    in_channels: int = 128
+    out_channels: int = 128
+    num_layers: int = 48
+    cross_attention_dim: int = 4096
+    caption_channels: int = 4096
+    with_audio: bool = True
+    audio_num_attention_heads: int = 32
+    audio_attention_head_dim: int = 64
+    audio_in_channels: int = 128
+    audio_out_channels: int = 128
+    rope_theta: float = 10000.0
+    # pixel-space extents for the fractional rope axes (f, h, w) / (t,)
+    positional_embedding_max_pos: Tuple[int, ...] = (20, 2048, 2048)
+    audio_positional_embedding_max_pos: Tuple[int, ...] = (20,)
+    # latent-token -> pixel-coordinate strides ((sec/frame, px, px) analogue)
+    video_pos_scale: Tuple[float, float, float] = (1.0, 32.0, 32.0)
+    audio_pos_scale: Tuple[float, ...] = (1.0,)
+    timestep_scale_multiplier: float = 1000.0
+    norm_eps: float = 1e-6
+    initializer_range: float = 0.02
+    # static latent grid (f, h, w) and audio token count for the rope plan
+    video_shape: Tuple[int, int, int] = ()
+    audio_len: int = 0
+    model_type: str = "ltx2"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    def __post_init__(self):
+        for f_ in ("positional_embedding_max_pos", "audio_positional_embedding_max_pos",
+                   "video_pos_scale", "audio_pos_scale", "video_shape"):
+            setattr(self, f_, tuple(getattr(self, f_)))
+        for f_ in ("dtype", "param_dtype"):
+            v = getattr(self, f_)
+            if isinstance(v, str):
+                setattr(self, f_, getattr(jnp, v))
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_attention_heads * self.attention_head_dim
+
+    @property
+    def audio_inner_dim(self) -> int:
+        return self.audio_num_attention_heads * self.audio_attention_head_dim
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def _attn_params(keys, q_dim, ctx_dim, inner, pd, s):
+    def init(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(pd)
+
+    return {
+        "q_norm": jnp.ones((inner,), pd),
+        "k_norm": jnp.ones((inner,), pd),
+        "to_q": init((q_dim, inner)), "to_q_b": jnp.zeros((inner,), pd),
+        "to_k": init((ctx_dim, inner)), "to_k_b": jnp.zeros((inner,), pd),
+        "to_v": init((ctx_dim, inner)), "to_v_b": jnp.zeros((inner,), pd),
+        "to_out": init((inner, q_dim)), "to_out_b": jnp.zeros((q_dim,), pd),
+    }
+
+
+def _ff_params(keys, dim, pd, s):
+    def init(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(pd)
+
+    return {
+        "fc1": init((dim, 4 * dim)), "fc1_b": jnp.zeros((4 * dim,), pd),
+        "fc2": init((4 * dim, dim)), "fc2_b": jnp.zeros((dim,), pd),
+    }
+
+
+def _adaln_single_params(keys, dim, coeff, pd, s):
+    def init(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(pd)
+
+    return {
+        "emb_fc1": init((256, dim)), "emb_fc1_b": jnp.zeros((dim,), pd),
+        "emb_fc2": init((dim, dim)), "emb_fc2_b": jnp.zeros((dim,), pd),
+        "linear": init((dim, coeff * dim)), "linear_b": jnp.zeros((coeff * dim,), pd),
+    }
+
+
+def init_params(rng: jax.Array, cfg: LTX2Config) -> Params:
+    pd, s = cfg.param_dtype, cfg.initializer_range
+    d, da = cfg.inner_dim, cfg.audio_inner_dim
+    L = cfg.num_layers
+    keys = iter(jax.random.split(rng, 256))
+
+    def init(shape):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * s).astype(pd)
+
+    def stack(fn):
+        per = [fn() for _ in range(L)]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *per)
+
+    blocks: Params = {
+        "attn1": stack(lambda: _attn_params(keys, d, d, d, pd, s)),
+        "attn2": stack(lambda: _attn_params(keys, d, d, d, pd, s)),
+        "ff": stack(lambda: _ff_params(keys, d, pd, s)),
+        "scale_shift_table": jnp.zeros((L, 6, d), pd),
+    }
+    p: Params = {
+        "patchify_proj": init((cfg.in_channels, d)),
+        "patchify_proj_b": jnp.zeros((d,), pd),
+        "caption_projection": {
+            "linear_1": init((cfg.caption_channels, d)),
+            "linear_1_b": jnp.zeros((d,), pd),
+            "linear_2": init((d, d)), "linear_2_b": jnp.zeros((d,), pd),
+        },
+        "adaln_single": _adaln_single_params(keys, d, 6, pd, s),
+        "scale_shift_table": jnp.zeros((2, d), pd),
+        "proj_out": init((d, cfg.out_channels)),
+        "proj_out_b": jnp.zeros((cfg.out_channels,), pd),
+    }
+    if cfg.with_audio:
+        blocks.update(
+            audio_attn1=stack(lambda: _attn_params(keys, da, da, da, pd, s)),
+            audio_attn2=stack(lambda: _attn_params(keys, da, da, da, pd, s)),
+            audio_ff=stack(lambda: _ff_params(keys, da, pd, s)),
+            audio_scale_shift_table=jnp.zeros((L, 6, da), pd),
+            # q: video, kv: audio — audio-sized heads/inner dim (reference)
+            audio_to_video_attn=stack(lambda: _attn_params(keys, d, da, da, pd, s)),
+            video_to_audio_attn=stack(lambda: _attn_params(keys, da, d, da, pd, s)),
+            scale_shift_table_a2v_ca_video=jnp.zeros((L, 5, d), pd),
+            scale_shift_table_a2v_ca_audio=jnp.zeros((L, 5, da), pd),
+        )
+        p.update(
+            audio_patchify_proj=init((cfg.audio_in_channels, da)),
+            audio_patchify_proj_b=jnp.zeros((da,), pd),
+            audio_caption_projection={
+                "linear_1": init((cfg.caption_channels, da)),
+                "linear_1_b": jnp.zeros((da,), pd),
+                "linear_2": init((da, da)), "linear_2_b": jnp.zeros((da,), pd),
+            },
+            audio_adaln_single=_adaln_single_params(keys, da, 6, pd, s),
+            av_ca_video_scale_shift_adaln_single=_adaln_single_params(keys, d, 4, pd, s),
+            av_ca_audio_scale_shift_adaln_single=_adaln_single_params(keys, da, 4, pd, s),
+            av_ca_a2v_gate_adaln_single=_adaln_single_params(keys, d, 1, pd, s),
+            av_ca_v2a_gate_adaln_single=_adaln_single_params(keys, da, 1, pd, s),
+            audio_scale_shift_table=jnp.zeros((2, da), pd),
+            audio_proj_out=init((da, cfg.audio_out_channels)),
+            audio_proj_out_b=jnp.zeros((cfg.audio_out_channels,), pd),
+        )
+    p["blocks"] = blocks
+    return p
+
+
+def abstract_params(cfg: LTX2Config) -> Params:
+    return jax.eval_shape(lambda: init_params(jax.random.PRNGKey(0), cfg))
+
+
+# ---------------------------------------------------------------------------
+# rope (LTX SPLIT: per-head frequency slices, fractional [-1,1] positions)
+# ---------------------------------------------------------------------------
+
+def ltx_rope(positions: np.ndarray, max_pos, inner_dim: int, heads: int,
+             theta: float):
+    """positions [T, n_axes] (pixel coords) -> (cos, sin) [1, heads, T,
+    head_dim/2] per-head SPLIT tables (reference ``rope.py:precompute_freqs_cis``)."""
+    n_axes = positions.shape[1]
+    n_freq = inner_dim // (2 * n_axes)
+    ladder = theta ** np.linspace(0.0, 1.0, n_freq) * (np.pi / 2)   # [F]
+    frac = np.stack([positions[:, i] / max_pos[i] for i in range(n_axes)], -1)
+    freqs = (ladder[None, None, :] * (frac[..., None] * 2.0 - 1.0))  # [T,A,F]
+    freqs = freqs.transpose(0, 2, 1).reshape(positions.shape[0], -1)  # [T,F*A]? -> match ref
+    # reference: (indices * frac).transpose(-1,-2).flatten -> [T, A*F] with
+    # axis-major ordering after transpose: freqs[t] = concat_f [f over axes]
+    pad = inner_dim // 2 - freqs.shape[-1]
+    cos = np.cos(freqs)
+    sin = np.sin(freqs)
+    if pad:
+        cos = np.concatenate([np.ones((cos.shape[0], pad)), cos], -1)
+        sin = np.concatenate([np.zeros((sin.shape[0], pad)), sin], -1)
+    t = cos.shape[0]
+    cos = cos.reshape(1, t, heads, -1).transpose(0, 2, 1, 3)
+    sin = sin.reshape(1, t, heads, -1).transpose(0, 2, 1, 3)
+    return jnp.asarray(cos, jnp.float32), jnp.asarray(sin, jnp.float32)
+
+
+def _apply_split_rope(x, cos, sin):
+    """x [B, H, T, hd]; cos/sin [1, H, T, hd/2]: half-split rotation."""
+    d = x.shape[-1] // 2
+    x1, x2 = x[..., :d], x[..., d:]
+    out1 = x1 * cos - sin * x2
+    out2 = x2 * cos + sin * x1
+    return jnp.concatenate([out1, out2], -1).astype(x.dtype)
+
+
+def _video_positions(cfg: LTX2Config, shape) -> np.ndarray:
+    f, h, w = shape
+    ff, hh, ww = np.meshgrid(np.arange(f), np.arange(h), np.arange(w),
+                             indexing="ij")
+    grid = np.stack([ff, hh, ww], -1).reshape(-1, 3).astype(np.float64)
+    scale = np.asarray(cfg.video_pos_scale)
+    return (grid + 0.5) * scale  # middle-indices grid
+
+
+def _audio_positions(cfg: LTX2Config, n: int) -> np.ndarray:
+    return ((np.arange(n, dtype=np.float64) + 0.5) * cfg.audio_pos_scale[0])[:, None]
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return (y * (w if w is not None else 1.0)).astype(x.dtype)
+
+
+def _adaln_single(lp, timestep, coeff):
+    """timestep [B] -> (coeffs [B, 1, coeff*dim] f32, embedded [B, dim])."""
+    e = _ts_embed(timestep, 256).astype(lp["emb_fc1"].dtype)
+    e = jnp.dot(e, lp["emb_fc1"]) + lp["emb_fc1_b"]
+    e = jnp.dot(jax.nn.silu(e), lp["emb_fc2"]) + lp["emb_fc2_b"]
+    out = jnp.dot(jax.nn.silu(e), lp["linear"]) + lp["linear_b"]
+    return out.astype(jnp.float32)[:, None, :], e
+
+
+def _ada(sst, ts_coeffs, idx0, n, dim):
+    """rows [idx0, idx0+n) of the block sst + per-token coeffs -> n tensors
+    [B, 1, dim] (timestep is per-sample here; the reference supports
+    per-token sigma — broadcasting keeps the same contract)."""
+    b = ts_coeffs.shape[0]
+    co = ts_coeffs.reshape(b, 1, -1, dim)
+    return [
+        (sst[i][None, None] + co[:, :, i]).astype(jnp.float32)
+        for i in range(idx0, idx0 + n)
+    ]
+
+
+def _attention(lp, x, ctx, heads, eps, pe=None, k_pe=None, seg_q=None, seg_k=None):
+    b, tq, _ = x.shape
+    inner = lp["to_q"].shape[-1]
+    hd = inner // heads
+    q = _rms(jnp.dot(x, lp["to_q"]) + lp["to_q_b"], lp["q_norm"], eps)
+    k = _rms(jnp.dot(ctx, lp["to_k"]) + lp["to_k_b"], lp["k_norm"], eps)
+    v = jnp.dot(ctx, lp["to_v"]) + lp["to_v_b"]
+    tk = ctx.shape[1]
+    q = q.reshape(b, tq, heads, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(b, tk, heads, hd).transpose(0, 2, 1, 3)
+    if pe is not None:
+        q = _apply_split_rope(q, *pe)
+        k = _apply_split_rope(k, *(k_pe if k_pe is not None else pe))
+    q = q.transpose(0, 2, 1, 3)
+    k = k.transpose(0, 2, 1, 3)
+    o = ops.attention(
+        q, k, v.reshape(b, tk, heads, hd),
+        segment_ids=None, causal=False,
+    ) if seg_q is None else _masked_attn(q, k, v.reshape(b, tk, heads, hd),
+                                         seg_q, seg_k)
+    o = o.reshape(b, tq, inner)
+    return jnp.dot(o, lp["to_out"]) + lp["to_out_b"]
+
+
+def _masked_attn(q, k, v, seg_q, seg_k):
+    from veomni_tpu.ops.attention import _attention_dense
+
+    bias = jnp.where(
+        (seg_q[:, :, None] > 0) & (seg_k[:, None, :] > 0), 0.0, -jnp.inf
+    ).astype(jnp.float32)
+    return _attention_dense(q, k, v, causal=False, bias=bias)
+
+
+def _block(carry, lp, cfg: LTX2Config, vts, ats, v_ss_ts, a_ss_ts,
+           v_gate_ts, a_gate_ts, v_ctx, a_ctx, ctx_mask, v_pe, a_pe,
+           v_cross_pe, a_cross_pe):
+    eps = cfg.norm_eps
+    d, da = cfg.inner_dim, cfg.audio_inner_dim
+    if cfg.with_audio:
+        vx, ax = carry
+    else:
+        vx, ax = carry, None
+
+    # video self-attention (adaLN-zero) + text cross
+    sh, sc, gate = _ada(lp["scale_shift_table"], vts, 0, 3, d)
+    vn = (_rms(vx, None, eps).astype(jnp.float32) * (1 + sc) + sh).astype(vx.dtype)
+    vx = vx + _attention(lp["attn1"], vn, vn, cfg.num_attention_heads, eps,
+                         pe=v_pe) * gate.astype(vx.dtype)
+    vx = vx + _attention(lp["attn2"], _rms(vx, None, eps), v_ctx,
+                         cfg.num_attention_heads, eps,
+                         seg_q=None if ctx_mask is None else jnp.ones(vx.shape[:2], jnp.int32),
+                         seg_k=ctx_mask)
+
+    if cfg.with_audio:
+        sh, sc, gate = _ada(lp["audio_scale_shift_table"], ats, 0, 3, da)
+        an = (_rms(ax, None, eps).astype(jnp.float32) * (1 + sc) + sh).astype(ax.dtype)
+        ax = ax + _attention(lp["audio_attn1"], an, an,
+                             cfg.audio_num_attention_heads, eps,
+                             pe=a_pe) * gate.astype(ax.dtype)
+        ax = ax + _attention(lp["audio_attn2"], _rms(ax, None, eps), a_ctx,
+                             cfg.audio_num_attention_heads, eps,
+                             seg_q=None if ctx_mask is None else jnp.ones(ax.shape[:2], jnp.int32),
+                             seg_k=ctx_mask)
+
+        # audio <-> video cross attention over the pre-exchange snapshot.
+        # NOTE row order: the A/V cross tables unpack (scale, shift) — the
+        # reference's get_av_ca_ada_values names row0 scale — while the MSA/FF
+        # tables unpack (shift, scale, gate) per the PixArt convention
+        # (reference transformer.py:196-228 vs 204-216). Both match upstream.
+        vx_pre, ax_pre = vx, ax
+        v_sc, v_sh = _ada(lp["scale_shift_table_a2v_ca_video"][:4], v_ss_ts, 0, 2, d)
+        (v_gate,) = _ada(lp["scale_shift_table_a2v_ca_video"][4:], v_gate_ts, 0, 1, d)
+        a_sc, a_sh = _ada(lp["scale_shift_table_a2v_ca_audio"][:4], a_ss_ts, 0, 2, da)
+        vq = (_rms(vx_pre, None, eps).astype(jnp.float32) * (1 + v_sc) + v_sh).astype(vx.dtype)
+        akv = (_rms(ax_pre, None, eps).astype(jnp.float32) * (1 + a_sc) + a_sh).astype(ax.dtype)
+        vx = vx + _attention(
+            lp["audio_to_video_attn"], vq, akv, cfg.audio_num_attention_heads,
+            eps, pe=v_cross_pe, k_pe=a_cross_pe,
+        ) * v_gate.astype(vx.dtype)
+
+        a_sc2, a_sh2 = _ada(lp["scale_shift_table_a2v_ca_audio"][:4], a_ss_ts, 2, 2, da)
+        (a_gate,) = _ada(lp["scale_shift_table_a2v_ca_audio"][4:], a_gate_ts, 0, 1, da)
+        v_sc2, v_sh2 = _ada(lp["scale_shift_table_a2v_ca_video"][:4], v_ss_ts, 2, 2, d)
+        aq = (_rms(ax_pre, None, eps).astype(jnp.float32) * (1 + a_sc2) + a_sh2).astype(ax.dtype)
+        vkv = (_rms(vx_pre, None, eps).astype(jnp.float32) * (1 + v_sc2) + v_sh2).astype(vx.dtype)
+        ax = ax + _attention(
+            lp["video_to_audio_attn"], aq, vkv, cfg.audio_num_attention_heads,
+            eps, pe=a_cross_pe, k_pe=v_cross_pe,
+        ) * a_gate.astype(ax.dtype)
+
+    # FFs (adaLN-zero)
+    sh, sc, gate = _ada(lp["scale_shift_table"], vts, 3, 3, d)
+    vn = (_rms(vx, None, eps).astype(jnp.float32) * (1 + sc) + sh).astype(vx.dtype)
+    y = jax.nn.gelu(jnp.dot(vn, lp["ff"]["fc1"]) + lp["ff"]["fc1_b"], approximate=True)
+    vx = vx + (jnp.dot(y, lp["ff"]["fc2"]) + lp["ff"]["fc2_b"]) * gate.astype(vx.dtype)
+    if cfg.with_audio:
+        sh, sc, gate = _ada(lp["audio_scale_shift_table"], ats, 3, 3, da)
+        an = (_rms(ax, None, eps).astype(jnp.float32) * (1 + sc) + sh).astype(ax.dtype)
+        y = jax.nn.gelu(jnp.dot(an, lp["audio_ff"]["fc1"]) + lp["audio_ff"]["fc1_b"],
+                        approximate=True)
+        ax = ax + (jnp.dot(y, lp["audio_ff"]["fc2"]) + lp["audio_ff"]["fc2_b"]) \
+            * gate.astype(ax.dtype)
+        return vx, ax
+    return vx
+
+
+def _caption_proj(lp, ctx):
+    y = jax.nn.gelu(jnp.dot(ctx, lp["linear_1"]) + lp["linear_1_b"], approximate=True)
+    return jnp.dot(y, lp["linear_2"]) + lp["linear_2_b"]
+
+
+def ltx2_forward(params, cfg: LTX2Config, video_latents, timestep, text_states,
+                 audio_latents=None, text_mask=None,
+                 video_shape: Tuple[int, int, int] = None):
+    """video_latents [B, N_v, in_channels] (N_v = f*h*w of ``video_shape``);
+    timestep [B] (flow sigma in [0,1]); text_states [B, Lt, caption_channels];
+    audio_latents [B, N_a, audio_in_channels] -> (video_pred, audio_pred)."""
+    p = jax.tree.map(lambda t: t.astype(cfg.dtype), params)
+    b, nv, _ = video_latents.shape
+    video_shape = video_shape or cfg.video_shape
+    if int(np.prod(video_shape)) != nv:
+        raise ValueError(f"video_shape {video_shape} != {nv} tokens")
+    ts = timestep * cfg.timestep_scale_multiplier
+
+    vx = jnp.dot(video_latents.astype(cfg.dtype), p["patchify_proj"]) + p["patchify_proj_b"]
+    v_ctx = _caption_proj(p["caption_projection"], text_states.astype(cfg.dtype))
+    vts, v_emb = _adaln_single(p["adaln_single"], ts, 6)
+
+    vpos = _video_positions(cfg, video_shape)
+    v_pe = ltx_rope(vpos, cfg.positional_embedding_max_pos, cfg.inner_dim,
+                    cfg.num_attention_heads, cfg.rope_theta)
+
+    ax = a_ctx = ats = a_emb = a_pe = None
+    v_ss = a_ss = v_gate = a_gate = v_cross_pe = a_cross_pe = None
+    if cfg.with_audio:
+        if audio_latents is None:
+            raise ValueError("with_audio config needs audio_latents")
+        na = audio_latents.shape[1]
+        ax = jnp.dot(audio_latents.astype(cfg.dtype), p["audio_patchify_proj"]) \
+            + p["audio_patchify_proj_b"]
+        a_ctx = _caption_proj(p["audio_caption_projection"], text_states.astype(cfg.dtype))
+        ats, a_emb = _adaln_single(p["audio_adaln_single"], ts, 6)
+        apos = _audio_positions(cfg, na)
+        a_pe = ltx_rope(apos, cfg.audio_positional_embedding_max_pos,
+                        cfg.audio_inner_dim, cfg.audio_num_attention_heads,
+                        cfg.rope_theta)
+        v_ss, _ = _adaln_single(p["av_ca_video_scale_shift_adaln_single"], ts, 4)
+        a_ss, _ = _adaln_single(p["av_ca_audio_scale_shift_adaln_single"], ts, 4)
+        v_gate, _ = _adaln_single(p["av_ca_a2v_gate_adaln_single"], ts, 1)
+        a_gate, _ = _adaln_single(p["av_ca_v2a_gate_adaln_single"], ts, 1)
+        # A/V cross rope: shared TEMPORAL axis (frame seconds on both sides)
+        cross_max = (max(cfg.positional_embedding_max_pos[0],
+                         cfg.audio_positional_embedding_max_pos[0]),)
+        v_cross_pe = ltx_rope(vpos[:, :1], cross_max, cfg.audio_inner_dim,
+                              cfg.audio_num_attention_heads, cfg.rope_theta)
+        a_cross_pe = ltx_rope(apos[:, :1], cross_max, cfg.audio_inner_dim,
+                              cfg.audio_num_attention_heads, cfg.rope_theta)
+
+    ctx_mask = None if text_mask is None else text_mask.astype(jnp.int32)
+    body = partial(
+        _block, cfg=cfg, vts=vts, ats=ats, v_ss_ts=v_ss, a_ss_ts=a_ss,
+        v_gate_ts=v_gate, a_gate_ts=a_gate, v_ctx=v_ctx, a_ctx=a_ctx,
+        ctx_mask=ctx_mask, v_pe=v_pe, a_pe=a_pe, v_cross_pe=v_cross_pe,
+        a_cross_pe=a_cross_pe,
+    )
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    carry = (vx, ax) if cfg.with_audio else vx
+    carry, _ = jax.lax.scan(lambda c, lp: (body(c, lp), None), carry, p["blocks"])
+    if cfg.with_audio:
+        vx, ax = carry
+    else:
+        vx = carry
+
+    def head(x, sst, emb, proj, proj_b, dim):
+        mod = sst[None, None] + emb.astype(jnp.float32)[:, None, None, :]
+        shift, scale = mod[:, :, 0], mod[:, :, 1]
+        x = (_ln_noaffine(x, cfg.norm_eps) * (1 + scale) + shift).astype(x.dtype)
+        return jnp.dot(x, proj) + proj_b
+
+    v_out = head(vx, p["scale_shift_table"].astype(jnp.float32), v_emb,
+                 p["proj_out"], p["proj_out_b"], cfg.inner_dim)
+    if not cfg.with_audio:
+        return v_out, None
+    a_out = head(ax, p["audio_scale_shift_table"].astype(jnp.float32), a_emb,
+                 p["audio_proj_out"], p["audio_proj_out_b"], cfg.audio_inner_dim)
+    return v_out, a_out
+
+
+def loss_fn(params, cfg: LTX2Config, batch) -> Tuple[jax.Array, Dict]:
+    """batch: latents [B,Nv,C] (noisy video), timestep [B] (0..1000 scale as
+    shipped by WanCollator — rescaled internally), text_states, text_mask,
+    target [B,Nv,C]; optional audio_latents/audio_target [B,Na,Ca]."""
+    ts = batch["timestep"] / cfg.timestep_scale_multiplier
+    v_pred, a_pred = ltx2_forward(
+        params, cfg, batch["latents"], ts, batch["text_states"],
+        audio_latents=batch.get("audio_latents"),
+        text_mask=batch.get("text_mask"),
+        video_shape=cfg.video_shape or None,
+    )
+    err = (v_pred.astype(jnp.float32) - batch["target"].astype(jnp.float32)) ** 2
+    loss = err.reshape(err.shape[0], -1).mean(axis=1)
+    if a_pred is not None and "audio_target" in batch:
+        aerr = (a_pred.astype(jnp.float32)
+                - batch["audio_target"].astype(jnp.float32)) ** 2
+        loss = loss + aerr.reshape(aerr.shape[0], -1).mean(axis=1)
+    loss = loss.mean()
+    n = jnp.int32(err.shape[0])
+    return loss * n, {"loss": loss, "ntokens": n, "mse_loss": loss}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint io (reference LTXModel module names)
+# ---------------------------------------------------------------------------
+
+_ATTN_MAP = [
+    ("q_norm", "q_norm.weight", False), ("k_norm", "k_norm.weight", False),
+    ("to_q", "to_q.weight", True), ("to_q_b", "to_q.bias", False),
+    ("to_k", "to_k.weight", True), ("to_k_b", "to_k.bias", False),
+    ("to_v", "to_v.weight", True), ("to_v_b", "to_v.bias", False),
+    ("to_out", "to_out.0.weight", True), ("to_out_b", "to_out.0.bias", False),
+]
+_FF_MAP = [
+    ("fc1", "net.0.proj.weight", True), ("fc1_b", "net.0.proj.bias", False),
+    ("fc2", "net.2.weight", True), ("fc2_b", "net.2.bias", False),
+]
+_ADALN_MAP = [
+    ("emb_fc1", "emb.timestep_embedder.linear_1.weight", True),
+    ("emb_fc1_b", "emb.timestep_embedder.linear_1.bias", False),
+    ("emb_fc2", "emb.timestep_embedder.linear_2.weight", True),
+    ("emb_fc2_b", "emb.timestep_embedder.linear_2.bias", False),
+    ("linear", "linear.weight", True), ("linear_b", "linear.bias", False),
+]
+_CAP_MAP = [
+    ("linear_1", "linear_1.weight", True), ("linear_1_b", "linear_1.bias", False),
+    ("linear_2", "linear_2.weight", True), ("linear_2_b", "linear_2.bias", False),
+]
+
+_BLOCK_SUBMODULES = [
+    ("attn1", "attn1", _ATTN_MAP), ("attn2", "attn2", _ATTN_MAP),
+    ("ff", "ff", _FF_MAP),
+    ("audio_attn1", "audio_attn1", _ATTN_MAP),
+    ("audio_attn2", "audio_attn2", _ATTN_MAP),
+    ("audio_ff", "audio_ff", _FF_MAP),
+    ("audio_to_video_attn", "audio_to_video_attn", _ATTN_MAP),
+    ("video_to_audio_attn", "video_to_audio_attn", _ATTN_MAP),
+]
+_BLOCK_TABLES = [
+    ("scale_shift_table", "scale_shift_table"),
+    ("audio_scale_shift_table", "audio_scale_shift_table"),
+    ("scale_shift_table_a2v_ca_video", "scale_shift_table_a2v_ca_video"),
+    ("scale_shift_table_a2v_ca_audio", "scale_shift_table_a2v_ca_audio"),
+]
+_TOP_SINGLE = [
+    ("patchify_proj", "patchify_proj.weight", True),
+    ("patchify_proj_b", "patchify_proj.bias", False),
+    ("scale_shift_table", "scale_shift_table", False),
+    ("proj_out", "proj_out.weight", True), ("proj_out_b", "proj_out.bias", False),
+    ("audio_patchify_proj", "audio_patchify_proj.weight", True),
+    ("audio_patchify_proj_b", "audio_patchify_proj.bias", False),
+    ("audio_scale_shift_table", "audio_scale_shift_table", False),
+    ("audio_proj_out", "audio_proj_out.weight", True),
+    ("audio_proj_out_b", "audio_proj_out.bias", False),
+]
+_TOP_MODULES = [
+    ("caption_projection", "caption_projection", _CAP_MAP),
+    ("audio_caption_projection", "audio_caption_projection", _CAP_MAP),
+    ("adaln_single", "adaln_single", _ADALN_MAP),
+    ("audio_adaln_single", "audio_adaln_single", _ADALN_MAP),
+    ("av_ca_video_scale_shift_adaln_single",
+     "av_ca_video_scale_shift_adaln_single", _ADALN_MAP),
+    ("av_ca_audio_scale_shift_adaln_single",
+     "av_ca_audio_scale_shift_adaln_single", _ADALN_MAP),
+    ("av_ca_a2v_gate_adaln_single", "av_ca_a2v_gate_adaln_single", _ADALN_MAP),
+    ("av_ca_v2a_gate_adaln_single", "av_ca_v2a_gate_adaln_single", _ADALN_MAP),
+]
+
+
+def params_to_hf(params, cfg: LTX2Config) -> Dict[str, np.ndarray]:
+    from veomni_tpu.models import hf_io
+
+    host = hf_io.gather_to_host(params)
+    out: Dict[str, np.ndarray] = {}
+    for ours, hf, tr in _TOP_SINGLE:
+        if ours in host:
+            x = host[ours]
+            out[hf] = np.ascontiguousarray(x.T) if tr else x
+    for ours, hf, mapping in _TOP_MODULES:
+        if ours in host:
+            for o2, h2, tr in mapping:
+                x = host[ours][o2]
+                out[f"{hf}.{h2}"] = np.ascontiguousarray(x.T) if tr else x
+    for i in range(cfg.num_layers):
+        pfx = f"transformer_blocks.{i}"
+        for ours, hf, mapping in _BLOCK_SUBMODULES:
+            if ours not in host["blocks"]:
+                continue
+            for o2, h2, tr in mapping:
+                x = host["blocks"][ours][o2][i]
+                out[f"{pfx}.{hf}.{h2}"] = np.ascontiguousarray(x.T) if tr else x
+        for ours, hf in _BLOCK_TABLES:
+            if ours in host["blocks"]:
+                out[f"{pfx}.{hf}"] = host["blocks"][ours][i]
+    return out
+
+
+def hf_to_params(model_dir: str, cfg: LTX2Config, target_shardings=None):
+    from veomni_tpu.models import hf_io
+
+    lazy = hf_io.LazyHFTensors(model_dir)
+    pd = cfg.param_dtype
+
+    def read(name):
+        return np.asarray(lazy.read(name))
+
+    def get(name, tr):
+        a = read(name)
+        return jnp.asarray(np.ascontiguousarray(a.T) if tr else a, pd)
+
+    params: Params = {}
+    for ours, hf, tr in _TOP_SINGLE:
+        if hf in lazy:
+            params[ours] = get(hf, tr)
+    for ours, hf, mapping in _TOP_MODULES:
+        if f"{hf}.{mapping[0][1]}" in lazy:
+            params[ours] = {o2: get(f"{hf}.{h2}", tr) for o2, h2, tr in mapping}
+    blocks: Params = {}
+    for ours, hf, mapping in _BLOCK_SUBMODULES:
+        if f"transformer_blocks.0.{hf}.{mapping[0][1]}" not in lazy:
+            continue
+        sub = {}
+        for o2, h2, tr in mapping:
+            sub[o2] = jnp.asarray(np.stack([
+                np.ascontiguousarray(read(f"transformer_blocks.{i}.{hf}.{h2}").T)
+                if tr else read(f"transformer_blocks.{i}.{hf}.{h2}")
+                for i in range(cfg.num_layers)
+            ]), pd)
+        blocks[ours] = sub
+    for ours, hf in _BLOCK_TABLES:
+        if f"transformer_blocks.0.{hf}" in lazy:
+            blocks[ours] = jnp.asarray(np.stack([
+                read(f"transformer_blocks.{i}.{hf}")
+                for i in range(cfg.num_layers)
+            ]), pd)
+    params["blocks"] = blocks
+    return params
+
+
+def save_hf_checkpoint(params, cfg: LTX2Config, out_dir: str) -> None:
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    tensors = params_to_hf(params, cfg)
+    if jax.process_index() != 0:
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    save_file({k: np.ascontiguousarray(v) for k, v in tensors.items()},
+              os.path.join(out_dir, "model.safetensors"))
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "ltx2",
+            "architectures": ["LTXVideoTransformerModel"],
+            "num_attention_heads": cfg.num_attention_heads,
+            "attention_head_dim": cfg.attention_head_dim,
+            "in_channels": cfg.in_channels,
+            "out_channels": cfg.out_channels,
+            "num_layers": cfg.num_layers,
+            "cross_attention_dim": cfg.cross_attention_dim,
+            "caption_channels": cfg.caption_channels,
+            "with_audio": cfg.with_audio,
+            "audio_num_attention_heads": cfg.audio_num_attention_heads,
+            "audio_attention_head_dim": cfg.audio_attention_head_dim,
+            "audio_in_channels": cfg.audio_in_channels,
+            "audio_out_channels": cfg.audio_out_channels,
+            "positional_embedding_max_pos": list(cfg.positional_embedding_max_pos),
+            "audio_positional_embedding_max_pos":
+                list(cfg.audio_positional_embedding_max_pos),
+            "video_pos_scale": list(cfg.video_pos_scale),
+            "audio_pos_scale": list(cfg.audio_pos_scale),
+            "video_shape": list(cfg.video_shape),
+            "audio_len": cfg.audio_len,
+        }, f, indent=2)
+
+
+def config_from_hf(hf: Dict[str, Any], **overrides) -> LTX2Config:
+    fields = set(LTX2Config.__dataclass_fields__)
+    kw = {k: v for k, v in hf.items() if k in fields}
+    kw.update(overrides)
+    kw["model_type"] = "ltx2"
+    return LTX2Config(**kw)

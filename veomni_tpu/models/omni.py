@@ -171,6 +171,42 @@ def audio_forward(params, cfg: AudioEncoderConfig, features: jax.Array) -> jax.A
     return jnp.dot(x, params["out_proj"])
 
 
+def build_gen_labels(input_ids, codes, gen_mask, gen_token_id, tokens_per_image,
+                     segment_ids=None):
+    """Next-token codebook labels [B,S] for autoregressive image generation
+    (shared by the seed_omni and janus composites).
+
+    ``codes`` [B, max_gen * T] holds each slot image's VQ indices in slot
+    order; position p gets the code at p+1 (IGNORE off gen slots / across
+    packed-segment boundaries)."""
+    from veomni_tpu.data.data_collator import IGNORE_INDEX
+
+    bi = input_ids.shape[0]
+    mg = codes.shape[1] // tokens_per_image
+    is_gen = input_ids == gen_token_id
+    ordinal = jnp.cumsum(is_gen.astype(jnp.int32), axis=1) - 1
+    img_i_raw = ordinal // tokens_per_image
+    img_i = jnp.clip(img_i_raw, 0, mg - 1)
+    tok_i = jnp.clip(ordinal % tokens_per_image, 0, tokens_per_image - 1)
+    code_at = jnp.take_along_axis(codes, img_i * tokens_per_image + tok_i, axis=1)
+    valid = (
+        is_gen
+        & (img_i_raw < mg)
+        & jnp.take_along_axis(gen_mask, img_i, axis=1)
+    )
+    code_at = jnp.where(valid, code_at, IGNORE_INDEX)
+    gen_labels = jnp.concatenate(
+        [code_at[:, 1:], jnp.full((bi, 1), IGNORE_INDEX, code_at.dtype)], axis=1
+    )
+    if segment_ids is not None:  # no cross-segment prediction under packing
+        same = jnp.concatenate(
+            [segment_ids[:, 1:] == segment_ids[:, :-1], jnp.zeros((bi, 1), bool)],
+            axis=1,
+        )
+        gen_labels = jnp.where(same, gen_labels, IGNORE_INDEX)
+    return gen_labels
+
+
 def init_image_gen_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
     """MoVQ tokenizer + gen_aligner (codebook -> LM stream, Linear-GELU-Linear
     like reference ``seed_omni/projector.py:20-33``) + generation head
@@ -199,6 +235,19 @@ def init_image_gen_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
             "fc2": init(r5, (h, v)), "fc2_b": jnp.zeros((v,), jnp.float32),
         },
     }
+
+
+def gen_head_ce(hidden, gh, gen_labels):
+    """Generation-head (Linear-GELU-Linear onto the codebook vocab) loss via
+    the fused chunked CE; the head bias folds in as a ones column so the
+    [T, codebook] logits never materialize. Shared by seed_omni and janus."""
+    from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy
+
+    b, s, h = hidden.shape
+    g = jax.nn.gelu(jnp.dot(hidden.reshape(b * s, h), gh["fc1"]) + gh["fc1_b"])
+    g1 = jnp.concatenate([g, jnp.ones((b * s, 1), g.dtype)], axis=1)
+    k1 = jnp.concatenate([gh["fc2"], gh["fc2_b"][None, :]], axis=0)
+    return fused_linear_cross_entropy(g1, k1, gen_labels.reshape(-1))
 
 
 def init_omni_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
@@ -292,31 +341,10 @@ def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
         embeds = merge_image_features(
             embeds, input_ids, feats, gen_mask, cfg.image_gen_token_id
         )
-        # per-position codebook code (IGNORE off gen slots), then the usual
-        # next-token shift: position p is trained to predict the code at p+1
-        is_gen = input_ids == cfg.image_gen_token_id
-        ordinal = jnp.cumsum(is_gen.astype(jnp.int32), axis=1) - 1
-        img_i_raw = ordinal // t_gen
-        img_i = jnp.clip(img_i_raw, 0, mg - 1)
-        tok_i = jnp.clip(ordinal % t_gen, 0, t_gen - 1)
-        code_at = jnp.take_along_axis(
-            idx.reshape(bi, mg * t_gen), img_i * t_gen + tok_i, axis=1
+        gen_labels = build_gen_labels(
+            input_ids, idx.reshape(bi, mg * t_gen), gen_mask,
+            cfg.image_gen_token_id, t_gen, batch.get("segment_ids"),
         )
-        valid = (
-            is_gen
-            & (img_i_raw < mg)
-            & jnp.take_along_axis(gen_mask, img_i, axis=1)
-        )
-        code_at = jnp.where(valid, code_at, IGNORE_INDEX)
-        gen_labels = jnp.concatenate(
-            [code_at[:, 1:], jnp.full((bi, 1), IGNORE_INDEX, code_at.dtype)], axis=1
-        )
-        seg = batch.get("segment_ids")
-        if seg is not None:  # no cross-segment prediction under packing
-            same = jnp.concatenate(
-                [seg[:, 1:] == seg[:, :-1], jnp.zeros((bi, 1), bool)], axis=1
-            )
-            gen_labels = jnp.where(same, gen_labels, IGNORE_INDEX)
 
     hidden, moe_aux, moe_dropped = transformer.forward_hidden(
         lm_params, tcfg, input_ids, batch["position_ids"],
@@ -326,15 +354,8 @@ def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
         lm_params, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
     )
     if gen_labels is not None:
-        from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy
-
         gh = jax.tree.map(lambda p: p.astype(tcfg.dtype), params["image_gen"]["gen_head"])
-        b, s, h = hidden.shape
-        g = jax.nn.gelu(jnp.dot(hidden.reshape(b * s, h), gh["fc1"]) + gh["fc1_b"])
-        # fold the head bias into the fused chunked CE via a ones column
-        g1 = jnp.concatenate([g, jnp.ones((b * s, 1), g.dtype)], axis=1)
-        k1 = jnp.concatenate([gh["fc2"], gh["fc2_b"][None, :]], axis=0)
-        gen_sum, gen_n = fused_linear_cross_entropy(g1, k1, gen_labels.reshape(-1))
+        gen_sum, gen_n = gen_head_ce(hidden, gh, gen_labels)
         total = total + cfg.image_gen.gen_loss_weight * gen_sum
         # gen tokens join the token-sum normalization space (train_step
         # divides by ntokens after the dp/sp psum)

@@ -65,9 +65,13 @@ class WanCollator:
         self.scheduler = scheduler
         self.latent_shape = tuple(latent_shape)  # wan: (C,F,H,W); qwen_image: (N, in_ch)
         self.text_len = text_len
-        # wan conditions on T5 states (text_dim); qwen_image on Qwen2.5-VL
-        # states (joint_attention_dim)
-        self.text_dim = getattr(cfg, "text_dim", 0) or cfg.joint_attention_dim
+        # wan conditions on T5 states (text_dim); qwen_image/flux on
+        # joint_attention_dim; ltx2 on caption_channels
+        self.text_dim = (
+            getattr(cfg, "text_dim", 0)
+            or getattr(cfg, "joint_attention_dim", 0)
+            or cfg.caption_channels
+        )
         self._rng = np.random.default_rng(seed)
 
     def __call__(self, samples) -> Dict[str, np.ndarray]:
@@ -77,6 +81,10 @@ class WanCollator:
         mask = np.zeros((b, self.text_len), np.int32)
         pooled_dim = int(getattr(self.cfg, "pooled_projection_dim", 0) or 0)
         pooled = np.zeros((b, pooled_dim), np.float32) if pooled_dim else None
+        audio_len = int(getattr(self.cfg, "audio_len", 0) or 0) \
+            if getattr(self.cfg, "with_audio", False) else 0
+        a0 = (np.zeros((b, audio_len, self.cfg.audio_in_channels), np.float32)
+              if audio_len else None)
         for i, s in enumerate(samples[:b]):
             x0[i] = np.asarray(s["latents"], np.float32).reshape(self.latent_shape)
             ts = np.asarray(s["text_states"], np.float32).reshape(-1, self.text_dim)
@@ -85,6 +93,14 @@ class WanCollator:
             mask[i, :n] = 1
             if pooled is not None and "pooled_text" in s:
                 pooled[i] = np.asarray(s["pooled_text"], np.float32)
+            if a0 is not None:
+                if "audio_latents" not in s:
+                    # a zero-filled slot would train the audio head to
+                    # predict pure noise — fail loudly like the model does
+                    raise KeyError(
+                        "with_audio ltx2 rows must carry 'audio_latents'"
+                    )
+                a0[i] = np.asarray(s["audio_latents"], np.float32).reshape(a0[i].shape)
         t = self.scheduler.sample_timesteps(self._rng, b)
         noise = self._rng.standard_normal(x0.shape).astype(np.float32)
         out = {
@@ -98,6 +114,10 @@ class WanCollator:
         }
         if pooled is not None:  # flux: pooled-CLIP conditioning stream
             out["pooled_text"] = pooled
+        if a0 is not None:  # ltx2: joint audio stream shares the sigma
+            anoise = self._rng.standard_normal(a0.shape).astype(np.float32)
+            out["audio_latents"] = FlowMatchScheduler.add_noise(a0, anoise, t)
+            out["audio_target"] = FlowMatchScheduler.velocity_target(a0, anoise)
         return out
 
     def state_dict(self):
@@ -117,7 +137,7 @@ class DiTTrainer(BaseTrainer):
         from veomni_tpu.models.auto import FoundationModel, ModelFamily
 
         req_mt = mt or self.args.model.model_type
-        if req_mt in ("wan_t2v", "qwen_image", "flux"):
+        if req_mt in ("wan_t2v", "qwen_image", "flux", "ltx2"):
             from veomni_tpu.models.auto import MODEL_REGISTRY
 
             # collator geometry knobs, not model-config fields
@@ -143,7 +163,7 @@ class DiTTrainer(BaseTrainer):
 
     @property
     def _is_wan(self) -> bool:
-        return self.model.config.model_type in ("wan_t2v", "qwen_image", "flux")
+        return self.model.config.model_type in ("wan_t2v", "qwen_image", "flux", "ltx2")
 
     @staticmethod
     def _save_native(params, cfg, out_dir):
@@ -205,6 +225,10 @@ class DiTTrainer(BaseTrainer):
             }
             if getattr(self.model.config, "pooled_projection_dim", 0):
                 m["pooled_text"] = P(None, ps.dp_axes, None)
+            if getattr(self.model.config, "with_audio", False) and \
+                    getattr(self.model.config, "audio_len", 0):
+                m["audio_latents"] = P(None, ps.dp_axes, None, None)
+                m["audio_target"] = P(None, ps.dp_axes, None, None)
             return m
         return {
             "latents": P(None, ps.dp_axes, None, None, None),
