@@ -64,6 +64,38 @@ def bench_config(remat_policy: str = "dots"):
     )
 
 
+def _wait_for_backend(retry_s: float = 120.0):
+    """The axon relay intermittently refuses the chip claim with
+    ``UNAVAILABLE: TPU backend setup/compile error`` (observed for hours at
+    a stretch, round-4 notes). Init is cheap to retry and the watchdog
+    bounds total time — keep knocking instead of dying on the first
+    refusal."""
+    import jax
+
+    attempt = 0
+    while True:
+        try:
+            return jax.device_count()
+        except RuntimeError as e:
+            if "UNAVAILABLE" not in str(e) and "Unable to initialize" not in str(e):
+                raise
+            attempt += 1
+            print(f"# backend init refused (attempt {attempt}): retrying "
+                  f"in {int(retry_s)}s", file=sys.stderr, flush=True)
+            # jax caches the failed init (error dict + backend map); clear
+            # so the next call re-attempts the claim
+            try:
+                from jax._src import xla_bridge
+
+                with xla_bridge._backend_lock:
+                    xla_bridge._backends.clear()
+                    xla_bridge._backend_errors.clear()
+                    xla_bridge._default_backend = None
+            except Exception:
+                pass
+            time.sleep(retry_s)
+
+
 def run_bench(
     seq_len: int,
     micro_bs: int,
@@ -90,7 +122,7 @@ def run_bench(
     os.environ["VEOMNI_DONATE_STATE"] = "1" if donate else "0"
     apply_ops_config({"attention": attention_impl} if attention_impl else None)
 
-    n_chips = jax.device_count()
+    n_chips = _wait_for_backend()
     ps = init_parallel_state()
 
     with use_parallel_state(ps):
