@@ -7,6 +7,16 @@ only within their own segment), which both the XLA impl and the Pallas flash
 kernel consume. Ulysses wrapping lives in ``parallel/sequence_parallel.py``
 and calls this op on gathered-sequence/scattered-head tensors.
 
+``mask_mod`` is the FlexAttention analogue (reference
+``ops/kernels/attention/flex.py`` mask mods): a callable
+``mask_mod(q_idx, k_idx) -> bool`` over broadcastable position index arrays
+(close over per-batch tensors for data-dependent masks, e.g. prefix-LM
+boundaries — the closure runs inside the jitted program, so GSPMD-sharded
+batch tensors are fine; sequence parallelism is rejected at the facade)
+that composes with the causal/window/segment masks. XLA fuses
+the predicate into the masked softmax the same way flex compiles a block
+mask — no kernel authoring needed on TPU.
+
 Layouts: q [B, S, Hq, D]; k/v [B, S, Hkv, D]; segment_ids [B, S] int32
 (0 is a valid segment; padding should use a dedicated segment value and be
 masked out by the loss). Returns [B, S, Hq, D].
@@ -50,6 +60,7 @@ def _attention_xla_chunked(
     sinks: Optional[jax.Array] = None,
     q_chunk: int = 1024,
     k_chunk: int = 1024,
+    mask_mod=None,
 ):
     """Blockwise online-softmax attention in pure XLA (flash-attention
     algorithm, no Pallas): O(S * chunk) live memory instead of the dense
@@ -67,7 +78,7 @@ def _attention_xla_chunked(
     if cq < 128 or ck < 128:
         # pathological (prime-ish) lengths: blockwise gains nothing
         return _attention_dense(q, k, v, segment_ids, causal, softmax_scale,
-                                sliding_window, sinks)
+                                sliding_window, sinks, mask_mod=mask_mod)
     n_rep = hq // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
@@ -104,6 +115,13 @@ def _attention_xla_chunked(
         mask = jnp.broadcast_to(mask[None, None], (b, hq, cq, ck))
         if seg_q is not None:
             mask = mask & (sq_i[:, None, :, None] == seg_k[:, j][:, None, None, :])
+        if mask_mod is not None:
+            mm = jnp.asarray(mask_mod(qpos, kpos))
+            if mm.ndim == 3:
+                mm = mm[:, None]
+            while mm.ndim < 4:
+                mm = mm[None]
+            mask = mask & mm
         s_blk = jnp.where(mask, s_blk, neg)
         m_new = jnp.maximum(m, s_blk.max(-1))
         p = jnp.where(mask, jnp.exp(s_blk - m_new[..., None]), 0.0)
@@ -164,6 +182,7 @@ def _attention_xla_twopass(
     sliding_window=None,
     sinks: Optional[jax.Array] = None,
     q_chunk: int = 2048,
+    mask_mod=None,
 ):
     """HBM-lean attention in pure XLA: q-chunked, scores computed TWICE.
 
@@ -192,7 +211,8 @@ def _attention_xla_twopass(
     cq = _best_chunk(sq, min(q_chunk, max(1, 8_388_608 // max(sk, 1))))
     if cq < 256 and sq > 256:
         return _attention_xla_chunked(q, k, v, segment_ids, causal,
-                                      softmax_scale, sliding_window, sinks)
+                                      softmax_scale, sliding_window, sinks,
+                                      mask_mod=mask_mod)
     n_rep = hq // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
@@ -217,6 +237,13 @@ def _attention_xla_twopass(
         if seg_qi is not None:
             seg = seg_qi[:, None, :, None] == seg_k[:, None, None, :]
             mask = seg if mask is None else (mask & seg)
+        if mask_mod is not None:
+            mm = jnp.asarray(mask_mod(qpos, kpos))
+            if mm.ndim == 3:
+                mm = mm[:, None]
+            while mm.ndim < 4:
+                mm = mm[None]
+            mask = mm if mask is None else (mask & mm)
 
         def scores():
             return jnp.einsum(
@@ -279,15 +306,17 @@ def _attention_xla(
     softmax_scale: Optional[float] = None,
     sliding_window=None,  # python int OR traced int32 scalar (0/<=0 = full)
     sinks: Optional[jax.Array] = None,  # [Hq] learned sink logits (gpt_oss)
+    mask_mod=None,
 ):
     from veomni_tpu.utils.env import get_env
 
     threshold = int(get_env("VEOMNI_ATTN_CHUNK_THRESHOLD"))
     if q.shape[1] > threshold:
         return _attention_xla_chunked(q, k, v, segment_ids, causal,
-                                      softmax_scale, sliding_window, sinks)
+                                      softmax_scale, sliding_window, sinks,
+                                      mask_mod=mask_mod)
     return _attention_dense(q, k, v, segment_ids, causal, softmax_scale,
-                            sliding_window, sinks)
+                            sliding_window, sinks, mask_mod=mask_mod)
 
 
 def _attention_dense(
@@ -300,6 +329,7 @@ def _attention_dense(
     sliding_window=None,
     sinks: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,  # [B, Sq, Sk] additive (DSA top-k mask)
+    mask_mod=None,                     # (q_idx, k_idx) -> bool, broadcastable
 ):
     b, sq, hq, d = q.shape
     sk = k.shape[1]
@@ -326,6 +356,15 @@ def _attention_dense(
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         seg = jnp.swapaxes(seg, -1, -2)  # [B,1,q,k]
         mask = seg if mask is None else (mask & seg)
+    if mask_mod is not None:
+        # [Sq,Sk] / [B,Sq,Sk] / [B,H,Sq,Sk] results all broadcast into the
+        # [B,H,q,k] mask; batch-shaped results get a head axis inserted
+        mm = jnp.asarray(mask_mod(jnp.arange(sq)[:, None], jnp.arange(sk)[None, :]))
+        if mm.ndim == 3:
+            mm = mm[:, None]
+        while mm.ndim < 4:
+            mm = mm[None]
+        mask = mm if mask is None else (mask & mm)
     if mask is not None:
         scores = jnp.where(mask, scores, -1e30)
     if sinks is not None:
@@ -337,6 +376,10 @@ def _attention_dense(
         probs = jax.nn.softmax(full, axis=-1)[..., :sk].astype(q.dtype)
     else:
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        if mask is not None:
+            # a row fully masked out (reachable via mask_mod) must emit 0,
+            # matching the blockwise impls, not a uniform average of V
+            probs = jnp.where(mask.any(-1, keepdims=True), probs, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -349,17 +392,30 @@ def attention(
     softmax_scale: Optional[float] = None,
     sliding_window=None,
     sinks: Optional[jax.Array] = None,
+    mask_mod=None,
 ):
     """SP-aware facade (reference ``ops/kernels/attention/__init__.py:30-86``):
     under an ambient ParallelState with ulysses > 1, wraps the resolved
-    kernel in the Ulysses a2a shard_map."""
+    kernel in the Ulysses a2a shard_map. ``mask_mod`` pins the XLA impls
+    (the Pallas flash kernel and the ring-CP path don't take flex masks)
+    and composes with data/expert parallelism only — sequence parallelism
+    would hand the closure sequence-sharded positions."""
     inner = resolve_op("attention")
     kwargs = dict(causal=causal, softmax_scale=softmax_scale,
                   sliding_window=sliding_window, sinks=sinks)
+    if mask_mod is not None:
+        kwargs["mask_mod"] = mask_mod
+        inner = _attention_xla
     from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
 
     pstate = get_parallel_state_or_none()
     if pstate is not None and (pstate.ulysses_size > 1 or pstate.cp_size > 1):
+        if mask_mod is not None:
+            raise NotImplementedError(
+                "mask_mod under ulysses/ring sequence parallelism: the "
+                "shard_map body sees sequence-local positions; run flex-"
+                "masked attention with sp=1 (dp/fsdp/ep compose fine)"
+            )
         from veomni_tpu.parallel.sequence_parallel import sp_attention
 
         return sp_attention(inner, q, k, v, segment_ids, pstate, **kwargs)
