@@ -17,13 +17,18 @@ from bench import run_bench  # noqa: E402
 
 
 DEFAULT = [
-    # [seq_len, micro_bs, attention_impl, remat_policy]
-    [4096, 4, "xla", "dots"],
-    [4096, 4, "pallas_flash", "dots"],
+    # [seq_len, micro_bs, attention_impl, remat_policy] — the VERDICT ladder:
+    # seq 2k -> 32k x attention impl x remat x micro-bs (xla_twopass is the
+    # measured-best attention on the relay-attached v5e, BENCH_NOTES r2)
+    [2048, 8, "xla_twopass", "dots"],
+    [4096, 8, "xla_twopass", "dots"],
+    [4096, 8, "xla_twopass", "nothing"],
+    [4096, 16, "xla_twopass", "dots"],
     [4096, 8, "xla", "dots"],
     [4096, 8, "pallas_flash", "dots"],
-    [4096, 8, "xla", "nothing"],
-    [4096, 16, "xla", "dots"],
+    [8192, 4, "xla_twopass", "dots"],
+    [16384, 2, "xla_twopass", "dots"],
+    [32768, 1, "xla_twopass", "dots"],
 ]
 
 

@@ -1,0 +1,121 @@
+"""Image-generation decoder registry — the seed_omni decoder contract.
+
+Reference: ``veomni/models/seed_omni/decoder/base.py:71-90`` — every
+generation decoder implements ``lm_encode`` (pixels -> codes + LM-side
+embeddings), ``lm_head`` (hidden states -> code logits/loss), ``lm_embed``
+(codes -> LM-side embeddings) and ``lm_generate`` (codes -> pixels), with
+concrete decoders under ``decoder/{movqgan,janusvq16,cosmos,...}``.
+
+TPU translation: a decoder is a bundle of pure functions over a param tree
+(no modules), registered by name; the omni composite's ``ImageGenConfig``
+picks one via ``decoder_type`` and drives the shared codebook-injection +
+generation-head machinery (``omni.py``). The aligner + generation head live
+in the composite (reference ``gen_aligner``/``gen_head`` are also owned by
+the wrapper, not the VQ model).
+
+Registered decoders:
+
+* ``movqgan`` — spatially-conditioned MoVQ tokenizer (``movqgan.py``;
+  reference ``decoder/movqgan``)
+* ``janus_vq`` — llamagen VQ-16 with l2-normalized codebook (``janus.py``'s
+  ``gen_vision_*``; reference ``decoder/janusvq16``)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+
+from veomni_tpu.utils.registry import Registry
+
+GEN_DECODER_REGISTRY = Registry("gen_decoders")
+
+
+@dataclass(frozen=True)
+class GenDecoder:
+    """The functional decoder contract (reference BaseDecoderModelMixin).
+
+    ``encode_codes(params, cfg, pixels) -> (codes [N,T], vq_per_image [N])``
+    is ``lm_encode``'s tokenize half; ``code_embeds(params, cfg, codes)``
+    is ``lm_embed``'s codebook lookup (the aligner applies downstream);
+    ``decode(params, cfg, codes) -> pixels`` is ``lm_generate``. The
+    ``lm_head`` half (hidden -> code logits) is the composite's generation
+    head (``omni.gen_head_ce``), shared across decoders."""
+
+    name: str
+    config_cls: type
+    init_params: Callable
+    encode_codes: Callable
+    code_embeds: Callable
+    decode: Callable
+    tokens_per_image: Callable
+    embed_dim: Callable
+    codebook_size: Callable
+    image_size: Callable
+    hf_to_params: Callable = None
+
+
+def _register_movqgan():
+    from veomni_tpu.models import movqgan as m
+
+    def encode_codes(params, cfg, pixels):
+        _, idx, vq_per = m.encode(params, cfg, pixels)
+        return idx.reshape(idx.shape[0], -1), vq_per
+
+    def code_embeds(params, cfg, codes):
+        return params["codebook"][codes]
+
+    GEN_DECODER_REGISTRY.register("movqgan", GenDecoder(
+        name="movqgan",
+        config_cls=m.MoVQGANConfig,
+        init_params=m.init_params,
+        encode_codes=encode_codes,
+        code_embeds=code_embeds,
+        decode=m.decode_code,
+        tokens_per_image=lambda cfg: cfg.tokens_per_image,
+        embed_dim=lambda cfg: cfg.embed_dim,
+        codebook_size=lambda cfg: cfg.n_embed,
+        image_size=lambda cfg: cfg.resolution,
+        hf_to_params=m.hf_to_params,
+    ))
+
+
+def _register_janus_vq():
+    from veomni_tpu.models import janus as j
+
+    def encode_codes(params, cfg, pixels):
+        _, idx, vq_per = j.gen_vision_encode(params, cfg, pixels)
+        return idx.reshape(idx.shape[0], -1), vq_per
+
+    def code_embeds(params, cfg, codes):
+        import jax.numpy as jnp
+
+        cb = params["codebook"]
+        if cfg.codebook_l2_norm:
+            cb = cb * jax.lax.rsqrt(
+                jnp.maximum((cb * cb).sum(-1, keepdims=True), 1e-12)
+            )
+        return cb[codes]
+
+    GEN_DECODER_REGISTRY.register("janus_vq", GenDecoder(
+        name="janus_vq",
+        config_cls=j.JanusGenVisionConfig,
+        init_params=j.init_gen_vision_params,
+        encode_codes=encode_codes,
+        code_embeds=code_embeds,
+        decode=j.decode_code,
+        tokens_per_image=lambda cfg: cfg.tokens_per_image,
+        embed_dim=lambda cfg: cfg.codebook_embed_dim,
+        codebook_size=lambda cfg: cfg.codebook_size,
+        image_size=lambda cfg: cfg.image_size,
+    ))
+
+
+_register_movqgan()
+_register_janus_vq()
+
+
+def get_gen_decoder(name: str) -> GenDecoder:
+    return GEN_DECODER_REGISTRY.get(name)

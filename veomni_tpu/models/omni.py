@@ -55,29 +55,42 @@ class AudioEncoderConfig:
 
 @dataclass
 class ImageGenConfig:
-    """Image-generation decoder attachment (reference
-    ``seed_omni/decoder/movqgan/configuration_movqgan.py`` + GenerationHead).
+    """Image-generation decoder attachment (reference seed_omni decoder
+    contract, ``decoder/base.py:71-90`` + GenerationHead): any decoder from
+    ``GEN_DECODER_REGISTRY`` (movqgan, janus_vq, ...) selected by
+    ``decoder_type``.
 
     ``freeze_tokenizer`` mirrors ``set_projector_trainable_only``: the VQ
     autoencoder stays frozen while the aligner + generation head train;
     ``freeze_codebook=False`` additionally trains the codebook embedding."""
 
-    movq: "MoVQGANConfig" = None
+    decoder_type: str = "movqgan"
+    movq: Any = None               # decoder config (name kept from the
+    # original movq-only attachment; holds whichever decoder_type's config)
     gen_loss_weight: float = 1.0
     freeze_tokenizer: bool = True
     freeze_codebook: bool = True
 
     def __post_init__(self):
-        from veomni_tpu.models.movqgan import MoVQGANConfig
-
+        dec = self.gen_decoder
         if self.movq is None:
-            self.movq = MoVQGANConfig()
+            self.movq = dec.config_cls()
         elif isinstance(self.movq, dict):
-            self.movq = MoVQGANConfig(**self.movq)
+            self.movq = dec.config_cls(**self.movq)
+
+    @property
+    def gen_decoder(self):
+        from veomni_tpu.models.gen_decoders import get_gen_decoder
+
+        return get_gen_decoder(self.decoder_type)
 
     @property
     def tokens_per_image(self) -> int:
-        return self.movq.tokens_per_image
+        return self.gen_decoder.tokens_per_image(self.movq)
+
+    @property
+    def image_size(self) -> int:
+        return self.gen_decoder.image_size(self.movq)
 
 
 @dataclass
@@ -208,16 +221,15 @@ def build_gen_labels(input_ids, codes, gen_mask, gen_token_id, tokens_per_image,
 
 
 def init_image_gen_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
-    """MoVQ tokenizer + gen_aligner (codebook -> LM stream, Linear-GELU-Linear
-    like reference ``seed_omni/projector.py:20-33``) + generation head
-    (Linear-GELU-Linear onto the codebook vocab, ``GenerationHead`` at
-    ``decoder/movqgan/modeling_movqgan.py:40-52``)."""
-    from veomni_tpu.models import movqgan
-
+    """Registered VQ decoder + gen_aligner (codebook -> LM stream,
+    Linear-GELU-Linear like reference ``seed_omni/projector.py:20-33``) +
+    generation head (Linear-GELU-Linear onto the codebook vocab,
+    ``GenerationHead`` at ``decoder/movqgan/modeling_movqgan.py:40-52``)."""
     icfg = cfg.image_gen
+    dec = icfg.gen_decoder
     h = cfg.text.hidden_size
-    e = icfg.movq.embed_dim
-    v = icfg.movq.n_embed
+    e = dec.embed_dim(icfg.movq)
+    v = dec.codebook_size(icfg.movq)
     s = icfg.movq.initializer_range
     r1, r2, r3, r4, r5 = jax.random.split(rng, 5)
 
@@ -225,7 +237,7 @@ def init_image_gen_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
         return jax.random.normal(key, shape, jnp.float32) * s
 
     return {
-        "movq": movqgan.init_params(r1, icfg.movq),
+        "movq": dec.init_params(r1, icfg.movq),
         "aligner": {
             "fc1": init(r2, (e, h)), "fc1_b": jnp.zeros((h,), jnp.float32),
             "fc2": init(r3, (h, h)), "fc2_b": jnp.zeros((h,), jnp.float32),
@@ -237,6 +249,23 @@ def init_image_gen_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
     }
 
 
+def apply_aligner(al, x):
+    """gen_aligner MLP (codebook embedding -> LM stream); shared by the
+    training loss and autoregressive generation so the two can't diverge."""
+    h = jax.nn.gelu(jnp.dot(x, al["fc1"]) + al["fc1_b"])
+    return jnp.dot(h, al["fc2"]) + al["fc2_b"]
+
+
+def gen_head_hidden(gh, h):
+    """First half of the generation head (pre-vocab projection + GELU);
+    the fused CE folds the final projection, generation materializes it."""
+    return jax.nn.gelu(jnp.dot(h, gh["fc1"]) + gh["fc1_b"])
+
+
+def gen_head_logits(gh, h):
+    return jnp.dot(gen_head_hidden(gh, h), gh["fc2"]) + gh["fc2_b"]
+
+
 def gen_head_ce(hidden, gh, gen_labels):
     """Generation-head (Linear-GELU-Linear onto the codebook vocab) loss via
     the fused chunked CE; the head bias folds in as a ones column so the
@@ -244,7 +273,7 @@ def gen_head_ce(hidden, gh, gen_labels):
     from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy
 
     b, s, h = hidden.shape
-    g = jax.nn.gelu(jnp.dot(hidden.reshape(b * s, h), gh["fc1"]) + gh["fc1_b"])
+    g = gen_head_hidden(gh, hidden.reshape(b * s, h))
     g1 = jnp.concatenate([g, jnp.ones((b * s, 1), g.dtype)], axis=1)
     k1 = jnp.concatenate([gh["fc2"], gh["fc2_b"][None, :]], axis=0)
     return fused_linear_cross_entropy(g1, k1, gen_labels.reshape(-1))
@@ -266,6 +295,68 @@ def init_omni_params(rng: jax.Array, cfg: OmniConfig) -> Dict[str, Any]:
 
 def abstract_omni_params(cfg: OmniConfig):
     return jax.eval_shape(lambda: init_omni_params(jax.random.PRNGKey(0), cfg))
+
+
+def generate_image(params, cfg: OmniConfig, prompt_ids, rng,
+                   temperature: float = 1.0):
+    """Autoregressive image generation — the ``lm_generate`` half of the
+    seed_omni decoder contract (reference ``decoder/base.py:87-90`` +
+    ``MoVQGANDecoder.lm_embed/lm_generate``).
+
+    prompt_ids [B, P] -> (pixels [B, H, W, C], codes [B, T]). Each step runs
+    the full prefix through the LM (teacher-forced stack, no KV cache — the
+    decode loop is ``lax.scan`` over T with a statically padded sequence, so
+    it jits once; T = tokens_per_image is 16-1024, and this path is a
+    correctness/parity surface, not the serving path)."""
+    icfg = cfg.image_gen
+    dec = icfg.gen_decoder
+    tcfg = cfg.text
+    t_gen = icfg.tokens_per_image
+    b, p_len = prompt_ids.shape
+    s = p_len + t_gen
+    lm = params["language_model"]
+    gp = params["image_gen"]
+    al = jax.tree.map(lambda t: t.astype(tcfg.dtype), gp["aligner"])
+    gh = jax.tree.map(lambda t: t.astype(tcfg.dtype), gp["gen_head"])
+    embed = lm["embed_tokens"].astype(tcfg.dtype)
+
+    def code_embed(codes):
+        cb = dec.code_embeds(gp["movq"], icfg.movq, codes)
+        return apply_aligner(al, cb.astype(tcfg.dtype))
+
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s)).astype(jnp.int32)
+    segments = jnp.ones((b, s), jnp.int32)
+    ids_full = jnp.concatenate(
+        [prompt_ids.astype(jnp.int32),
+         jnp.full((b, t_gen), cfg.image_gen_token_id, jnp.int32)], axis=1
+    )
+
+    def step(carry, i):
+        codes, key = carry
+        # embeddings: prompt tokens + already-generated code embeddings at
+        # the gen slots (future slots hold the placeholder embedding, masked
+        # off by causality)
+        gen_embeds = code_embed(codes)                     # [B, T, H]
+        base = embed[ids_full]
+        if tcfg.embed_scale:  # match the training path's prompt scaling
+            base = base * jnp.asarray(tcfg.embed_scale, tcfg.dtype)
+        slot = jnp.arange(t_gen)[None, :, None]
+        gen_part = jnp.where(slot < i, gen_embeds, base[:, p_len:])
+        embeds = jnp.concatenate([base[:, :p_len], gen_part], axis=1)
+        hidden, _, _ = transformer.forward_hidden(
+            lm, tcfg, ids_full, positions, segments, inputs_embeds=embeds
+        )
+        h_pred = hidden[:, p_len + i - 1]                  # predicts slot i
+        logits = gen_head_logits(gh, h_pred).astype(jnp.float32)
+        key, sub = jax.random.split(key)
+        nxt = jax.random.categorical(sub, logits / jnp.maximum(temperature, 1e-6))
+        codes = codes.at[:, i].set(nxt.astype(jnp.int32))
+        return (codes, key), None
+
+    codes0 = jnp.zeros((b, t_gen), jnp.int32)
+    (codes, _), _ = jax.lax.scan(step, (codes0, rng), jnp.arange(t_gen))
+    pixels = dec.decode(gp["movq"], icfg.movq, codes)
+    return pixels, codes
 
 
 def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
@@ -309,35 +400,32 @@ def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
     gen_labels = None
     vq_loss = None
     if cfg.image_gen is not None and "gen_pixels" in batch:
-        from veomni_tpu.data.data_collator import IGNORE_INDEX
-        from veomni_tpu.models import movqgan
-
         icfg = cfg.image_gen
+        dec = icfg.gen_decoder
         gp = params["image_gen"]
-        movq_p = gp["movq"]
+        enc_p = gp["movq"]
         if icfg.freeze_tokenizer:
-            movq_p = jax.lax.stop_gradient(movq_p)
-        codebook = movq_p["codebook"]
-        if icfg.freeze_codebook:
-            codebook = jax.lax.stop_gradient(codebook)
+            enc_p = jax.lax.stop_gradient(enc_p)
         px = batch["gen_pixels"]                     # [B, max_gen, H, W, C]
         bi, mg = px.shape[:2]
         gen_mask = batch["gen_image_mask"]
-        _, idx, vq_per = movqgan.encode(
-            movq_p, icfg.movq, px.reshape(bi * mg, *px.shape[2:])
+        codes, vq_per = dec.encode_codes(
+            enc_p, icfg.movq, px.reshape(bi * mg, *px.shape[2:])
         )
         if not icfg.freeze_tokenizer:
             # mask zero-filled dummy slots out of the VQ/commit objective
             m = gen_mask.reshape(-1).astype(jnp.float32)
             vq_loss = (vq_per * m).sum() / jnp.maximum(m.sum(), 1.0)
         t_gen = icfg.tokens_per_image
-        idx = idx.reshape(bi, mg, t_gen)             # codebook index per slot
-        cb = codebook[idx]                           # [B, mg, T, e] f32
+        idx = codes.reshape(bi, mg, t_gen)           # codebook index per slot
+        # the LM-side code embedding trains iff freeze_codebook is off
+        # (reference set_projector_trainable_only)
+        emb_p = dict(gp["movq"])
+        if icfg.freeze_codebook:
+            emb_p["codebook"] = jax.lax.stop_gradient(emb_p["codebook"])
+        cb = dec.code_embeds(emb_p, icfg.movq, idx)  # [B, mg, T, e] f32
         al = jax.tree.map(lambda p: p.astype(tcfg.dtype), gp["aligner"])
-        feats = jax.nn.gelu(
-            jnp.dot(cb.astype(tcfg.dtype), al["fc1"]) + al["fc1_b"]
-        )
-        feats = jnp.dot(feats, al["fc2"]) + al["fc2_b"]  # [B, mg, T, H]
+        feats = apply_aligner(al, cb.astype(tcfg.dtype))  # [B, mg, T, H]
         embeds = merge_image_features(
             embeds, input_ids, feats, gen_mask, cfg.image_gen_token_id
         )

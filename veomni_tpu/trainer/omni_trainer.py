@@ -59,7 +59,7 @@ class OmniCollator:
             )
             out["audio_mask"] = np.zeros((b, cfg.max_audio), bool)
         if cfg.image_gen is not None:
-            r = cfg.image_gen.movq.resolution
+            r = cfg.image_gen.image_size
             out["gen_pixels"] = np.zeros((b, cfg.max_gen_images, r, r, 3), np.float32)
             out["gen_image_mask"] = np.zeros((b, cfg.max_gen_images), bool)
 
@@ -98,7 +98,7 @@ class OmniCollator:
                 for k, gi in enumerate(gen_images):
                     ids += [cfg.image_gen_token_id] * t_gen
                     labels += [IGNORE_INDEX] * t_gen
-                    arr = load_image(gi, cfg.image_gen.movq.resolution)
+                    arr = load_image(gi, cfg.image_gen.image_size)
                     out["gen_pixels"][i, k] = arr * 2.0 - 1.0  # [0,1] -> [-1,1]
                     out["gen_image_mask"][i, k] = True
             ids, labels = ids[:s], labels[:s]
