@@ -19,14 +19,14 @@ Registered decoders:
   reference ``decoder/movqgan``)
 * ``janus_vq`` — llamagen VQ-16 with l2-normalized codebook (``janus.py``'s
   ``gen_vision_*``; reference ``decoder/janusvq16``)
+* ``cosmos`` — NVIDIA Cosmos FSQ tokenizer with Haar-wavelet patching
+  (``cosmos.py``; reference ``decoder/cosmos``)
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Tuple
-
-import jax
+from typing import Callable
 
 from veomni_tpu.utils.registry import Registry
 
@@ -54,7 +54,9 @@ class GenDecoder:
     embed_dim: Callable
     codebook_size: Callable
     image_size: Callable
-    hf_to_params: Callable = None
+    # whether the tokenizer has a trainable quantization objective (FSQ has
+    # an implicit codebook and no commit loss -> freeze-only)
+    trainable_tokenizer: bool = True
 
 
 def _register_movqgan():
@@ -78,7 +80,6 @@ def _register_movqgan():
         embed_dim=lambda cfg: cfg.embed_dim,
         codebook_size=lambda cfg: cfg.n_embed,
         image_size=lambda cfg: cfg.resolution,
-        hf_to_params=m.hf_to_params,
     ))
 
 
@@ -90,13 +91,9 @@ def _register_janus_vq():
         return idx.reshape(idx.shape[0], -1), vq_per
 
     def code_embeds(params, cfg, codes):
-        import jax.numpy as jnp
-
         cb = params["codebook"]
         if cfg.codebook_l2_norm:
-            cb = cb * jax.lax.rsqrt(
-                jnp.maximum((cb * cb).sum(-1, keepdims=True), 1e-12)
-            )
+            cb = j._l2norm(cb)  # same normalization as encode/decode
         return cb[codes]
 
     GEN_DECODER_REGISTRY.register("janus_vq", GenDecoder(
@@ -113,8 +110,35 @@ def _register_janus_vq():
     ))
 
 
+def _register_cosmos():
+    from veomni_tpu.models import cosmos as c
+
+    def encode_codes(params, cfg, pixels):
+        _, idx, vq_per = c.encode(params, cfg, pixels)
+        return idx.reshape(idx.shape[0], -1), vq_per
+
+    def code_embeds(params, cfg, codes):
+        # FSQ's codebook is implicit: the code vector IS the embedding
+        return c.fsq_indices_to_codes(codes, cfg.levels)
+
+    GEN_DECODER_REGISTRY.register("cosmos", GenDecoder(
+        name="cosmos",
+        config_cls=c.CosmosConfig,
+        init_params=c.init_params,
+        encode_codes=encode_codes,
+        code_embeds=code_embeds,
+        decode=c.decode_code,
+        tokens_per_image=lambda cfg: cfg.tokens_per_image,
+        embed_dim=lambda cfg: len(cfg.levels),
+        codebook_size=lambda cfg: cfg.codebook_size,
+        image_size=lambda cfg: cfg.resolution,
+        trainable_tokenizer=False,
+    ))
+
+
 _register_movqgan()
 _register_janus_vq()
+_register_cosmos()
 
 
 def get_gen_decoder(name: str) -> GenDecoder:

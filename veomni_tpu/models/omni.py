@@ -77,6 +77,13 @@ class ImageGenConfig:
             self.movq = dec.config_cls()
         elif isinstance(self.movq, dict):
             self.movq = dec.config_cls(**self.movq)
+        if not self.freeze_tokenizer and not dec.trainable_tokenizer:
+            raise ValueError(
+                f"decoder {dec.name!r} has no trainable quantization "
+                "objective (implicit FSQ codebook) — freeze_tokenizer=False "
+                "would be a silent no-op; train it offline via its "
+                "reconstruction objective instead"
+            )
 
     @property
     def gen_decoder(self):
@@ -419,9 +426,10 @@ def omni_loss_fn(params, cfg: OmniConfig, batch) -> Tuple[jax.Array, Dict]:
         t_gen = icfg.tokens_per_image
         idx = codes.reshape(bi, mg, t_gen)           # codebook index per slot
         # the LM-side code embedding trains iff freeze_codebook is off
-        # (reference set_projector_trainable_only)
+        # (reference set_projector_trainable_only); FSQ decoders (cosmos)
+        # have an implicit codebook — nothing to freeze
         emb_p = dict(gp["movq"])
-        if icfg.freeze_codebook:
+        if icfg.freeze_codebook and "codebook" in emb_p:
             emb_p["codebook"] = jax.lax.stop_gradient(emb_p["codebook"])
         cb = dec.code_embeds(emb_p, icfg.movq, idx)  # [B, mg, T, e] f32
         al = jax.tree.map(lambda p: p.astype(tcfg.dtype), gp["aligner"])
