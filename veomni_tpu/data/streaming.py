@@ -69,9 +69,10 @@ class _ParquetShard:
         import pyarrow.parquet as pq
 
         self.path = path
-        self._pf = pq.ParquetFile(path)
-        counts = [self._pf.metadata.row_group(g).num_rows
-                  for g in range(self._pf.num_row_groups)]
+        pf = pq.ParquetFile(path)  # index only — no handle is retained
+        counts = [pf.metadata.row_group(g).num_rows
+                  for g in range(pf.num_row_groups)]
+        pf.close()
         self._bounds = np.cumsum([0] + counts)
         self._cached_group: Tuple[int, Optional[List[Dict[str, Any]]]] = (-1, None)
 
@@ -79,9 +80,12 @@ class _ParquetShard:
         return int(self._bounds[-1])
 
     def read(self, i: int) -> Dict[str, Any]:
+        import pyarrow.parquet as pq
+
         g = int(np.searchsorted(self._bounds, i, side="right") - 1)
         if self._cached_group[0] != g:
-            self._cached_group = (g, self._pf.read_row_group(g).to_pylist())
+            with pq.ParquetFile(self.path) as pf:
+                self._cached_group = (g, pf.read_row_group(g).to_pylist())
         return self._cached_group[1][i - int(self._bounds[g])]
 
 
@@ -254,16 +258,25 @@ class StreamingShardDataset:
         self._rec_pos = int(state.get("rec_pos", 0))
 
     # -- random access (weighted mixing) ------------------------------------
+    def _bounds(self):
+        """Cumulative record bounds over shards; built ONCE on the first
+        random access (random access inherently needs every shard's length —
+        the sequential __iter__ path stays lazy)."""
+        if not hasattr(self, "_bounds_cache"):
+            self._bounds_cache = np.cumsum(
+                [0] + [self._shard_len(s) for s in self.shards]
+            )
+        return self._bounds_cache
+
     def __len__(self) -> int:
-        return sum(self._shard_len(s) for s in self.shards)
+        return int(self._bounds()[-1])
 
     def __getitem__(self, idx: int) -> Dict[str, Any]:
         """Linear (epoch-0, unshuffled, all-rank) order — lets a streaming
         source plug into WeightedMultiSourceDataset's cursor mixing."""
-        for s in self.shards:
-            n = self._shard_len(s)
-            if idx < n:
-                row = self._reader(s).read(idx)
-                return self.transform(row) if self.transform else row
-            idx -= n
-        raise IndexError(idx)
+        b = self._bounds()
+        if idx < 0 or idx >= b[-1]:
+            raise IndexError(idx)
+        si = int(np.searchsorted(b, idx, side="right") - 1)
+        row = self._reader(self.shards[si]).read(idx - int(b[si]))
+        return self.transform(row) if self.transform else row

@@ -265,7 +265,8 @@ def flux_forward(params, cfg: FluxConfig, latents, timestep, text_states,
                  pooled_text, guidance=None, text_mask=None,
                  img_shape: Tuple[int, int] = None):
     """latents [B, N_img, in_channels] (pre-patchified, N_img = h*w of
-    ``img_shape``); timestep [B] (0..1 flow-matching sigma); text_states
+    ``img_shape``); timestep [B] in EMBEDDING scale (flow sigma x 1000 —
+    the WanCollator/diffusers convention); text_states
     [B, Lt, joint_dim]; pooled_text [B, pooled_dim]; guidance [B] (-dev) ->
     prediction [B, N_img, in_channels]."""
     p = jax.tree.map(lambda t: t.astype(cfg.dtype), params)

@@ -386,7 +386,7 @@ def _standard_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, wi
 
 
 def _dsa_bias(x, lp, cfg: TransformerConfig, cos, sin, segment_ids):
-    """DSA lightning-indexer top-k additive mask [B,S,S] (glm_moe_dsa;
+    """DSA lightning-indexer top-k KEEP mask [B,S,S] bool (glm_moe_dsa;
     reference ``GlmMoeDsaIndexer`` at ``glm_moe_dsa/generated/...:123``).
 
     The indexer runs no-grad (``@torch.no_grad`` upstream): token selection
@@ -423,10 +423,9 @@ def _dsa_bias(x, lp, cfg: TransformerConfig, cos, sin, segment_ids):
     index_scores = jnp.where(allowed, index_scores, -jnp.inf)
     top_k = min(cfg.index_topk, s)
     kth = jax.lax.top_k(index_scores, top_k)[0][..., -1:]
-    keep = (index_scores >= kth) & allowed
-    return jax.lax.stop_gradient(
-        jnp.where(keep, 0.0, -jnp.inf).astype(jnp.float32)
-    )
+    # boolean keep mask (NOT an additive bias): 4x smaller as a scan carry
+    # and consumable by the chunked attention's mask_mod hook at long S
+    return jax.lax.stop_gradient((index_scores >= kth) & allowed)
 
 
 def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window,
@@ -463,18 +462,22 @@ def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window,
 
     scale = (dn + dr) ** -0.5 * yarn_attention_factor(cfg.rope_scaling, dr)
     if dsa_bias is not None:
-        from veomni_tpu.ops.attention import _attention_dense
+        from veomni_tpu.ops.attention import _attention_xla
         from veomni_tpu.parallel.parallel_state import get_parallel_state_or_none
 
         ps = get_parallel_state_or_none()
         if ps is not None and (ps.ulysses_size > 1 or ps.cp_size > 1):
             raise NotImplementedError(
                 "DSA sparse attention under ulysses/ring SP: gather-based "
-                "bias plumbing is a follow-up; run DSA models with sp=1"
+                "mask plumbing is a follow-up; run DSA models with sp=1"
             )
-        attn = _attention_dense(
+        # the boolean keep mask rides the mask_mod hook, so long sequences
+        # take the blockwise online-softmax path instead of materializing
+        # a dense [B,H,S,S] score tensor
+        attn = _attention_xla(
             q, k, v, segment_ids=segment_ids, causal=True,
-            softmax_scale=scale, sliding_window=window, bias=dsa_bias,
+            softmax_scale=scale, sliding_window=window,
+            mask_mod=lambda qi, ki: dsa_bias[:, qi, ki],
         )
     else:
         attn = ops.attention(
@@ -675,8 +678,13 @@ def forward_hidden(
     # carry (threaded across run/segment boundaries, zeros before the first
     # indexer) only exists when the config actually has shared layers —
     # all-"full" DSA configs keep the plain scan
+    if cfg.use_dsa and tuple(cfg.indexer_types or ())[:1] == ("shared",):
+        raise ValueError(
+            "indexer_types[0] == 'shared' has no provider layer — the "
+            "first DSA layer would silently reuse an all-pass mask"
+        )
     dsa_carry = (
-        jnp.zeros((hidden.shape[0], hidden.shape[1], hidden.shape[1]), jnp.float32)
+        jnp.zeros((hidden.shape[0], hidden.shape[1], hidden.shape[1]), bool)
         if cfg.use_dsa and "shared" in tuple(cfg.indexer_types or ())
         else None
     )

@@ -39,6 +39,19 @@ def _repeat_kv(k, n_rep: int):
     return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
 
 
+def _normalize_mask_mod(mm):
+    """Accept [Sq,Sk] / [B,Sq,Sk] / [B,H,Sq,Sk] mask_mod results and lift
+    them to the [B,H,q,k]-broadcastable rank used by every impl."""
+    import jax.numpy as _jnp
+
+    mm = _jnp.asarray(mm)
+    if mm.ndim == 3:
+        mm = mm[:, None]
+    while mm.ndim < 4:
+        mm = mm[None]
+    return mm
+
+
 def _best_chunk(n: int, target: int) -> int:
     """Largest divisor of n that is <= target (chunked attention block size)."""
     best = 1
@@ -116,12 +129,7 @@ def _attention_xla_chunked(
         if seg_q is not None:
             mask = mask & (sq_i[:, None, :, None] == seg_k[:, j][:, None, None, :])
         if mask_mod is not None:
-            mm = jnp.asarray(mask_mod(qpos, kpos))
-            if mm.ndim == 3:
-                mm = mm[:, None]
-            while mm.ndim < 4:
-                mm = mm[None]
-            mask = mask & mm
+            mask = mask & _normalize_mask_mod(mask_mod(qpos, kpos))
         s_blk = jnp.where(mask, s_blk, neg)
         m_new = jnp.maximum(m, s_blk.max(-1))
         p = jnp.where(mask, jnp.exp(s_blk - m_new[..., None]), 0.0)
@@ -238,11 +246,7 @@ def _attention_xla_twopass(
             seg = seg_qi[:, None, :, None] == seg_k[:, None, None, :]
             mask = seg if mask is None else (mask & seg)
         if mask_mod is not None:
-            mm = jnp.asarray(mask_mod(qpos, kpos))
-            if mm.ndim == 3:
-                mm = mm[:, None]
-            while mm.ndim < 4:
-                mm = mm[None]
+            mm = _normalize_mask_mod(mask_mod(qpos, kpos))
             mask = mm if mask is None else (mask & mm)
 
         def scores():
@@ -357,13 +361,9 @@ def _attention_dense(
         seg = jnp.swapaxes(seg, -1, -2)  # [B,1,q,k]
         mask = seg if mask is None else (mask & seg)
     if mask_mod is not None:
-        # [Sq,Sk] / [B,Sq,Sk] / [B,H,Sq,Sk] results all broadcast into the
-        # [B,H,q,k] mask; batch-shaped results get a head axis inserted
-        mm = jnp.asarray(mask_mod(jnp.arange(sq)[:, None], jnp.arange(sk)[None, :]))
-        if mm.ndim == 3:
-            mm = mm[:, None]
-        while mm.ndim < 4:
-            mm = mm[None]
+        mm = _normalize_mask_mod(
+            mask_mod(jnp.arange(sq)[:, None], jnp.arange(sk)[None, :])
+        )
         mask = mm if mask is None else (mask & mm)
     if mask is not None:
         scores = jnp.where(mask, scores, -1e30)
