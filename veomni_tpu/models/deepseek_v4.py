@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from veomni_tpu import ops
+from veomni_tpu.models import transformer as core
 from veomni_tpu.ops.cross_entropy import fused_linear_cross_entropy
 from veomni_tpu.ops.rotary import _scale_inv_freq
 
@@ -102,6 +103,8 @@ class DeepseekV4Config:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    remat_policy: str = "nothing"  # dots | offload | nothing (trainer knob;
+    # "nothing" = full recompute, matching TransformerConfig's default)
 
     def __post_init__(self):
         if isinstance(self.dtype, str):
@@ -688,7 +691,7 @@ def forward_hidden(params: Params, cfg: DeepseekV4Config, input_ids,
                        segments=segment_ids, input_ids=input_ids,
                        layer_type=lt, mlp_type=mt)
         if cfg.remat:
-            body = jax.checkpoint(body)
+            body = jax.checkpoint(body, policy=core._remat_policy(cfg))
         streams, aux = jax.lax.scan(
             lambda c, lp: body(c, lp), streams, run_params
         )
