@@ -158,3 +158,50 @@ def test_hf_roundtrip(model, tmp_path):
             np.asarray(flat_a[k]).astype(np.float32),
             np.asarray(flat_b[k]).astype(np.float32), atol=0, err_msg=k,
         )
+
+
+def test_janus_trainer_e2e(tmp_path):
+    """Trainer drive: understanding + generation images through the omni
+    task path (JanusCollator, registry family, replicated VQ plan)."""
+    import json
+
+    from veomni_tpu.arguments import VeOmniArguments
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+    from veomni_tpu.trainer.omni_trainer import OmniTrainer
+
+    rng = np.random.default_rng(0)
+    with open(tmp_path / "data.jsonl", "w") as f:
+        for i in range(24):
+            row = {"input_ids": rng.integers(1, 500, int(rng.integers(10, 24))).tolist()}
+            if i % 2:
+                row["images"] = [rng.random((32, 32, 3)).tolist()]
+            if i % 3 == 0:
+                row["gen_images"] = [rng.random((8, 8, 3)).tolist()]
+            f.write(json.dumps(row) + "\n")
+
+    args = VeOmniArguments()
+    args.model.config_overrides = {
+        "model_type": "janus",
+        "text": dict(TEXT),
+        "vision": dict(VISION),
+        "gen_vision": dict(GEN),
+        "image_token_id": IMG_ID, "image_gen_token_id": GEN_ID,
+        "gen_head_embed": 48,
+    }
+    args.data.train_path = str(tmp_path / "data.jsonl")
+    args.data.max_seq_len = 96
+    args.train.output_dir = str(tmp_path / "out")
+    args.train.micro_batch_size = 1
+    args.train.train_steps = 3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.log_steps = 100
+    destroy_parallel_state()
+    try:
+        trainer = OmniTrainer(args)
+        ctl = trainer.train()
+        assert ctl.global_step == 3
+        assert np.isfinite(ctl.metrics["loss"])
+        trainer.checkpointer.close()
+    finally:
+        destroy_parallel_state()

@@ -204,6 +204,13 @@ def _register_vlm_families():
     # janus: unified understanding (SigLIP ViT) + generation (llamagen VQ)
     from veomni_tpu.models import janus as janus_mod
 
+    def _janus_plan(_cfg):
+        from veomni_tpu.parallel.parallel_plan import ParallelPlan
+
+        # replicate the (frozen) VQ tokenizer: GSPMD-partitioned conv
+        # kernels deadlock XLA:CPU's rendezvous and gain nothing on TPU
+        return ParallelPlan(rules={r"(^|\.)gen_vision\.": ()})
+
     MODEL_REGISTRY.register(
         "janus",
         ModelFamily(
@@ -215,6 +222,7 @@ def _register_vlm_families():
             forward_logits=None,
             hf_to_params=janus_mod.hf_to_params,
             save_hf_checkpoint=janus_mod.save_hf_checkpoint,
+            parallel_plan_fn=_janus_plan,
         ),
     )
 
@@ -297,6 +305,21 @@ def build_config(model_type: str = "", **overrides):
     nested text config so the same override surface works for both.
     """
     overrides.pop("model_type", None)
+    if model_type == "janus":
+        from veomni_tpu.models.janus import JanusConfig
+
+        kw = {
+            k: overrides.pop(k)
+            for k in ("vision", "gen_vision", "aligner_depth",
+                      "gen_aligner_depth", "gen_head_embed", "image_token_id",
+                      "image_gen_token_id", "gen_loss_weight", "freeze_vision",
+                      "freeze_gen_vision", "max_images", "max_gen_images")
+            if k in overrides
+        }
+        text = dict(overrides.pop("text", {}) or {})
+        text.update(overrides)
+        text.setdefault("model_type", "llama")
+        return JanusConfig(text=text, **kw)
     if model_type == "deepseek_v4":
         from veomni_tpu.models.deepseek_v4 import DeepseekV4Config
 

@@ -28,6 +28,21 @@ from veomni_tpu.models.omni import (
 from veomni_tpu.trainer.base import BaseTrainer
 
 
+def _finalize_row(out, i, ids, labels, s):
+    """Shared collator tail: truncate, next-token shift, place, mark live
+    (kept in ONE place so packing/truncation fixes can't diverge between
+    the omni and janus collators)."""
+    ids, labels = ids[:s], labels[:s]
+    shifted = np.concatenate(
+        [np.asarray(labels[1:], np.int32), [IGNORE_INDEX]]
+    ).astype(np.int32)
+    n = len(ids)
+    out["input_ids"][i, :n] = np.asarray(ids, np.int32)
+    out["labels"][i, :n] = shifted[:n]
+    out["position_ids"][i, :n] = np.arange(n)
+    out["segment_ids"][i, :n] = 1
+
+
 class OmniCollator:
     """Rows: tokenized text with modality placeholders + image/audio slots."""
 
@@ -101,15 +116,58 @@ class OmniCollator:
                     arr = load_image(gi, cfg.image_gen.image_size)
                     out["gen_pixels"][i, k] = arr * 2.0 - 1.0  # [0,1] -> [-1,1]
                     out["gen_image_mask"][i, k] = True
-            ids, labels = ids[:s], labels[:s]
-            shifted = np.concatenate(
-                [np.asarray(labels[1:], np.int32), [IGNORE_INDEX]]
-            ).astype(np.int32)
-            n = len(ids)
-            out["input_ids"][i, :n] = np.asarray(ids, np.int32)
-            out["labels"][i, :n] = shifted[:n]
-            out["position_ids"][i, :n] = np.arange(n)
-            out["segment_ids"][i, :n] = 1
+            _finalize_row(out, i, ids, labels, s)
+        return out
+
+
+class JanusCollator:
+    """Rows: tokenized text + understanding images + generation targets for
+    the janus composite (fixed slots; reference janus batch contract of
+    ``image_input_mask`` / ``image_output_mask`` becomes ordered slot
+    placeholders like the other composites)."""
+
+    def __init__(self, cfg, seq_len: int, micro_batch_size: int, sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError("seq_len % sp_size != 0")
+        self.cfg = cfg
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+
+    def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+        cfg = self.cfg
+        b, s = self.micro_batch_size, self.seq_len
+        r_in = cfg.vision.image_size
+        r_gen = cfg.gen_vision.image_size
+        out: Dict[str, np.ndarray] = {
+            "input_ids": np.zeros((b, s), np.int32),
+            "labels": np.full((b, s), IGNORE_INDEX, np.int32),
+            "position_ids": np.zeros((b, s), np.int32),
+            "segment_ids": np.zeros((b, s), np.int32),
+            "pixel_values": np.zeros((b, cfg.max_images, r_in, r_in, 3), np.float32),
+            "image_mask": np.zeros((b, cfg.max_images), bool),
+            "gen_pixels": np.zeros((b, cfg.max_gen_images, r_gen, r_gen, 3), np.float32),
+            "gen_image_mask": np.zeros((b, cfg.max_gen_images), bool),
+        }
+        for i, sample in enumerate(samples[:b]):
+            ids: list = []
+            labels: list = []
+            for k, im in enumerate(sample.get("images", [])[: cfg.max_images]):
+                t_img = cfg.vision.tokens_per_image
+                ids += [cfg.image_token_id] * t_img
+                labels += [IGNORE_INDEX] * t_img
+                # SigLIP normalization: (x - 0.5) / 0.5 (reference processor)
+                out["pixel_values"][i, k] = load_image(im, r_in) * 2.0 - 1.0
+                out["image_mask"][i, k] = True
+            text = list(sample["input_ids"])
+            ids += text
+            labels += list(sample.get("labels", text))
+            t_gen = cfg.gen_vision.tokens_per_image
+            for k, gi in enumerate(sample.get("gen_images", [])[: cfg.max_gen_images]):
+                ids += [cfg.image_gen_token_id] * t_gen
+                labels += [IGNORE_INDEX] * t_gen
+                out["gen_pixels"][i, k] = load_image(gi, r_gen) * 2.0 - 1.0
+                out["gen_image_mask"][i, k] = True
+            _finalize_row(out, i, ids, labels, s)
         return out
 
 
@@ -117,8 +175,10 @@ class OmniTrainer(BaseTrainer):
     def _build_model(self):
         overrides = dict(self.args.model.config_overrides)
         mt = overrides.pop("model_type", "") or self.args.model.model_type
-        if mt == "qwen3_omni_moe" or self.args.model.config_path:
-            # real thinker family: HF config / overrides via the registry path
+        if mt in ("qwen3_omni_moe", "janus") or self.args.model.config_path:
+            # registry families: HF config / overrides via the registry path
+            # (build_config has janus/qwen3_omni_moe cases, so every trainer
+            # knob — dtype, remat policy, ops impl — flows through)
             super()._build_model()
             return
         text = dict(overrides.pop("text", {}))
@@ -152,6 +212,10 @@ class OmniTrainer(BaseTrainer):
     @property
     def _is_qwen3_omni(self) -> bool:
         return self.model.config.model_type == "qwen3_omni_moe"
+
+    @property
+    def _is_janus(self) -> bool:
+        return self.model.config.model_type == "janus"
 
     @staticmethod
     def _save_native(params, cfg, out_dir):
@@ -218,6 +282,10 @@ class OmniTrainer(BaseTrainer):
                 max_audio_chunks=d.max_audio_chunks,
                 sp_size=ps.sp_size,
             )
+        elif self._is_janus:
+            collator = JanusCollator(
+                self.model.config, d.max_seq_len, local_mb, sp_size=ps.sp_size
+            )
         else:
             collator = OmniCollator(
                 self.model.config, d.max_seq_len, local_mb, sp_size=ps.sp_size
@@ -238,6 +306,17 @@ class OmniTrainer(BaseTrainer):
     def _batch_sharding_map(self):
         ps = self.parallel_state
         cfg = self.model.config
+        if self._is_janus:
+            return {
+                "input_ids": P(None, ps.dp_axes, ps.sp_axes),
+                "labels": P(None, ps.dp_axes, ps.sp_axes),
+                "position_ids": P(None, ps.dp_axes, ps.sp_axes),
+                "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
+                "pixel_values": P(None, ps.dp_axes, None, None, None, None),
+                "image_mask": P(None, ps.dp_axes, None),
+                "gen_pixels": P(None, ps.dp_axes, None, None, None, None),
+                "gen_image_mask": P(None, ps.dp_axes, None),
+            }
         if self._is_qwen3_omni:
             return {
                 "input_ids": P(None, ps.dp_axes, ps.sp_axes),
