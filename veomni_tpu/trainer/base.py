@@ -298,10 +298,12 @@ class BaseTrainer:
             base_params = jax.jit(make_base, out_shardings=param_shardings)(self.rng)
 
         if self.lora_config is not None:
-            if self.args.data.channel_list:
-                raise NotImplementedError("LoRA + channel_list not wired yet")
             # frozen base + trainable adapter tree (reference base.py:411-462)
-            from veomni_tpu.lora import apply_lora_to_loss_fn, init_lora_params
+            from veomni_tpu.lora import (
+                apply_lora_to_loss_fn,
+                init_lora_params,
+                merge_lora_params,
+            )
             from veomni_tpu.lora.lora import load_adapter, lora_parallel_plan_rules
             from veomni_tpu.parallel.parallel_plan import ParallelPlan
 
@@ -323,11 +325,13 @@ class BaseTrainer:
                 # on XLA:CPU) at step 2+
                 step=jax.device_put(jnp.int32(0), self.state_shardings.step),
             )
-            loss_fn = apply_lora_to_loss_fn(
-                lambda p, b: model.loss_fn(p, b), base_params
-            )
+            loss_fn = apply_lora_to_loss_fn(self._inner_loss_fn(model), base_params)
+            # subclass losses (DPO/RL) call this to turn whatever tree the
+            # train step optimizes into full model params (jit-traceable)
+            self.merge_params = lambda p: merge_lora_params(base_params, p)
         else:
             self.base_params = None
+            self.merge_params = lambda p: p
             self.optimizer = _make_optimizer(jax.eval_shape(lambda: base_params))
             abs_state = jax.eval_shape(
                 lambda p: build_train_state(p, self.optimizer), base_params
@@ -342,17 +346,7 @@ class BaseTrainer:
                 # committed: see the LoRA branch note on jit signature drift
                 step=jax.device_put(jnp.int32(0), self.state_shardings.step),
             )
-            if self.args.data.channel_list:
-                from veomni_tpu.train.channel_loss import make_channel_loss_fn
-
-                if "embed_tokens" not in model.abstract():
-                    raise NotImplementedError(
-                        "data.channel_list is only wired for text-family "
-                        "models (composite VLM/omni param trees unsupported)"
-                    )
-                loss_fn = make_channel_loss_fn(model, len(self.args.data.channel_list))
-            else:
-                loss_fn = lambda params, batch: model.loss_fn(params, batch)
+            loss_fn = self._inner_loss_fn(model)
 
         self.batch_shardings = {
             k: NamedSharding(ps.mesh, spec)
@@ -372,6 +366,7 @@ class BaseTrainer:
                 ),
                 self.abstract_state.params,
             )
+        self.grad_mask = grad_mask  # subclass train_step rebuilds reuse it
         self.train_step = build_train_step(
             loss_fn, self.optimizer, ps,
             state_shardings=self.state_shardings,
@@ -390,6 +385,19 @@ class BaseTrainer:
             async_save=t.async_save,
             max_to_keep=t.max_ckpt_to_keep,
         )
+
+    def _inner_loss_fn(self, model):
+        """Loss over FULL model params (LoRA merge, if any, wraps outside)."""
+        if self.args.data.channel_list:
+            from veomni_tpu.train.channel_loss import make_channel_loss_fn
+
+            if "embed_tokens" not in model.abstract():
+                raise NotImplementedError(
+                    "data.channel_list is only wired for text-family "
+                    "models (composite VLM/omni param trees unsupported)"
+                )
+            return make_channel_loss_fn(model, len(self.args.data.channel_list))
+        return lambda params, batch: model.loss_fn(params, batch)
 
     def _init_callbacks(self):
         t = self.args.train

@@ -127,20 +127,31 @@ class TextDPOTrainer(BaseTrainer):
         )
 
     def _build_parallelized_state(self):
-        if self.args.model.lora:
-            raise NotImplementedError(
-                "DPO + LoRA is not wired yet (adapter-tree params would need "
-                "a merged forward for both policy and reference)"
-            )
         super()._build_parallelized_state()
-        # frozen reference policy = detached copy of the initial params
-        # (kept un-donated: the train state owns its own buffers)
-        self.ref_params = jax.tree.map(jnp.copy, self.train_state.params)
+        if self.lora_config is not None:
+            if self.args.model.lora_adapter_path:
+                # the INITIAL policy includes the loaded (nonzero) adapter —
+                # the reference must anchor there, not at the bare base
+                from veomni_tpu.lora import merge_lora_params
+
+                self.ref_params = jax.jit(merge_lora_params)(
+                    self.base_params, self.train_state.params
+                )
+            else:
+                # fresh adapter has B=0, so adapters-off base IS the frozen
+                # reference policy (cf. reference lora/model.py:101 adapter-
+                # disable for ref logprobs; zero extra memory)
+                self.ref_params = self.base_params
+        else:
+            # frozen reference policy = detached copy of the initial params
+            # (kept un-donated: the train state owns its own buffers)
+            self.ref_params = jax.tree.map(jnp.copy, self.train_state.params)
         model, cfg = self.model, self.model.config
         beta = float(self.args.train.dpo_beta)
+        merge = self.merge_params
 
         def dpo_loss(params, batch):
-            logps = sequence_logprob_sums(params, cfg, batch)           # [2P]
+            logps = sequence_logprob_sums(merge(params), cfg, batch)    # [2P]
             ref_logps = sequence_logprob_sums(
                 jax.lax.stop_gradient(self.ref_params), cfg, batch
             )
@@ -153,9 +164,11 @@ class TextDPOTrainer(BaseTrainer):
 
         from veomni_tpu.train import build_train_step
 
+        self._loss_fn = dpo_loss  # evaluate() must score the DPO objective
         self.train_step = build_train_step(
             dpo_loss, self.optimizer, self.parallel_state,
             state_shardings=self.state_shardings,
             batch_shardings=self.batch_shardings,
             max_grad_norm=self.args.train.max_grad_norm,
+            grad_mask=self.grad_mask,
         )

@@ -125,13 +125,13 @@ class BaseRLTrainer(BaseTrainer):
         return base
 
     def _build_parallelized_state(self):
-        if self.args.model.lora:
-            raise NotImplementedError("RL + LoRA not wired yet")
         super()._build_parallelized_state()
         model, cfg = self.model, self.model.config
         eps = float(self.args.train.ppo_clip_ratio)
+        merge = self.merge_params
 
         def rl_loss(params, batch):
+            params = merge(params)
             hidden, _, _ = transformer.forward_hidden(
                 params, cfg, batch["input_ids"], batch["position_ids"],
                 batch.get("segment_ids"),
@@ -160,9 +160,11 @@ class BaseRLTrainer(BaseTrainer):
 
         from veomni_tpu.train import build_train_step
 
+        self._loss_fn = rl_loss  # evaluate() must score the RL objective
         self.train_step = build_train_step(
             rl_loss, self.optimizer, self.parallel_state,
             state_shardings=self.state_shardings,
             batch_shardings=self.batch_shardings,
             max_grad_norm=self.args.train.max_grad_norm,
+            grad_mask=self.grad_mask,
         )
