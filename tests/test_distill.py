@@ -1,0 +1,208 @@
+"""Top-k distillation loss: chunked op parity + trainer e2e.
+
+Reference semantics: ``veomni/ops/kernels/cross_entropy/chunk_topk_distill.py``
+(forward KL on the teacher's top-k support; log_probs/entropy shared with the
+chunk_logprobs path; mass terms metrics-only).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veomni_tpu.ops.cross_entropy import IGNORE_INDEX, _topk_distill_chunked
+
+
+def _dense_reference(hidden, kernel, labels, t_ids, t_lp, temperature=1.0,
+                     clamp=None):
+    """Unchunked direct computation of all five outputs."""
+    logits = (hidden.astype(jnp.float32) @ kernel.astype(jnp.float32))
+    if temperature != 1.0:
+        logits = logits / temperature
+    valid = labels != IGNORE_INDEX
+    lab = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    gold = jnp.take_along_axis(logp, lab[:, None], 1)[:, 0]
+    p = jnp.exp(logp)
+    ent = -(p * logp).sum(-1)
+    s_lp = jnp.take_along_axis(logp, t_ids, 1)
+    t32 = t_lp.astype(jnp.float32)
+    if clamp is not None:
+        s_lp = jnp.maximum(s_lp, clamp)
+        t32 = jnp.maximum(t32, clamp)
+    pt = jnp.exp(t32)
+    dist = (pt * (t32 - s_lp)).sum(-1)
+    z = jnp.zeros_like(gold)
+    raw_logp = jax.nn.log_softmax(
+        hidden.astype(jnp.float32) @ kernel.astype(jnp.float32), axis=-1
+    )
+    raw_gold = jnp.take_along_axis(raw_logp, lab[:, None], 1)[:, 0]
+    return {
+        "nll": jnp.where(valid, -raw_gold, z),
+        "log_probs": jnp.where(valid, gold, z),
+        "entropy": jnp.where(valid, ent, z),
+        "distill": jnp.where(valid, dist, z),
+        "student_mass": jax.lax.stop_gradient(
+            jnp.where(valid, jnp.exp(s_lp).sum(-1), z)
+        ),
+        "teacher_mass": jax.lax.stop_gradient(jnp.where(valid, pt.sum(-1), z)),
+    }
+
+
+def _make_inputs(t=37, h=16, v=64, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    hidden = jnp.asarray(rng.normal(size=(t, h)), jnp.float32)
+    kernel = jnp.asarray(rng.normal(size=(h, v)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, t), jnp.int32)
+    labels = labels.at[::7].set(IGNORE_INDEX)  # sprinkle ignored positions
+    t_ids = jnp.asarray(
+        np.stack([rng.choice(v, k, replace=False) for _ in range(t)]), jnp.int32
+    )
+    # a proper sub-distribution: softmax logprobs restricted to k slots
+    raw = rng.normal(size=(t, k))
+    t_lp = jnp.asarray(raw - np.log(np.exp(raw).sum(-1, keepdims=True)) - 0.3,
+                       jnp.float32)
+    return hidden, kernel, labels, t_ids, t_lp
+
+
+@pytest.mark.parametrize("chunk", [8, 64])  # 37 % 8 != 0 exercises padding
+def test_distill_chunked_matches_dense(chunk):
+    hidden, kernel, labels, t_ids, t_lp = _make_inputs()
+    got = _topk_distill_chunked(
+        hidden, kernel, labels, t_ids, t_lp, chunk_size=chunk
+    )
+    want = _dense_reference(hidden, kernel, labels, t_ids, t_lp)
+    for name in want:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]), rtol=1e-5,
+            atol=1e-5, err_msg=name,
+        )
+    # sign contracts (reference docstring): logp <= 0, entropy/KL/mass >= 0
+    assert float(jnp.max(got["log_probs"])) <= 1e-6
+    assert float(jnp.min(got["entropy"])) >= -1e-6
+    assert float(jnp.min(got["distill"])) >= -1e-5
+
+
+def test_distill_grads_match_dense_and_mass_detached():
+    hidden, kernel, labels, t_ids, t_lp = _make_inputs()
+
+    def total(fn):
+        def f(h, w):
+            out = fn(h, w)
+            # mass terms are stop_gradient'ed; including them must not
+            # perturb the gradient of the differentiable outputs
+            return (out["distill"].sum() + 0.1 * out["log_probs"].sum()
+                    + out["student_mass"].sum())
+        return f
+
+    g_chunk = jax.grad(total(
+        lambda h, w: _topk_distill_chunked(h, w, labels, t_ids, t_lp,
+                                           chunk_size=8)), argnums=(0, 1)
+    )(hidden, kernel)
+    g_dense = jax.grad(total(
+        lambda h, w: _dense_reference(h, w, labels, t_ids, t_lp)),
+        argnums=(0, 1)
+    )(hidden, kernel)
+    for gc, gd in zip(g_chunk, g_dense):
+        np.testing.assert_allclose(np.asarray(gc), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-5)
+    # ignored positions carry zero hidden-gradient
+    ignored = np.asarray(labels) == IGNORE_INDEX
+    assert float(jnp.abs(g_chunk[0][ignored]).max()) == 0.0
+
+
+def test_distill_temperature_and_clamp():
+    hidden, kernel, labels, t_ids, t_lp = _make_inputs()
+    for kw in ({"temperature": 2.0}, {"log_prob_min_clamp": -1.5}):
+        got = _topk_distill_chunked(
+            hidden, kernel, labels, t_ids, t_lp, chunk_size=16,
+            **kw,
+        )
+        want = _dense_reference(
+            hidden, kernel, labels, t_ids, t_lp,
+            temperature=kw.get("temperature", 1.0),
+            clamp=kw.get("log_prob_min_clamp"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got["distill"]), np.asarray(want["distill"]),
+            rtol=1e-5, atol=1e-5,
+        )
+    # perfectly matching teacher ==> zero KL on the support
+    logits = hidden @ kernel
+    logp = jax.nn.log_softmax(logits, -1)
+    ids = jnp.argsort(-logits, axis=-1)[:, :4].astype(jnp.int32)
+    perfect = jnp.take_along_axis(logp, ids, 1)
+    out = _topk_distill_chunked(hidden, kernel, labels, ids, perfect,
+                                chunk_size=16)
+    np.testing.assert_allclose(np.asarray(out["distill"]), 0.0, atol=1e-5)
+
+
+def test_distill_collator_ragged_teacher():
+    """Rows with fewer teacher columns than distill_topk (or fewer teacher
+    tokens than input tokens) fill with zero-weight slots, not a crash."""
+    from veomni_tpu.trainer.distill_trainer import DistillCollator
+
+    col = DistillCollator(seq_len=16, micro_batch_size=1, topk=8)
+    batch = col([{
+        "input_ids": list(range(10)),
+        "teacher_topk_ids": [[1, 2]] * 6,          # 2 cols < topk, 6 tok < 10
+        "teacher_topk_log_probs": [[-0.5, -1.0]] * 6,
+    }])
+    assert batch["teacher_topk_ids"].shape == (1, 16, 8)
+    # present slots kept, absent slots carry ~zero probability mass
+    assert batch["teacher_topk_log_probs"][0, 0, 0] == -0.5
+    assert np.exp(batch["teacher_topk_log_probs"][0, 0, 7]) == 0.0
+    assert np.exp(batch["teacher_topk_log_probs"][0, 9, 0]) == 0.0
+    with pytest.raises(ValueError, match="shape mismatch"):
+        col([{
+            "input_ids": [1, 2, 3],
+            "teacher_topk_ids": [[1]] * 3,
+            "teacher_topk_log_probs": [[-0.5, -1.0]] * 3,
+        }])
+
+
+def test_distill_trainer_e2e(tmp_path):
+    from veomni_tpu.arguments import VeOmniArguments
+    from veomni_tpu.trainer.distill_trainer import DistillTrainer
+
+    rng = np.random.default_rng(0)
+    v, k = 128, 4
+    with open(tmp_path / "distill.jsonl", "w") as f:
+        for _ in range(32):
+            n = int(rng.integers(8, 24))
+            lp = rng.normal(size=(n, k))
+            lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True)) - 0.2
+            f.write(json.dumps({
+                "input_ids": rng.integers(0, v, n).tolist(),
+                "teacher_topk_ids": rng.integers(0, v, (n, k)).tolist(),
+                "teacher_topk_log_probs": lp.tolist(),
+            }) + "\n")
+
+    args = VeOmniArguments()
+    args.model.config_overrides = {
+        "model_type": "qwen2", "vocab_size": v, "hidden_size": 32,
+        "intermediate_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 8,
+        "attention_bias": True,
+    }
+    args.data.train_path = str(tmp_path / "distill.jsonl")
+    args.data.data_type = "distill"
+    args.data.max_seq_len = 32
+    args.train.output_dir = str(tmp_path / "out")
+    args.train.micro_batch_size = 2
+    args.train.train_steps = 3
+    args.train.distill_topk = k
+    args.train.distill_kl_coef = 0.5
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.save_hf_weights = False
+    args.train.log_steps = 100
+    trainer = DistillTrainer(args)
+    ctl = trainer.train()
+    assert ctl.global_step == 3
+    assert np.isfinite(ctl.metrics["loss"])
+    assert np.isfinite(ctl.metrics["distill_kl"])
+    assert 0.0 < ctl.metrics["teacher_mass"] <= 1.0 + 1e-5
+    trainer.checkpointer.close()

@@ -94,6 +94,10 @@ class TrainingArguments:
     module_lr_scales: Dict[str, float] = field(default_factory=dict)
     dpo_beta: float = 0.1
     ppo_clip_ratio: float = 0.2
+    # top-k distillation (trainer/distill_trainer.py)
+    distill_topk: int = 8
+    distill_kl_coef: float = 1.0
+    distill_temperature: float = 1.0
     # schedule/steps
     train_steps: int = 0              # 0 -> derive from epochs * len(dataloader)
     num_train_epochs: int = 1

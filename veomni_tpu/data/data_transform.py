@@ -83,6 +83,7 @@ _LAZY_TRANSFORM_MODULES = {
     "qwen2_5_vl_conversation": "veomni_tpu.data.multimodal",
     "rl": "veomni_tpu.trainer.rl_trainer",
     "dpo": "veomni_tpu.trainer.dpo_trainer",
+    "distill": "veomni_tpu.trainer.distill_trainer",
 }
 
 

@@ -22,6 +22,7 @@ rotary_tables = _rotary.rotary_tables
 swiglu = _swiglu.swiglu
 attention = _attention.attention
 fused_linear_cross_entropy = _cross_entropy.fused_linear_cross_entropy
+fused_linear_topk_distill = _cross_entropy.fused_linear_topk_distill
 load_balancing_loss = _load_balancing.load_balancing_loss
 group_gemm = _group_gemm.group_gemm
 
@@ -35,6 +36,7 @@ __all__ = [
     "swiglu",
     "attention",
     "fused_linear_cross_entropy",
+    "fused_linear_topk_distill",
     "load_balancing_loss",
     "group_gemm",
 ]

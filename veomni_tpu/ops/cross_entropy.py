@@ -94,3 +94,110 @@ def fused_linear_cross_entropy_per_token(
 
 def fused_linear_cross_entropy(hidden, kernel, labels, **kwargs):
     return resolve_op("fused_linear_cross_entropy")(hidden, kernel, labels, **kwargs)
+
+
+# --------------------------------------------------------------- distillation
+def _chunk_distill_body(
+    h, lab, t_ids, t_lp, kernel, temperature, log_prob_min_clamp
+):
+    """One token-chunk of the top-k forward-KL distillation outputs.
+
+    Semantics follow the reference ``chunk_topk_distill_function``
+    (``ops/kernels/cross_entropy/chunk_topk_distill.py:329``): student top-k
+    log-probs are gathered at the teacher's ids, the KL is computed on that
+    support, and the mass terms are metrics-only (stop_gradient). The
+    reference hand-writes a three-path autograd backward; here the chunk body
+    is plain jnp under ``jax.checkpoint`` and JAX derives the same closed
+    form."""
+    logits = jnp.dot(h, kernel, preferred_element_type=jnp.float32)  # [C, V]
+    valid = lab != IGNORE_INDEX
+    lab_safe = jnp.where(valid, lab, 0)
+    # untempered gold NLL rides along so CE+KL trainers need only this one
+    # [C,V] projection (the matmul dominates; the extra logsumexp is noise)
+    raw_logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    raw_gold = jnp.take_along_axis(logits, lab_safe[:, None], axis=-1)[:, 0]
+    nll = jnp.where(valid, raw_logz - raw_gold, 0.0)
+    if temperature != 1.0:
+        logits = logits / temperature
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)          # [C]
+        gold = jnp.take_along_axis(logits, lab_safe[:, None], axis=-1)[:, 0]
+    else:
+        logz, gold = raw_logz, raw_gold
+    log_probs = jnp.where(valid, gold - logz, 0.0)
+    probs = jax.nn.softmax(logits, axis=-1)
+    entropy = jnp.where(valid, logz - (probs * logits).sum(-1), 0.0)
+
+    s_lp = jnp.take_along_axis(logits, t_ids, axis=-1) - logz[:, None]  # [C, K]
+    t_lp32 = t_lp.astype(jnp.float32)
+    if log_prob_min_clamp is not None:
+        s_lp = jnp.maximum(s_lp, log_prob_min_clamp)
+        t_lp32 = jnp.maximum(t_lp32, log_prob_min_clamp)
+    p_teacher = jnp.exp(t_lp32)
+    distill = jnp.where(valid, (p_teacher * (t_lp32 - s_lp)).sum(-1), 0.0)
+    student_mass = jnp.where(valid, jnp.exp(s_lp).sum(-1), 0.0)
+    teacher_mass = jnp.where(valid, p_teacher.sum(-1), 0.0)
+    return (
+        log_probs,
+        entropy,
+        distill,
+        jax.lax.stop_gradient(student_mass),
+        jax.lax.stop_gradient(teacher_mass),
+        nll,
+    )
+
+
+@KERNEL_REGISTRY.register("fused_linear_topk_distill", "xla_chunked", priority=1)
+def _topk_distill_chunked(
+    hidden, kernel, labels, teacher_topk_ids, teacher_topk_log_probs, *,
+    chunk_size: int = 1024, temperature: float = 1.0,
+    log_prob_min_clamp: Optional[float] = None,
+):
+    """Chunked fused-linear top-k forward-KL distillation + logprobs + entropy.
+
+    hidden [T,H], kernel [H,V], labels [T] (pre-shifted — the repo's collators
+    emit next-token-aligned labels, so no internal causal shift; the
+    reference's un-shifted entry branch corresponds to its HF-style callers),
+    teacher_topk_ids/log_probs [T,K] aligned with labels.
+
+    Returns a dict of per-token [T] fp32 arrays: ``log_probs`` (gold-label,
+    non-positive, tempered), ``entropy`` (non-negative), ``distill`` (forward
+    KL on the top-k support, non-negative up to clamp effects),
+    ``student_mass`` / ``teacher_mass`` (metrics-only, no grad), and ``nll``
+    (UNtempered gold NLL — the CE term for CE+KL objectives, sharing the one
+    [T,V] projection). All are 0 at ignored positions.
+    """
+    t, hdim = hidden.shape
+    chunk = min(chunk_size, t)
+    pad = (-t) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=IGNORE_INDEX)
+        teacher_topk_ids = jnp.pad(teacher_topk_ids, ((0, pad), (0, 0)))
+        teacher_topk_log_probs = jnp.pad(
+            teacher_topk_log_probs, ((0, pad), (0, 0))
+        )
+    n = (t + pad) // chunk
+    body = jax.checkpoint(partial(
+        _chunk_distill_body, kernel=kernel, temperature=temperature,
+        log_prob_min_clamp=log_prob_min_clamp,
+    ))
+    outs = jax.lax.map(
+        lambda args: body(*args),
+        (
+            hidden.reshape(n, chunk, hdim),
+            labels.reshape(n, chunk),
+            teacher_topk_ids.reshape(n, chunk, -1),
+            teacher_topk_log_probs.reshape(n, chunk, -1),
+        ),
+    )
+    names = ("log_probs", "entropy", "distill", "student_mass",
+             "teacher_mass", "nll")
+    return {k: v.reshape(-1)[:t] for k, v in zip(names, outs)}
+
+
+def fused_linear_topk_distill(hidden, kernel, labels, teacher_topk_ids,
+                              teacher_topk_log_probs, **kwargs):
+    return resolve_op("fused_linear_topk_distill")(
+        hidden, kernel, labels, teacher_topk_ids, teacher_topk_log_probs,
+        **kwargs
+    )

@@ -20,8 +20,12 @@ def __getattr__(name):  # lazy: the heavier trainers pull optional deps
         from veomni_tpu.trainer.rl_trainer import RLTrainer
 
         return RLTrainer
+    if name == "DistillTrainer":
+        from veomni_tpu.trainer.distill_trainer import DistillTrainer
+
+        return DistillTrainer
     raise AttributeError(name)
 
 
 __all__ = ["BaseTrainer", "TextTrainer", "VLMTrainer", "OmniTrainer",
-           "DiTTrainer", "DPOTrainer", "RLTrainer"]
+           "DiTTrainer", "DPOTrainer", "RLTrainer", "DistillTrainer"]

@@ -1,0 +1,192 @@
+"""Top-k distillation trainer: CE + forward-KL against teacher top-k.
+
+Reference: the distillation path of ``veomni/ops/kernels/cross_entropy``
+(``chunk_topk_distill.py``), consumed there through verl's engine with
+``distillation_use_topk=True``; here the same loss surface is a first-class
+trainer so a dataset of (tokens, teacher top-k ids, teacher top-k logprobs)
+trains directly:  L = CE + kl_coef * sum_t KL(p_teacher || q_student).
+
+Rows: {"input_ids": [...], "teacher_topk_ids": [[K]*T], and
+"teacher_topk_log_probs": [[K]*T]} — teacher arrays aligned per input token t
+with the prediction made AT t (i.e. of token t+1), matching the collator's
+label shift.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.data.data_collator import IGNORE_INDEX
+from veomni_tpu.data.data_transform import DATA_TRANSFORM_REGISTRY
+from veomni_tpu.models import transformer
+from veomni_tpu.ops import fused_linear_topk_distill
+from veomni_tpu.trainer.base import BaseTrainer
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@DATA_TRANSFORM_REGISTRY.register("distill")
+def build_distill_transform(tokenizer=None, max_seq_len: int = 0, **_):
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        ids = list(row["input_ids"])[: max_seq_len or None]
+        n = len(ids)
+        return {
+            "input_ids": ids,
+            "teacher_topk_ids": [list(r) for r in row["teacher_topk_ids"][:n]],
+            "teacher_topk_log_probs": [
+                list(r) for r in row["teacher_topk_log_probs"][:n]
+            ],
+        }
+
+    return transform
+
+
+class DistillCollator:
+    """One sample per row [B, S]; teacher arrays ride as [B, S, K].
+
+    The label at position t is input_ids[t+1] (causal shift). The teacher
+    tensors arrive already aligned with the PREDICTION at t, so they are
+    placed unshifted at 0..n-1 — exactly the alignment the reference's
+    shifted-labels branch expects (``chunk_topk_distill_function``)."""
+
+    def __init__(self, seq_len: int, micro_batch_size: int, topk: int,
+                 sp_size: int = 1):
+        if seq_len % max(sp_size, 1):
+            raise ValueError("seq_len % sp_size != 0")
+        self.seq_len = seq_len
+        self.micro_batch_size = micro_batch_size
+        self.topk = topk
+
+    # log-prob for absent teacher slots: exp(-1e9) == 0, so filled positions
+    # and columns contribute nothing to the KL or the mass metrics
+    NO_TEACHER = -1e9
+
+    def __call__(self, samples):
+        b, s, k = self.micro_batch_size, self.seq_len, self.topk
+        out = {
+            "input_ids": np.zeros((b, s), np.int32),
+            "labels": np.full((b, s), IGNORE_INDEX, np.int32),
+            "position_ids": np.zeros((b, s), np.int32),
+            "segment_ids": np.zeros((b, s), np.int32),
+            "teacher_topk_ids": np.zeros((b, s, k), np.int32),
+            "teacher_topk_log_probs": np.full(
+                (b, s, k), self.NO_TEACHER, np.float32
+            ),
+        }
+        for i, sample in enumerate(samples[: b]):
+            ids = np.asarray(sample["input_ids"], np.int32)[:s]
+            n = len(ids)
+            t_ids = np.asarray(sample["teacher_topk_ids"], np.int32)
+            t_lp = np.asarray(sample["teacher_topk_log_probs"], np.float32)
+            if t_ids.shape != t_lp.shape:
+                raise ValueError(
+                    f"teacher_topk_ids {t_ids.shape} vs teacher_topk_log_probs "
+                    f"{t_lp.shape} shape mismatch in sample {i}"
+                )
+            # ragged teacher data (fewer tokens than input_ids, or fewer
+            # columns than train.distill_topk) fills with zero-weight slots
+            # instead of crashing mid-epoch on a broadcast error
+            nt = min(n, t_ids.shape[0])
+            kt = min(k, t_ids.shape[1]) if t_ids.ndim == 2 else 0
+            out["input_ids"][i, :n] = ids
+            out["labels"][i, : n - 1] = ids[1:]
+            out["position_ids"][i, :n] = np.arange(n)
+            out["segment_ids"][i, :n] = 1
+            if kt:
+                out["teacher_topk_ids"][i, :nt, :kt] = t_ids[:nt, :kt]
+                out["teacher_topk_log_probs"][i, :nt, :kt] = t_lp[:nt, :kt]
+        return out
+
+
+class DistillTrainer(BaseTrainer):
+    def _build_data_transform(self):
+        from veomni_tpu.data.data_transform import build_data_transform
+
+        self.data_transform = build_data_transform(
+            "distill", tokenizer=self.tokenizer,
+            max_seq_len=self.args.data.max_seq_len,
+        )
+
+    def _build_dataloader(self):
+        from veomni_tpu.data.data_loader import build_dataloader
+
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        nproc = jax.process_count()
+        local_mb = t.micro_batch_size * ps.dp_size // nproc
+        self.dataloader = build_dataloader(
+            d.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=DistillCollator(
+                d.max_seq_len, local_mb, topk=t.distill_topk,
+                sp_size=ps.sp_size,
+            ),
+            micro_batch_size=local_mb,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=local_mb,
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            infinite=True,
+        )
+
+    def _batch_sharding_map(self):
+        from jax.sharding import PartitionSpec as P
+
+        ps = self.parallel_state
+        base = {k: P(None, ps.dp_axes, ps.sp_axes) for k in (
+            "input_ids", "labels", "position_ids", "segment_ids")}
+        base["teacher_topk_ids"] = P(None, ps.dp_axes, ps.sp_axes, None)
+        base["teacher_topk_log_probs"] = P(None, ps.dp_axes, ps.sp_axes, None)
+        return base
+
+    def _build_parallelized_state(self):
+        super()._build_parallelized_state()
+        model, cfg = self.model, self.model.config
+        kl_coef = float(self.args.train.distill_kl_coef)
+        temperature = float(self.args.train.distill_temperature)
+        merge = self.merge_params
+
+        def distill_loss(params, batch):
+            params = merge(params)
+            hidden, _, _ = transformer.forward_hidden(
+                params, cfg, batch["input_ids"], batch["position_ids"],
+                batch.get("segment_ids"),
+            )
+            b, s, h = hidden.shape
+            kernel = transformer.lm_head_kernel(params, cfg).astype(cfg.dtype)
+            labels = batch["labels"].reshape(b * s)
+            # one fused [T,V] pass yields BOTH the untempered CE (out["nll"])
+            # and the tempered KL — no separate cross-entropy projection
+            out = fused_linear_topk_distill(
+                hidden.reshape(b * s, h), kernel, labels,
+                batch["teacher_topk_ids"].reshape(b * s, -1),
+                batch["teacher_topk_log_probs"].reshape(b * s, -1),
+                temperature=temperature,
+            )
+            ntokens = (labels != IGNORE_INDEX).sum()
+            loss = out["nll"].sum() + kl_coef * out["distill"].sum()
+            denom = jnp.maximum(ntokens, 1)
+            return loss, {
+                "ntokens": ntokens,
+                "distill_kl": out["distill"].sum() / denom,
+                "student_mass": out["student_mass"].sum() / denom,
+                "teacher_mass": out["teacher_mass"].sum() / denom,
+            }
+
+        from veomni_tpu.train import build_train_step
+
+        self._loss_fn = distill_loss
+        self.train_step = build_train_step(
+            distill_loss, self.optimizer, self.parallel_state,
+            state_shardings=self.state_shardings,
+            batch_shardings=self.batch_shardings,
+            max_grad_norm=self.args.train.max_grad_norm,
+            grad_mask=self.grad_mask,
+        )
