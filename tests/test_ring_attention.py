@@ -23,7 +23,8 @@ def _qkv(b=2, s=64, hq=8, hkv=4, d=16, seed=0):
     return q, k, v, seg
 
 
-def _sp_case(layout, causal=True, sliding_window=None, seg=True, **qkv_kw):
+def _sp_case(layout, causal=True, sliding_window=None, seg=True, mask_mod=None,
+             **qkv_kw):
     from veomni_tpu.ops.attention import _attention_xla
     from veomni_tpu.parallel import init_parallel_state, use_parallel_state
     from veomni_tpu.parallel.parallel_state import destroy_parallel_state
@@ -32,7 +33,8 @@ def _sp_case(layout, causal=True, sliding_window=None, seg=True, **qkv_kw):
     q, k, v, segs = _qkv(**qkv_kw)
     segs = segs if seg else None
     ref = _attention_xla(
-        q, k, v, segment_ids=segs, causal=causal, sliding_window=sliding_window
+        q, k, v, segment_ids=segs, causal=causal, sliding_window=sliding_window,
+        mask_mod=mask_mod,
     )
     destroy_parallel_state()
     ps = init_parallel_state(**layout)
@@ -40,7 +42,7 @@ def _sp_case(layout, causal=True, sliding_window=None, seg=True, **qkv_kw):
         got = jax.jit(
             lambda *a: sp_attention(
                 _attention_xla, *a, pstate=ps, causal=causal,
-                sliding_window=sliding_window,
+                sliding_window=sliding_window, mask_mod=mask_mod,
             )
         )(q, k, v, segs)
     np.testing.assert_allclose(
@@ -71,6 +73,72 @@ def test_ring_sliding_window():
 
 def test_ring_no_segments():
     _sp_case(dict(cp_size=4, dp_shard_size=1), seg=False)
+
+
+def _doc_mask(q_idx, k_idx):
+    """Block-diagonal 'document' flex mask (width 16) — positional only, so
+    it must see GLOBAL indices to survive sequence sharding."""
+    return (q_idx // 16) == (k_idx // 16)
+
+
+@pytest.mark.parametrize(
+    "layout",
+    [
+        dict(ulysses_size=4, dp_shard_size=1),
+        dict(cp_size=4, dp_shard_size=1),
+        dict(cp_size=2, ulysses_size=2, dp_shard_size=1),
+    ],
+    ids=["u4", "cp4", "cp2xu2"],
+)
+def test_mask_mod_under_sp(layout):
+    """Flex masks compose with ulysses/ring SP on global positions
+    (reference flex x Ulysses, ops/kernels/attention/__init__.py:30-86)."""
+    _sp_case(layout, mask_mod=_doc_mask)
+    _sp_case(layout, causal=False, mask_mod=_doc_mask)
+
+
+def test_mask_mod_sp_via_facade():
+    """The public attention() facade routes mask_mod through the ambient
+    parallel state instead of raising."""
+    from veomni_tpu.ops.attention import attention
+    from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+
+    q, k, v, seg = _qkv()
+    destroy_parallel_state()
+    ref = attention(q, k, v, segment_ids=seg, causal=True, mask_mod=_doc_mask)
+    ps = init_parallel_state(cp_size=2, ulysses_size=2, dp_shard_size=1)
+    with use_parallel_state(ps):
+        got = jax.jit(
+            lambda *a: attention(*a, causal=True, mask_mod=_doc_mask)
+        )(q, k, v, seg)
+    destroy_parallel_state()
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_batch_mask_mod_rejected_under_sp():
+    """Batch-dependent flex masks can't ride the shard_map closure; the
+    facade rejects them with a clear error (not a deep trace failure)."""
+    from veomni_tpu.ops.attention import attention
+    from veomni_tpu.parallel import init_parallel_state, use_parallel_state
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+
+    q, k, v, seg = _qkv()
+    doc_ids = jnp.asarray(np.arange(q.shape[0])[:, None] * jnp.ones(
+        (1, q.shape[1]), jnp.int32))
+
+    def batch_mask(q_idx, k_idx):
+        return doc_ids[:, q_idx[:, 0]][:, :, None] == doc_ids[:, k_idx[0]][:, None, :]
+
+    destroy_parallel_state()
+    ps = init_parallel_state(cp_size=2, dp_shard_size=2)
+    with use_parallel_state(ps):
+        with pytest.raises(NotImplementedError, match="batch-dependent"):
+            attention(q, k, v, segment_ids=seg, causal=True,
+                      mask_mod=batch_mask)
+    destroy_parallel_state()
 
 
 def test_ring_grads_match_local():

@@ -12,8 +12,9 @@ and calls this op on gathered-sequence/scattered-head tensors.
 ``mask_mod(q_idx, k_idx) -> bool`` over broadcastable position index arrays
 (close over per-batch tensors for data-dependent masks, e.g. prefix-LM
 boundaries — the closure runs inside the jitted program, so GSPMD-sharded
-batch tensors are fine; sequence parallelism is rejected at the facade)
-that composes with the causal/window/segment masks. XLA fuses
+batch tensors are fine; under sequence parallelism the predicate receives
+GLOBAL positions — gathered sequence for ulysses, chunk-offset indices for
+ring CP) that composes with the causal/window/segment masks. XLA fuses
 the predicate into the masked softmax the same way flex compiles a block
 mask — no kernel authoring needed on TPU.
 
@@ -397,9 +398,15 @@ def attention(
     """SP-aware facade (reference ``ops/kernels/attention/__init__.py:30-86``):
     under an ambient ParallelState with ulysses > 1, wraps the resolved
     kernel in the Ulysses a2a shard_map. ``mask_mod`` pins the XLA impls
-    (the Pallas flash kernel and the ring-CP path don't take flex masks)
-    and composes with data/expert parallelism only — sequence parallelism
-    would hand the closure sequence-sharded positions."""
+    (the Pallas flash kernel doesn't take flex masks) and composes with
+    sequence parallelism too: the ulysses a2a gathers the full sequence
+    before the inner impl builds its position grids, and the ring-CP path
+    evaluates the predicate on global (chunk-offset) positions — so a
+    positional mask_mod sees GLOBAL q/k indices under every layout.
+    Batch-dependent masks (a closure returning a per-batch [B,...] mask)
+    do NOT compose with SP: shard_map would replicate the closed-over
+    tensor against the local batch slice — rejected here with a clear
+    error instead of a deep trace failure."""
     inner = resolve_op("attention")
     kwargs = dict(causal=causal, softmax_scale=softmax_scale,
                   sliding_window=sliding_window, sinks=sinks)
@@ -411,11 +418,22 @@ def attention(
     pstate = get_parallel_state_or_none()
     if pstate is not None and (pstate.ulysses_size > 1 or pstate.cp_size > 1):
         if mask_mod is not None:
-            raise NotImplementedError(
-                "mask_mod under ulysses/ring sequence parallelism: the "
-                "shard_map body sees sequence-local positions; run flex-"
-                "masked attention with sp=1 (dp/fsdp/ep compose fine)"
+            # shape-only probe (no compute): a mask with a real batch dim
+            # would be captured whole by the shard_map closure and collide
+            # with the body's local batch slice — fail here, legibly
+            sq = q.shape[1]
+            mm_abs = jax.eval_shape(
+                lambda qi, ki: _normalize_mask_mod(mask_mod(qi, ki)),
+                jax.ShapeDtypeStruct((sq, 1), jnp.int32),
+                jax.ShapeDtypeStruct((1, sq), jnp.int32),
             )
+            if mm_abs.shape[0] > 1:
+                raise NotImplementedError(
+                    "batch-dependent mask_mod under sequence parallelism: "
+                    "the closed-over per-batch tensor would be replicated "
+                    "against the shard_map-local batch slice. Use a "
+                    "positional (batch-free) mask, or run with sp=1."
+                )
         from veomni_tpu.parallel.sequence_parallel import sp_attention
 
         return sp_attention(inner, q, k, v, segment_ids, pstate, **kwargs)

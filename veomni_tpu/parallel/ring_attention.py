@@ -57,6 +57,7 @@ def ring_attention_local(
     softmax_scale: Optional[float] = None,
     sliding_window=None,
     sinks: Optional[jax.Array] = None,
+    mask_mod=None,
     q_chunk: int = 1024,
     k_chunk: int = 1024,
 ):
@@ -121,6 +122,12 @@ def ring_attention_local(
             mask = mask & (
                 sq_i[:, None, :, None] == seg_kb[:, j][:, None, None, :]
             )
+            if mask_mod is not None:
+                # qpos/kpos are GLOBAL indices (chunk offsets above), so a
+                # flex mask composes across ring rotation unchanged
+                from veomni_tpu.ops.attention import _normalize_mask_mod
+
+                mask = mask & _normalize_mask_mod(mask_mod(qpos, kpos))
             s_blk = jnp.where(mask, s_blk, _NEG)
             m_new = jnp.maximum(mm, s_blk.max(-1))
             p = jnp.where(mask, jnp.exp(s_blk - m_new[..., None]), 0.0)
