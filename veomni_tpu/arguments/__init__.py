@@ -4,9 +4,11 @@ from veomni_tpu.arguments.arguments_types import (
     TrainingArguments,
     VeOmniArguments,
 )
+from veomni_tpu.arguments.compat import translate_reference_schema
 from veomni_tpu.arguments.parser import parse_args, save_args
 
 __all__ = [
+    "translate_reference_schema",
     "DataArguments",
     "ModelArguments",
     "TrainingArguments",

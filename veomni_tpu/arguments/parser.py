@@ -57,13 +57,23 @@ def _resolve_type(cls, field_name):
     return hints.get(field_name, str)
 
 
-def _apply_dict(obj: Any, data: Dict[str, Any]) -> None:
+def _apply_dict(obj: Any, data: Dict[str, Any], lenient: bool = False) -> None:
     for k, v in data.items():
         if not hasattr(obj, k):
+            if lenient:
+                # reference-schema file: its surface is larger than the TPU
+                # mapping; the translator already warned about known drops
+                from veomni_tpu.utils.logging import get_logger
+
+                get_logger(__name__).warning_rank0(
+                    "reference-config: unknown key %r for %s, ignored",
+                    k, type(obj).__name__,
+                )
+                continue
             raise AttributeError(f"unknown config key {k!r} for {type(obj).__name__}")
         cur = getattr(obj, k)
         if dataclasses.is_dataclass(cur) and isinstance(v, dict):
-            _apply_dict(cur, v)
+            _apply_dict(cur, v, lenient=lenient)
         else:
             # YAML 1.1 parses bare "1e-3" as a string — coerce scalars to the
             # declared field type so yaml and CLI values behave identically.
@@ -85,7 +95,12 @@ def parse_args(cls: Type[T], argv: Optional[List[str]] = None) -> T:
                 data = yaml.safe_load(f)
             else:
                 data = json.load(f)
-        _apply_dict(obj, data or {})
+        lenient = False
+        if data:
+            from veomni_tpu.arguments.compat import translate_reference_schema
+
+            data, _, lenient = translate_reference_schema(data)
+        _apply_dict(obj, data or {}, lenient=lenient)
     i = 0
     while i < len(argv):
         tok = argv[i]

@@ -20,12 +20,24 @@ _configured = False
 
 
 def _process_index() -> int:
+    """Rank for *_rank0 gating WITHOUT forcing backend init: jax.process_index
+    would claim the accelerator (on the axon TPU that can block for many
+    minutes behind another claimant) — a log call must never be the thing
+    that initializes the backend. Pre-init we trust the launcher env."""
     try:
-        import jax
+        from jax._src import xla_bridge
 
-        return jax.process_index()
+        if xla_bridge._backends:  # already initialized: authoritative
+            import jax
+
+            return jax.process_index()
     except Exception:
-        return int(os.environ.get("JAX_PROCESS_INDEX", "0"))
+        pass
+    return int(
+        os.environ.get(
+            "VEOMNI_PROCESS_ID", os.environ.get("JAX_PROCESS_INDEX", "0")
+        )
+    )
 
 
 def _configure_root() -> None:
