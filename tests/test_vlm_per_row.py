@@ -1,0 +1,116 @@
+"""Per-row patch-budget VLM data path (multihost variant).
+
+Packed mode (one global patch buffer, replicated) and per-row mode (budget
+per row, batch-sharded) must produce identical losses — the per-row layout is
+what multihost assembly ships (reference per-rank multimodal slicing,
+``data/data_collator.py:317-431``).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from veomni_tpu.data.data_transform import build_data_transform
+from veomni_tpu.models.auto import build_config
+
+_TEXT = {
+    "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+    "num_hidden_layers": 2, "num_attention_heads": 4, "num_key_value_heads": 2,
+    "image_token_id": 9, "video_token_id": 10, "vision_start_token_id": 8,
+}
+OVERRIDES = {
+    "qwen2_5_vl": {
+        **_TEXT,
+        "rope_scaling": {"type": "mrope", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "hidden_size": 32, "intermediate_size": 64,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+            "window_size": 8, "fullatt_block_indexes": [1],
+            "out_hidden_size": 64,
+        },
+    },
+    "qwen2_vl": {
+        **_TEXT,
+        "rope_scaling": {"type": "mrope", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "embed_dim": 32, "hidden_size": 64, "mlp_ratio": 2,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+        },
+    },
+    "qwen3_vl": {
+        **_TEXT,
+        "head_dim": 16,
+        "rope_scaling": {"rope_type": "default", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "hidden_size": 32, "intermediate_size": 64,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+            "out_hidden_size": 64, "num_position_embeddings": 16,
+            "deepstack_visual_indexes": [0],
+        },
+    },
+}
+
+
+def _samples(cfg, key, n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    transform = build_data_transform(
+        key, tokenizer=None, vlm_config=cfg, max_seq_len=64,
+        max_patches_per_sample=32, text_keys="text",
+    )
+    rows = []
+    for i in range(n):
+        rows.append(transform({
+            "input_ids": rng.integers(11, 256, int(rng.integers(8, 24))).tolist(),
+            "images": [rng.random((8 + 4 * (i % 2), 8, 3))],
+        }))
+    return rows
+
+
+def _losses(model_type, collator_cls, loss_fn):
+    cfg = build_config(model_type, **OVERRIDES[model_type])
+    key = "qwen3_vl" if model_type.startswith("qwen3") else model_type
+    samples = _samples(cfg, key)
+    model_params = None
+
+    out = []
+    for per_row in (False, True):
+        col = collator_cls(
+            seq_len=64, micro_batch_size=4, vlm_config=cfg,
+            max_patches=128, per_row=per_row,
+        )
+        batch = {k: jax.numpy.asarray(v) for k, v in col(samples).items()}
+        if model_params is None:
+            from veomni_tpu.models import build_foundation_model
+
+            model = build_foundation_model(config=cfg)
+            model_params = model.init(jax.random.PRNGKey(0))
+        loss, metrics = loss_fn(model_params, cfg, batch)
+        out.append((float(loss), float(metrics["ntokens"])))
+    return out
+
+
+def test_qwen25_vl_per_row_matches_packed():
+    from veomni_tpu.data.multimodal import Qwen25VLCollator
+    from veomni_tpu.models.qwen2_5_vl import loss_fn
+
+    (lp, np_), (lr, nr) = _losses("qwen2_5_vl", Qwen25VLCollator, loss_fn)
+    assert np_ == nr
+    assert lp == pytest.approx(lr, rel=1e-5)
+
+
+def test_qwen2_vl_per_row_matches_packed():
+    from veomni_tpu.data.multimodal import Qwen2VLCollator
+    from veomni_tpu.models.qwen2_vl import loss_fn
+
+    (lp, np_), (lr, nr) = _losses("qwen2_vl", Qwen2VLCollator, loss_fn)
+    assert np_ == nr
+    assert lp == pytest.approx(lr, rel=1e-5)
+
+
+def test_qwen3_vl_per_row_matches_packed():
+    from veomni_tpu.data.multimodal import Qwen3VLCollator
+    from veomni_tpu.models.qwen3_vl import loss_fn
+
+    (lp, np_), (lr, nr) = _losses("qwen3_vl", Qwen3VLCollator, loss_fn)
+    assert np_ == nr
+    assert lp == pytest.approx(lr, rel=1e-5)

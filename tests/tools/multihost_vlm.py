@@ -1,0 +1,79 @@
+"""Per-process driver for the multihost packed-VLM data-path test.
+
+Runs VLMTrainer (qwen2_5_vl toy) on a (4-local x nproc) virtual CPU mesh and
+prints the loss trajectory. With nproc=2 the trainer auto-selects the
+per-row patch-budget collator (each process assembles only its rows); the
+parent asserts the trajectory matches a single-process (packed-mode) run of
+the same global batch — the reference contract of per-rank multimodal
+slicing (``data/data_collator.py:317-431``).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main():
+    data_path, steps, local_devices = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    out_dir = sys.argv[4]
+
+    from veomni_tpu.arguments import VeOmniArguments
+    from veomni_tpu.trainer import VLMTrainer
+    from veomni_tpu.trainer.callbacks import Callback
+
+    args = VeOmniArguments()
+    args.model.config_overrides = {
+        "model_type": "qwen2_5_vl",
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "rope_scaling": {"type": "mrope", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "hidden_size": 32, "intermediate_size": 64,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+            "window_size": 8, "fullatt_block_indexes": [1],
+            "out_hidden_size": 64,
+        },
+        "image_token_id": 9, "video_token_id": 10,
+        "vision_start_token_id": 8,
+    }
+    args.data.train_path = data_path
+    args.data.data_type = "pretokenized"
+    args.data.max_seq_len = 64
+    args.data.max_patches = 256
+    args.train.platform = "cpu"
+    args.train.num_virtual_devices = local_devices
+    args.train.output_dir = out_dir
+    args.train.micro_batch_size = 1
+    args.train.train_steps = steps
+    args.train.lr = 1e-3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.save_hf_weights = False
+    args.train.log_steps = 1  # sync every step: the test reads the series
+
+    losses = []
+
+    class Rec(Callback):
+        def on_step_end(self, trainer, state):
+            if "loss" in state.metrics:
+                losses.append(round(float(state.metrics["loss"]), 6))
+
+    trainer = VLMTrainer(args)
+    trainer.callbacks.append(Rec())
+    trainer.train()
+    trainer.checkpointer.close()
+    import jax
+
+    print(json.dumps({
+        "process": jax.process_index(),
+        "devices": jax.device_count(),
+        "per_row": trainer._vlm_per_row,
+        "losses": losses,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -341,7 +341,12 @@ class Qwen25VLCollator:
     per-row budget variant (follow-up)."""
 
     def __init__(self, seq_len: int, micro_batch_size: int, vlm_config,
-                 max_patches: int, sp_size: int = 1):
+                 max_patches: int, sp_size: int = 1, per_row: bool = False):
+        """``per_row=True`` switches to the per-row patch-budget layout
+        (reference multihost slicing, ``data/data_collator.py:317-431``):
+        every row gets its own ``max_patches // micro_batch_size`` buffer and
+        index plan, so the vision arrays gain a batch dim and shard over dp
+        like the text — each process assembles only its rows."""
         if seq_len % max(sp_size, 1):
             raise ValueError(f"seq_len {seq_len} % sp_size {sp_size} != 0")
         unit = vlm_config.vision.merge_unit
@@ -350,7 +355,18 @@ class Qwen25VLCollator:
         self.seq_len = seq_len
         self.micro_batch_size = micro_batch_size
         self.cfg = vlm_config
-        self.max_patches = max_patches
+        self.per_row = per_row
+        if per_row:
+            row = max_patches // micro_batch_size
+            row -= row % unit
+            if row <= 0:
+                raise ValueError(
+                    f"per-row budget {row} (= max_patches {max_patches} / "
+                    f"micro_batch {micro_batch_size}) too small"
+                )
+            self.max_patches = row  # per ROW in this mode
+        else:
+            self.max_patches = max_patches
 
     def _sync_grids(self, ids, lab, grids):
         """Keep grids <-> placeholder runs consistent after seq_len
@@ -385,8 +401,12 @@ class Qwen25VLCollator:
         return ids, lab, grids[:kept], sum(patch_counts[:kept])
 
     def _assemble_text(self, samples) -> Tuple[Dict[str, np.ndarray], np.ndarray, list]:
-        """Shared text/patch assembly: returns (text arrays, packed patch
-        buffer [max_patches, patch_dim], grids)."""
+        """Shared text/patch assembly.
+
+        Packed mode: (text arrays, patch buffer [max_patches, patch_dim],
+        flat grid list). Per-row mode: (text arrays, [B, max_patches,
+        patch_dim], per-row grid lists) — ``max_patches`` is per row there.
+        """
         b, s = self.micro_batch_size, self.seq_len
         vcfg = self.cfg.vision
         out = {
@@ -394,7 +414,8 @@ class Qwen25VLCollator:
             "labels": np.full((b, s), IGNORE_INDEX, np.int32),
             "segment_ids": np.zeros((b, s), np.int32),
         }
-        all_patches, all_grids = [], []
+        row_patches: List[Any] = [None] * b
+        row_grids: List[list] = [[] for _ in range(b)]
         total = 0
         for i, sample in enumerate(samples[:b]):
             ids = np.asarray(sample["input_ids"], np.int32)[:s]
@@ -403,35 +424,65 @@ class Qwen25VLCollator:
             ids, lab, grids, n_keep_patches = self._sync_grids(ids, lab, grids)
             if px is not None and n_keep_patches:
                 px = np.asarray(px)[:n_keep_patches]
-                if total + len(px) > self.max_patches:
+                budget_used = len(px) if self.per_row else total + len(px)
+                if budget_used > self.max_patches:
+                    scope = "row" if self.per_row else "micro-batch"
                     raise ValueError(
-                        f"micro-batch exceeds max_patches={self.max_patches}; "
+                        f"{scope} exceeds max_patches={self.max_patches}; "
                         "raise data.max_patches or lower image resolution"
                     )
                 total += len(px)
-                all_patches.append(px)
-                all_grids += grids
+                row_patches[i] = px
+                row_grids[i] = grids
             shifted = np.concatenate([lab[1:], [IGNORE_INDEX]]).astype(np.int32)
             n = len(ids)
             out["input_ids"][i, :n] = ids
             out["labels"][i, :n] = shifted
             out["segment_ids"][i, :n] = 1
+        if self.per_row:
+            px = np.zeros((b, self.max_patches, vcfg.patch_dim), np.float32)
+            for i, rp in enumerate(row_patches):
+                if rp is not None:
+                    px[i, : len(rp)] = rp
+            return out, px, row_grids
         px = np.zeros((self.max_patches, vcfg.patch_dim), np.float32)
-        if all_patches:
-            cat = np.concatenate(all_patches)
+        cat = [rp for rp in row_patches if rp is not None]
+        if cat:
+            cat = np.concatenate(cat)
             px[: len(cat)] = cat
-        return out, px, all_grids
+        return out, px, [g for row in row_grids for g in row]
+
+    def _stack_meta(self, row_grids, vision_metadata):
+        """Per-row index plans stacked on a batch dim (per-row mode)."""
+        metas = [
+            vision_metadata(g, self.cfg.vision, self.max_patches)
+            for g in row_grids
+        ]
+        return {k: np.stack([m[k] for m in metas]) for k in metas[0]}
+
+    @staticmethod
+    def _flat_grids(grids):
+        return [g for row in grids for g in row]
 
     def __call__(self, samples: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
         from veomni_tpu.models.qwen2_5_vl import mrope_position_ids, vision_metadata
 
         cfg, vcfg = self.cfg, self.cfg.vision
-        out, px, all_grids = self._assemble_text(samples)
-        out["position_ids"] = mrope_position_ids(
-            out["input_ids"].astype(np.int64), all_grids, cfg
-        ).astype(np.int32)
-        meta = vision_metadata(all_grids, vcfg, self.max_patches)
-        out["pixel_values"] = px[meta["patch_gather"]]
+        out, px, grids = self._assemble_text(samples)
+        if self.per_row:
+            out["position_ids"] = mrope_position_ids(
+                out["input_ids"].astype(np.int64), self._flat_grids(grids), cfg
+            ).astype(np.int32)
+            meta = self._stack_meta(grids, vision_metadata)
+            out["pixel_values"] = np.take_along_axis(
+                px, meta["patch_gather"][..., None].astype(np.int64), axis=1
+            )
+        else:
+            out["position_ids"] = mrope_position_ids(
+                out["input_ids"].astype(np.int64), grids, cfg
+            ).astype(np.int32)
+            meta = vision_metadata(grids, vcfg, self.max_patches)
+            out["pixel_values"] = px[meta["patch_gather"]]
         out["vis_pos_hw"] = meta["pos_hw"]
         out["vis_seg_window"] = meta["seg_window"]
         out["vis_seg_full"] = meta["seg_full"]
@@ -449,11 +500,15 @@ class Qwen2VLCollator(Qwen25VLCollator):
         from veomni_tpu.models.qwen2_vl import mrope_position_ids, vision_metadata
 
         cfg, vcfg = self.cfg, self.cfg.vision
-        out, px, all_grids = self._assemble_text(samples)
+        out, px, grids = self._assemble_text(samples)
+        flat = self._flat_grids(grids) if self.per_row else grids
         out["position_ids"] = mrope_position_ids(
-            out["input_ids"].astype(np.int64), all_grids, cfg
+            out["input_ids"].astype(np.int64), flat, cfg
         ).astype(np.int32)
-        meta = vision_metadata(all_grids, vcfg, self.max_patches)
+        meta = (
+            self._stack_meta(grids, vision_metadata) if self.per_row
+            else vision_metadata(grids, vcfg, self.max_patches)
+        )
         out["pixel_values"] = px
         out["vis_pos_hw"] = meta["pos_hw"]
         out["vis_seg"] = meta["seg"]
@@ -470,11 +525,15 @@ class Qwen3VLCollator(Qwen25VLCollator):
         from veomni_tpu.models.qwen3_vl import mrope_position_ids, vision_metadata
 
         cfg, vcfg = self.cfg, self.cfg.vision
-        out, px, all_grids = self._assemble_text(samples)
+        out, px, grids = self._assemble_text(samples)
+        flat = self._flat_grids(grids) if self.per_row else grids
         out["position_ids"] = mrope_position_ids(
-            out["input_ids"].astype(np.int64), all_grids, cfg
+            out["input_ids"].astype(np.int64), flat, cfg
         ).astype(np.int32)
-        meta = vision_metadata(all_grids, vcfg, self.max_patches)
+        meta = (
+            self._stack_meta(grids, vision_metadata) if self.per_row
+            else vision_metadata(grids, vcfg, self.max_patches)
+        )
         out["pixel_values"] = px
         out["vis_pos_hw"] = meta["pos_hw"]
         out["vis_pos_interp_idx"] = meta["pos_interp_idx"]

@@ -205,6 +205,11 @@ def hf_to_params(
     def has(name: str) -> bool:
         return name in alias and alias[name] in lazy
 
+    broadcast = (
+        os.environ.get("VEOMNI_WEIGHTS_BROADCAST") == "1"
+        and jax.process_count() > 1
+    )
+
     def place(dotted: str, shape, read_block):
         """read_block(idx: tuple[slice]) -> np array of that sub-shape."""
         sh = shardings.get(dotted)
@@ -216,6 +221,20 @@ def hf_to_params(
                 f"(have e.g. {sorted(shardings)[:4]})"
             )
         if sh is not None:
+            if broadcast and not any(sh.spec):
+                # fully-replicated param in rank0-broadcast mode: one
+                # filesystem read on process 0, everyone else receives over
+                # the interconnect (reference chunked rank0 broadcast,
+                # ``module_utils.py:867`` — here one psum collective)
+                from jax.experimental import multihost_utils
+
+                if jax.process_index() == 0:
+                    full = read_block(tuple(slice(None) for _ in shape))
+                    host = np.ascontiguousarray(full).astype(pd_np)
+                else:
+                    host = np.zeros(tuple(shape), pd_np)
+                arr = multihost_utils.broadcast_one_to_all(host)
+                return jax.device_put(jnp.asarray(arr, pd), sh)
             return jax.make_array_from_callback(
                 tuple(shape), sh,
                 lambda idx: np.ascontiguousarray(read_block(idx)).astype(pd_np),

@@ -407,14 +407,30 @@ def vision_forward(
 # ---------------------------------------------------------------------------
 
 def gather_packed_features(input_ids, feats, merged_mask,
-                           image_token_id, video_token_id):
+                           image_token_id, video_token_id,
+                           row_tokens: int = 0):
     """Align packed per-token features [M, H] (image order) with placeholder
-    tokens (reading order over the whole batch): returns
-    (gathered [B*S, H], valid [B*S]) — the shared scatter core for the
-    VLM/omni composites."""
+    tokens: returns (gathered [B*S, H], valid [B*S]) — the shared scatter
+    core for the VLM/omni composites.
+
+    ``row_tokens=0`` (packed mode): feats cover the whole batch in reading
+    order, global cumsum ordinal. ``row_tokens=R`` (per-row budget mode):
+    feats are row-major [B*R, H], each row's placeholders index its own R
+    merged slots — elementwise per row, so dp batch sharding stays local
+    (the multihost data path)."""
     m = feats.shape[0]
     is_vis = (input_ids == image_token_id) | (input_ids == video_token_id)
     flat = is_vis.reshape(-1)
+    if row_tokens:
+        b = input_ids.shape[0]
+        ordinal = (jnp.cumsum(is_vis.astype(jnp.int32), axis=1) - 1)
+        in_budget = is_vis & (ordinal < row_tokens)
+        idx = (
+            jnp.arange(b)[:, None] * row_tokens
+            + jnp.clip(ordinal, 0, row_tokens - 1)
+        ).reshape(-1)
+        valid = in_budget.reshape(-1) & merged_mask[idx]
+        return feats[idx], valid
     ordinal = jnp.cumsum(flat.astype(jnp.int32)) - 1
     idx = jnp.clip(ordinal, 0, m - 1)
     valid = flat & (ordinal < m) & merged_mask[idx]
@@ -422,27 +438,67 @@ def gather_packed_features(input_ids, feats, merged_mask,
 
 
 def merge_vision_features(embeds, input_ids, feats, merged_mask,
-                          image_token_id, video_token_id):
+                          image_token_id, video_token_id,
+                          row_tokens: int = 0):
     """Scatter packed vision features (image order) into placeholder tokens
-    (reading order over the whole batch — the collator packs images in batch
-    row order)."""
+    (reading order over the whole batch in packed mode; per-row in budget
+    mode — see gather_packed_features)."""
     b, s, h = embeds.shape
     gathered, valid = gather_packed_features(
-        input_ids, feats, merged_mask, image_token_id, video_token_id
+        input_ids, feats, merged_mask, image_token_id, video_token_id,
+        row_tokens=row_tokens,
     )
     out = jnp.where(valid[:, None], gathered.astype(embeds.dtype),
                     embeds.reshape(b * s, h))
     return out.reshape(b, s, h)
 
 
-def loss_fn(params, cfg: Qwen25VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """batch: input_ids/labels/segment_ids [B,S]; position_ids [B,3,S]
-    (mrope); pixel_values [N, patch_dim] window-ordered; vis_pos_hw [N,2];
-    vis_seg_window / vis_seg_full [N]; vis_reverse [M]; vis_merged_mask [M]."""
+def flatten_per_row_vision(batch, unit: int) -> Tuple[Dict[str, jax.Array], int]:
+    """Per-row-budget vision arrays [B, Pr, ...] -> the packed layout the
+    vision tower consumes, with per-row segment/index offsets so rows stay
+    mutually masked after concatenation. Returns (packed arrays, merged
+    tokens per row). Elementwise per row => dp batch sharding stays local
+    (the multihost VLM data path; reference per-rank slicing,
+    ``data/data_collator.py:317-431``)."""
+    pv = batch["pixel_values"]
+    b, pr, d = pv.shape
+    mr = pr // unit
+    row = jnp.arange(b, dtype=jnp.int32)[:, None]
+    out = {"pixel_values": pv.reshape(b * pr, d)}
+    for key in ("vis_seg_window", "vis_seg_full", "vis_seg"):
+        if key in batch:
+            seg = batch[key]
+            # +1 headroom: row seg ids are 1..Pr, so stride Pr+1 cannot collide
+            out[key] = jnp.where(seg > 0, seg + row * (pr + 1), 0).reshape(-1)
+    if "vis_pos_hw" in batch:
+        out["vis_pos_hw"] = batch["vis_pos_hw"].reshape(b * pr, 2)
+    if "vis_reverse" in batch:
+        out["vis_reverse"] = (batch["vis_reverse"] + row * mr).reshape(-1)
+    if "vis_merged_mask" in batch:
+        out["vis_merged_mask"] = batch["vis_merged_mask"].reshape(-1)
+    if "vis_pos_interp_idx" in batch:
+        # [B, 4, Pr] -> [4, B*Pr]; indices address the shared pos-embed
+        # table, so no per-row offset
+        out["vis_pos_interp_idx"] = (
+            batch["vis_pos_interp_idx"].transpose(1, 0, 2).reshape(4, b * pr)
+        )
+        out["vis_pos_interp_w"] = (
+            batch["vis_pos_interp_w"].transpose(1, 0, 2).reshape(4, b * pr)
+        )
+    return out, mr
+
+
+def _vision_merged_hidden(params, cfg: Qwen25VLConfig, batch):
+    """Shared preamble: vision tower + placeholder merge + text transformer.
+    Returns (lm params, hidden [B,S,H], moe_aux, moe_dropped)."""
     tcfg = cfg.text
     vp = params["vision_tower"]
     if cfg.freeze_vision:
         vp = jax.lax.stop_gradient(vp)
+    row_tokens = 0
+    if batch["pixel_values"].ndim == 3:
+        packed, row_tokens = flatten_per_row_vision(batch, cfg.vision.merge_unit)
+        batch = {**batch, **packed}
     feats = vision_forward(
         vp, cfg.vision, batch["pixel_values"], batch["vis_pos_hw"],
         batch["vis_seg_window"], batch["vis_seg_full"], batch["vis_reverse"],
@@ -452,15 +508,41 @@ def loss_fn(params, cfg: Qwen25VLConfig, batch) -> Tuple[jax.Array, Dict[str, ja
     embeds = lm["embed_tokens"].astype(tcfg.dtype)[batch["input_ids"]]
     embeds = merge_vision_features(
         embeds, batch["input_ids"], feats, batch["vis_merged_mask"],
-        cfg.image_token_id, cfg.video_token_id,
+        cfg.image_token_id, cfg.video_token_id, row_tokens=row_tokens,
     )
     hidden, moe_aux, moe_dropped = transformer.forward_hidden(
         lm, tcfg, batch["input_ids"], batch["position_ids"],
         batch.get("segment_ids"), inputs_embeds=embeds,
     )
+    return lm, hidden, moe_aux, moe_dropped
+
+
+def loss_fn(params, cfg: Qwen25VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: input_ids/labels/segment_ids [B,S]; position_ids [B,3,S]
+    (mrope); pixel_values [N, patch_dim] window-ordered; vis_pos_hw [N,2];
+    vis_seg_window / vis_seg_full [N]; vis_reverse [M]; vis_merged_mask [M].
+    Per-row budget mode: the vision arrays carry a leading batch dim instead
+    ([B, Pr, ...]) and are flattened here with per-row offsets."""
+    lm, hidden, moe_aux, moe_dropped = _vision_merged_hidden(params, cfg, batch)
     return transformer.head_loss(
-        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+        lm, cfg.text, hidden, batch["labels"], moe_aux, moe_dropped
     )
+
+
+def sequence_logprob_sums(params, cfg: Qwen25VLConfig, batch) -> jax.Array:
+    """Per-row sum of label log-probs [B] through the full VLM (the
+    multimodal DPO/RL logit gather; text counterpart
+    ``transformer.sequence_logprob_sums``)."""
+    from veomni_tpu.ops import fused_linear_cross_entropy
+
+    lm, hidden, _, _ = _vision_merged_hidden(params, cfg, batch)
+    kernel = transformer.lm_head_kernel(lm, cfg.text).astype(cfg.text.dtype)
+
+    def row_nll(h_row, l_row):
+        loss_sum, _ = fused_linear_cross_entropy(h_row, kernel, l_row)
+        return loss_sum
+
+    return -jax.vmap(row_nll)(hidden, batch["labels"])
 
 
 # ---------------------------------------------------------------------------

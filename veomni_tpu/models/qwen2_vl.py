@@ -302,6 +302,12 @@ def loss_fn(params, cfg: Qwen2VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax
     vp = params["vision_tower"]
     if cfg.freeze_vision:
         vp = jax.lax.stop_gradient(vp)
+    row_tokens = 0
+    if batch["pixel_values"].ndim == 3:
+        from veomni_tpu.models.qwen2_5_vl import flatten_per_row_vision
+
+        packed, row_tokens = flatten_per_row_vision(batch, cfg.vision.merge_unit)
+        batch = {**batch, **packed}
     feats = vision_forward(
         vp, cfg.vision, batch["pixel_values"], batch["vis_pos_hw"],
         batch["vis_seg"], dtype=tcfg.dtype,
@@ -310,7 +316,7 @@ def loss_fn(params, cfg: Qwen2VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax
     embeds = lm["embed_tokens"].astype(tcfg.dtype)[batch["input_ids"]]
     embeds = merge_vision_features(
         embeds, batch["input_ids"], feats, batch["vis_merged_mask"],
-        cfg.image_token_id, cfg.video_token_id,
+        cfg.image_token_id, cfg.video_token_id, row_tokens=row_tokens,
     )
     hidden, moe_aux, moe_dropped = transformer.forward_hidden(
         lm, tcfg, batch["input_ids"], batch["position_ids"],

@@ -440,14 +440,16 @@ def vision_forward(
 # ---------------------------------------------------------------------------
 
 def scatter_vision_features(input_ids, feats, merged_mask,
-                            image_token_id, video_token_id, hidden, dtype):
+                            image_token_id, video_token_id, hidden, dtype,
+                            row_tokens: int = 0):
     """Scatter packed features [M, H] (image order) to sequence positions:
     returns [B, S, H] with features at placeholder tokens, zeros elsewhere."""
     from veomni_tpu.models.qwen2_5_vl import gather_packed_features
 
     b, s = input_ids.shape
     gathered, valid = gather_packed_features(
-        input_ids, feats, merged_mask, image_token_id, video_token_id
+        input_ids, feats, merged_mask, image_token_id, video_token_id,
+        row_tokens=row_tokens,
     )
     return jnp.where(valid[:, None], gathered.astype(dtype), 0).reshape(
         b, s, hidden
@@ -463,6 +465,12 @@ def loss_fn(params, cfg: Qwen3VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax
     vp = params["vision_tower"]
     if cfg.freeze_vision:
         vp = jax.lax.stop_gradient(vp)
+    row_tokens = 0
+    if batch["pixel_values"].ndim == 3:
+        from veomni_tpu.models.qwen2_5_vl import flatten_per_row_vision
+
+        packed, row_tokens = flatten_per_row_vision(batch, cfg.vision.merge_unit)
+        batch = {**batch, **packed}
     feats, deepstack = vision_forward(
         vp, cfg.vision, batch["pixel_values"], batch["vis_pos_hw"],
         batch["vis_pos_interp_idx"], batch["vis_pos_interp_w"],
@@ -473,6 +481,7 @@ def loss_fn(params, cfg: Qwen3VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax
     scattered = scatter_vision_features(
         batch["input_ids"], feats, batch["vis_merged_mask"],
         cfg.image_token_id, cfg.video_token_id, tcfg.hidden_size, tcfg.dtype,
+        row_tokens=row_tokens,
     )
     is_vis = (
         (batch["input_ids"] == cfg.image_token_id)
@@ -484,7 +493,7 @@ def loss_fn(params, cfg: Qwen3VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax
         lambda f: scatter_vision_features(
             batch["input_ids"], f, batch["vis_merged_mask"],
             cfg.image_token_id, cfg.video_token_id, tcfg.hidden_size,
-            tcfg.dtype,
+            tcfg.dtype, row_tokens=row_tokens,
         )
     )(deepstack)
     hidden, moe_aux, moe_dropped = transformer.forward_hidden(

@@ -40,6 +40,14 @@ class VLMTrainer(BaseTrainer):
     def _real_vl_key(self):
         return _REAL_VL.get(self.model.config.model_type)
 
+    @property
+    def _vlm_per_row(self):
+        """Per-row patch budgets whenever the batch is process-split (the
+        packed global buffer cannot be assembled from one process's rows)."""
+        import jax
+
+        return jax.process_count() > 1
+
     def _build_data_transform(self):
         d = self.args.data
         key = self._real_vl_key
@@ -47,18 +55,22 @@ class VLMTrainer(BaseTrainer):
             import jax
 
             ps = self.parallel_state
-            local_mb = max(
-                1, self.args.train.micro_batch_size * ps.dp_size // jax.process_count()
+            global_mb = max(1, self.args.train.micro_batch_size * ps.dp_size)
+            local_mb = max(1, global_mb // jax.process_count())
+            # packed mode: the budget is per MICRO-BATCH, cap each sample to
+            # its share; per-row mode: the budget IS per sample. Either way
+            # legitimate data can never blow the static shape.
+            per_sample = (
+                d.max_patches // global_mb if self._vlm_per_row
+                else d.max_patches // local_mb
             )
             self.data_transform = build_data_transform(
                 key,
                 tokenizer=self.tokenizer,
                 vlm_config=self.model.config,
                 max_seq_len=d.max_seq_len,
-                # the collator's budget is per MICRO-BATCH; cap each sample to
-                # its share so legitimate data can never blow the static shape
                 max_patches_per_sample=max(
-                    self.model.config.vision.merge_unit, d.max_patches // local_mb
+                    self.model.config.vision.merge_unit, per_sample
                 ),
                 text_keys=d.text_keys,
             )
@@ -86,11 +98,6 @@ class VLMTrainer(BaseTrainer):
         local_mb = t.micro_batch_size * ps.dp_size // nproc
         key = self._real_vl_key
         if key:
-            if nproc > 1:
-                raise NotImplementedError(
-                    "packed-patch multihost data assembly needs the per-row "
-                    "patch budget variant"
-                )
             from veomni_tpu.data.multimodal import (
                 Qwen2VLCollator, Qwen3VLCollator, Qwen25VLCollator,
             )
@@ -101,8 +108,12 @@ class VLMTrainer(BaseTrainer):
                 seq_len=d.max_seq_len,
                 micro_batch_size=local_mb,
                 vlm_config=self.model.config,
-                max_patches=d.max_patches,
+                # multihost: per-row budgets let every process assemble only
+                # its rows; the batch stitch then shards vision over dp like
+                # text (reference per-rank slicing, data_collator.py:317-431)
+                max_patches=d.max_patches // nproc if nproc > 1 else d.max_patches,
                 sp_size=ps.sp_size,
+                per_row=self._vlm_per_row,
             )
         else:
             collator = VLMCollator(
@@ -134,41 +145,78 @@ class VLMTrainer(BaseTrainer):
             "labels": P(None, ps.dp_axes, ps.sp_axes),
             "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
         }
+        # per-row mode: every vision array gains a batch dim and shards over
+        # dp exactly like the text; packed mode: one replicated global buffer
+        pr = self._vlm_per_row
         if key == "qwen2_vl":
-            return {
+            base = {
                 **text,
                 "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
-                "pixel_values": P(None, None, None),
-                "vis_pos_hw": P(None, None, None),
-                "vis_seg": P(None, None),
-                "vis_merged_mask": P(None, None),
             }
+            if pr:
+                base.update({
+                    "pixel_values": P(None, ps.dp_axes, None, None),
+                    "vis_pos_hw": P(None, ps.dp_axes, None, None),
+                    "vis_seg": P(None, ps.dp_axes, None),
+                    "vis_merged_mask": P(None, ps.dp_axes, None),
+                })
+            else:
+                base.update({
+                    "pixel_values": P(None, None, None),
+                    "vis_pos_hw": P(None, None, None),
+                    "vis_seg": P(None, None),
+                    "vis_merged_mask": P(None, None),
+                })
+            return base
         if key == "qwen2_5_vl":
-            return {
+            base = {
                 **text,
                 # mrope positions [A, B, 3, S]
                 "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
-                # packed global patch sequence: replicated (vision tower runs
-                # data-parallel-replicated; batch-sharded variant follows the
-                # per-row budget collator)
-                "pixel_values": P(None, None, None),
-                "vis_pos_hw": P(None, None, None),
-                "vis_seg_window": P(None, None),
-                "vis_seg_full": P(None, None),
-                "vis_reverse": P(None, None),
-                "vis_merged_mask": P(None, None),
             }
+            if pr:
+                base.update({
+                    "pixel_values": P(None, ps.dp_axes, None, None),
+                    "vis_pos_hw": P(None, ps.dp_axes, None, None),
+                    "vis_seg_window": P(None, ps.dp_axes, None),
+                    "vis_seg_full": P(None, ps.dp_axes, None),
+                    "vis_reverse": P(None, ps.dp_axes, None),
+                    "vis_merged_mask": P(None, ps.dp_axes, None),
+                })
+            else:
+                base.update({
+                    "pixel_values": P(None, None, None),
+                    "vis_pos_hw": P(None, None, None),
+                    "vis_seg_window": P(None, None),
+                    "vis_seg_full": P(None, None),
+                    "vis_reverse": P(None, None),
+                    "vis_merged_mask": P(None, None),
+                })
+            return base
         if key == "qwen3_vl":
-            return {
+            base = {
                 **text,
                 "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
-                "pixel_values": P(None, None, None),
-                "vis_pos_hw": P(None, None, None),
-                "vis_pos_interp_idx": P(None, None, None),
-                "vis_pos_interp_w": P(None, None, None),
-                "vis_seg_full": P(None, None),
-                "vis_merged_mask": P(None, None),
             }
+            if pr:
+                base.update({
+                    "pixel_values": P(None, ps.dp_axes, None, None),
+                    "vis_pos_hw": P(None, ps.dp_axes, None, None),
+                    "vis_pos_interp_idx": P(None, ps.dp_axes, None, None),
+                    "vis_pos_interp_w": P(None, ps.dp_axes, None, None),
+                    "vis_seg_full": P(None, ps.dp_axes, None),
+                    "vis_merged_mask": P(None, ps.dp_axes, None),
+                })
+            else:
+                base.update({
+                    "pixel_values": P(None, None, None),
+                    "vis_pos_hw": P(None, None, None),
+                    "vis_pos_interp_idx": P(None, None, None),
+                    "vis_pos_interp_w": P(None, None, None),
+                    "vis_seg_full": P(None, None),
+                    "vis_merged_mask": P(None, None),
+                })
+            return base
         return {
             **text,
             "position_ids": P(None, ps.dp_axes, ps.sp_axes),
