@@ -1,0 +1,24 @@
+"""Multimodal DPO training entry point (reference: text_dpo pipeline x
+multimodal chat template)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from veomni_tpu.arguments import VeOmniArguments, parse_args, save_args
+from veomni_tpu.trainer.dpo_trainer import VLMDPOTrainer
+
+
+def main():
+    from veomni_tpu.utils.xla_flags import apply_performance_flags
+
+    apply_performance_flags()
+    args = parse_args(VeOmniArguments)
+    save_args(args, args.train.output_dir)
+    trainer = VLMDPOTrainer(args)
+    trainer.train()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""Chat-template registry + multimodal DPO transform
+(reference ``multimodal_chat_template.py`` TEMPLATES + ``chat_template.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veomni_tpu.data.chat_template import (
+    CHAT_TEMPLATE_REGISTRY,
+    build_chat_template,
+)
+from veomni_tpu.models.auto import build_config
+
+
+class FakeTok:
+    """Char-level tokenizer: deterministic, no vocab files needed."""
+
+    def __call__(self, text, add_special_tokens=False):
+        return {"input_ids": [10 + (ord(c) % 200) for c in text]}
+
+
+MESSAGES = [
+    {"role": "system", "content": "be terse"},
+    {"role": "user", "content": "hi"},
+    {"role": "assistant", "content": "hello"},
+]
+
+
+def test_registry_covers_reference_names():
+    for name in ("qwen2vl", "qwen2_5vl", "qwen3vl", "qwen2_5omni", "janus",
+                 "chatml", "llama2"):
+        assert name in CHAT_TEMPLATE_REGISTRY, name
+
+
+@pytest.mark.parametrize("name", ["chatml", "llama2"])
+def test_text_templates_supervise_assistant_only(name):
+    tmpl = build_chat_template(name, FakeTok())
+    enc = tmpl.encode_messages(MESSAGES)
+    ids, labels = enc["input_ids"], enc["labels"]
+    assert len(ids) == len(labels)
+    sup = [l for l in labels if l != -100]
+    assert 0 < len(sup) < len(ids)  # some supervised, prompt masked
+    assert all(l == i for l, i in zip(labels, ids) if l != -100)
+
+
+def test_model_type_resolution():
+    cfg = build_config("qwen2_5_vl", **{
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "rope_scaling": {"type": "mrope", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "hidden_size": 32, "intermediate_size": 64,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+            "window_size": 8, "fullatt_block_indexes": [1],
+            "out_hidden_size": 64,
+        },
+        "image_token_id": 9, "video_token_id": 10, "vision_start_token_id": 8,
+    })
+    tmpl = build_chat_template("default", FakeTok(), cfg)
+    enc = tmpl.encode_messages([
+        {"role": "user", "content": [
+            {"type": "text", "text": "what is this?"},
+            {"type": "image", "image": np.random.default_rng(0).random((8, 8, 3))},
+        ]},
+        {"role": "assistant", "content": "a square"},
+    ])
+    # the image expanded into vision_start + merged-token placeholders
+    assert enc["input_ids"].count(9) == 4 * 4 // 4  # (8/2)^2 patches / 2^2
+    assert 8 in enc["input_ids"]
+    assert len(enc["vis_patches"]) == 1 and len(enc["vis_grids"]) == 1
+    with pytest.raises(ValueError, match="unknown chat template"):
+        build_chat_template("nope", FakeTok())
+
+
+def test_vlm_dpo_transform_collate_and_logprobs():
+    """vlm_dpo rows -> paired per-row-budget batch -> finite VLM logprobs;
+    chosen/rejected share the prompt+media, differ in the response."""
+    from veomni_tpu.data.data_transform import build_data_transform
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.models.qwen2_5_vl import sequence_logprob_sums
+    from veomni_tpu.trainer.dpo_trainer import VLMDPOPairCollator
+
+    cfg = build_config("qwen2_5_vl", **{
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "rope_scaling": {"type": "mrope", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "hidden_size": 32, "intermediate_size": 64,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+            "window_size": 8, "fullatt_block_indexes": [1],
+            "out_hidden_size": 64,
+        },
+        "image_token_id": 9, "video_token_id": 10, "vision_start_token_id": 8,
+    })
+    transform = build_data_transform(
+        "vlm_dpo", tokenizer=FakeTok(), vlm_config=cfg, max_seq_len=64,
+    )
+    rng = np.random.default_rng(0)
+    samples = [transform({
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "pick"},
+            {"type": "image", "image": rng.random((8, 8, 3))},
+        ]}],
+        "chosen": "good answer",
+        "rejected": "bad",
+    }) for _ in range(2)]
+    # prompt (incl. media placeholders) is masked in both branches
+    s = samples[0]
+    n_prompt_c = sum(1 for l in s["chosen_labels"] if l == -100)
+    n_prompt_r = sum(1 for l in s["rejected_labels"] if l == -100)
+    assert n_prompt_c == n_prompt_r > 0
+    assert s["chosen_input_ids"][:n_prompt_c] == s["rejected_input_ids"][:n_prompt_r]
+
+    col = VLMDPOPairCollator(seq_len=64, pairs=2, vlm_config=cfg, max_patches=128)
+    batch = col(samples)
+    assert batch["input_ids"].shape == (4, 64)
+    assert batch["pixel_values"].ndim == 3  # per-row budget layout
+
+    model = build_foundation_model(config=cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    logps = sequence_logprob_sums(
+        params, cfg, {k: jnp.asarray(v) for k, v in batch.items()}
+    )
+    assert logps.shape == (4,)
+    assert np.all(np.isfinite(np.asarray(logps)))
+    assert np.all(np.asarray(logps) < 0)

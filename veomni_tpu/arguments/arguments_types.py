@@ -113,6 +113,10 @@ class TrainingArguments:
     enable_full_determinism: bool = False
     seed: int = 42
     # checkpoint
+    # multihost HF weight load: replicated params read once on process 0 and
+    # broadcast over the interconnect instead of N filesystem reads
+    # (sharded params always stream only their local slices)
+    broadcast_weights_from_rank0: bool = False
     ckpt_manager: str = "orbax"
     save_steps: int = 0               # 0 = only at end
     save_hf_weights: bool = True

@@ -214,10 +214,14 @@ def _translate_train(train: Dict[str, Any], notes: List[str]) -> None:
         }, "train.profile", notes)
     if "max_steps" in train:
         train["train_steps"] = train.pop("max_steps")
+    if "broadcast_model_weights_from_rank0" in train:
+        train["broadcast_weights_from_rank0"] = train.pop(
+            "broadcast_model_weights_from_rank0"
+        )
     for k in ("init_device", "empty_cache_steps", "bsz_warmup_ratio",
               "bsz_warmup_init_mbtoken", "channel_loss", "use_doptim",
-              "broadcast_timeout", "broadcast_model_weights_from_rank0",
-              "use_rmpad", "load_balance", "calculate_per_token_loss"):
+              "broadcast_timeout", "use_rmpad", "load_balance",
+              "calculate_per_token_loss"):
         if k in train:
             _warn(notes, f"train.{k}", "no TPU counterpart, ignored")
             train.pop(k)

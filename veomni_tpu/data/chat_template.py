@@ -2,12 +2,16 @@
 processed media payloads.
 
 Reference: ``veomni/data/multimodal/multimodal_chat_template.py`` (995 LoC:
-Qwen2VL/Qwen3VL/Qwen25Omni templates expanding <image>/<video>/<audio>
+Qwen2VL/Qwen3VL/Qwen25Omni/Janus templates expanding <image>/<video>/<audio>
 content parts into placeholder-token runs, masking non-assistant tokens) and
-``data/chat_template.py``. Design here: one template class parameterized by
+``data/chat_template.py`` (chatml/llama2/default text templates +
+CHAT_TEMPLATE_REGISTRY). Design here: one template class parameterized by
 *media expanders* — callables that turn a media item into (placeholder ids,
 payload) — so VLM and omni variants differ only in their expander set, not
-in the message-walk logic.
+in the message-walk logic. ``CHAT_TEMPLATE_REGISTRY`` maps the reference's
+template names (qwen2vl / qwen2_5vl / qwen3vl / qwen2_5omni / janus /
+chatml / llama2) onto these builders; ``build_chat_template`` resolves a
+name + model config into a ready template.
 
 Message format (HF-conversations style):
   {"role": "user", "content": [
@@ -93,11 +97,17 @@ def qwen_vl_chat_template(
     vlm_config,
     *,
     video_kwargs: Optional[Dict[str, Any]] = None,
+    max_patches_per_sample: int = 0,
 ) -> MultimodalChatTemplate:
     """Qwen2.5-VL template: images/videos become
     ``vision_start + image_pad * n_merged (+ vision_end)`` runs whose length
     matches the vision tower's merged-token output for the real grid
-    (reference Qwen2VLTemplate.image_pattern/video_pattern)."""
+    (reference Qwen2VLTemplate.image_pattern/video_pattern).
+
+    ``max_patches_per_sample``: still images are downscaled so one image
+    never exceeds the collator's static per-sample budget (cap-by-resize —
+    placeholders stay consistent because the grid comes from the resized
+    array)."""
     from veomni_tpu.data.media import load_video
     from veomni_tpu.data.multimodal import image_to_qwen_patches, load_image
 
@@ -112,10 +122,27 @@ def qwen_vl_chat_template(
             out.append(vision_end)
         return out
 
+    def _cap_resize(arr: np.ndarray) -> np.ndarray:
+        if not max_patches_per_sample:
+            return arr
+        ps = vcfg.patch_size
+        unit_px = ps * m
+        h, w = arr.shape[:2]
+        n_patches = vcfg.temporal_patch_size * (h // ps) * (w // ps)
+        if n_patches <= max_patches_per_sample:
+            return arr
+        scale = (max_patches_per_sample / max(n_patches, 1)) ** 0.5
+        nh = max(unit_px, int(h * scale) // unit_px * unit_px)
+        nw = max(unit_px, int(w * scale) // unit_px * unit_px)
+        ys = np.linspace(0, h - 1, nh).astype(np.int64)
+        xs = np.linspace(0, w - 1, nw).astype(np.int64)
+        return arr[ys][:, xs]
+
     def expand_image(item) -> Tuple[List[int], Dict[str, Any]]:
         arr = load_image(item, image_size=0) if isinstance(item, str) else np.asarray(item, np.float32)
         if arr.max() > 1.5:
             arr = arr / 255.0
+        arr = _cap_resize(arr)
         patches, grid = image_to_qwen_patches(arr, vcfg)
         t, gh, gw = grid
         n_merged = t * (gh // m) * (gw // m)
@@ -195,3 +222,128 @@ def omni_chat_template(
         template.expanders["audio"] = expand_audio
 
     return template
+
+
+def janus_chat_template(tokenizer, janus_config) -> MultimodalChatTemplate:
+    """Janus template (reference JanusChatTemplate): chatml-framed dialog
+    where each input image becomes ``tokens_per_image`` placeholder tokens
+    plus the square-resized pixel payload the SigLIP tower consumes."""
+    cfg = janus_config
+    vcfg = cfg.vision
+
+    def expand_image(item) -> Tuple[List[int], Dict[str, Any]]:
+        from veomni_tpu.data.multimodal import load_image
+
+        arr = load_image(item, image_size=vcfg.image_size)
+        run = [cfg.image_token_id] * cfg.vision.tokens_per_image
+        return run, {"pixel_values": arr}
+
+    return MultimodalChatTemplate(
+        tokenizer=tokenizer, expanders={"image": expand_image}
+    )
+
+
+# ----------------------------------------------------------- text templates
+@dataclass
+class ChatmlTemplate:
+    """Tokenizer-independent chatml rendering (reference ChatmlTemplate):
+    works when the tokenizer ships no jinja chat template. Labels supervise
+    assistant turns (incl. the closing tag)."""
+
+    tokenizer: Any
+
+    def encode_messages(self, messages: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        return MultimodalChatTemplate(tokenizer=self.tokenizer).encode_messages(
+            messages
+        )
+
+
+@dataclass
+class Llama2Template:
+    """Llama-2 [INST] dialog rendering (reference Llama2Template)."""
+
+    tokenizer: Any
+
+    def _tok(self, text: str) -> List[int]:
+        return self.tokenizer(text, add_special_tokens=False)["input_ids"]
+
+    def encode_messages(self, messages: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        ids: List[int] = []
+        labels: List[int] = []
+        system = ""
+        for msg in messages:
+            content = msg.get("content", "")
+            if not isinstance(content, str):
+                content = "".join(
+                    p.get("text", "") if isinstance(p, dict) else str(p)
+                    for p in content
+                )
+            role = msg["role"]
+            if role == "system":
+                system = f"<<SYS>>\n{content}\n<</SYS>>\n\n"
+                continue
+            if role == "user":
+                t = self._tok(f"[INST] {system}{content} [/INST]")
+                system = ""
+                ids += t
+                labels += [IGNORE_INDEX] * len(t)
+            else:
+                # the closing </s> is supervised so the model learns to stop
+                eos = getattr(self.tokenizer, "eos_token", None) or "</s>"
+                t = self._tok(f" {content} {eos}")
+                ids += t
+                labels += t
+        return {"input_ids": ids, "labels": labels}
+
+
+# ------------------------------------------------------------------ registry
+# reference TEMPLATES (multimodal_chat_template.py:978) + text registry
+# (chat_template.py CHAT_TEMPLATE_REGISTRY) in one name->builder map;
+# builders take (tokenizer, config) — config is the model config for
+# media-expanding templates, ignored by text-only ones.
+CHAT_TEMPLATE_REGISTRY: Dict[str, Callable] = {
+    "qwen2vl": lambda tok, cfg, **kw: qwen_vl_chat_template(tok, cfg, **kw),
+    "qwen2_5vl": lambda tok, cfg, **kw: qwen_vl_chat_template(tok, cfg, **kw),
+    "qwen25_vl": lambda tok, cfg, **kw: qwen_vl_chat_template(tok, cfg, **kw),
+    "qwen3vl": lambda tok, cfg, **kw: qwen_vl_chat_template(tok, cfg, **kw),
+    "qwen2_5omni": lambda tok, cfg, **kw: omni_chat_template(tok, cfg, **kw),
+    "qwen3omni": lambda tok, cfg, **kw: omni_chat_template(tok, cfg, **kw),
+    "janus": lambda tok, cfg, **kw: janus_chat_template(tok, cfg),
+    "chatml": lambda tok, cfg=None, **kw: ChatmlTemplate(tok),
+    "llama2": lambda tok, cfg=None, **kw: Llama2Template(tok),
+}
+
+# model_type -> template name (so data.chat_template: default resolves)
+_MODEL_TYPE_TEMPLATES = {
+    "qwen2_vl": "qwen2vl",
+    "qwen2_5_vl": "qwen2_5vl",
+    "qwen3_vl": "qwen3vl",
+    "qwen3_vl_moe": "qwen3vl",
+    "qwen2_5_omni": "qwen2_5omni",
+    "qwen3_omni_moe": "qwen3omni",
+    "janus": "janus",
+}
+
+
+# names whose builders expand media and therefore need the model config
+_MEDIA_TEMPLATE_NAMES = frozenset(
+    n for n in CHAT_TEMPLATE_REGISTRY if n not in ("chatml", "llama2")
+)
+
+
+def build_chat_template(name: str, tokenizer, config=None, **kw):
+    """Resolve a template by explicit name, or by the config's model_type
+    when ``name`` is empty/"default"."""
+    if (not name or name == "default") and config is not None:
+        name = _MODEL_TYPE_TEMPLATES.get(getattr(config, "model_type", ""), name)
+    if name in _MEDIA_TEMPLATE_NAMES and config is None:
+        raise ValueError(
+            f"chat template {name!r} expands media and needs the model "
+            "config (use it through the VLM/omni data pipeline, or pick a "
+            "text template: chatml / llama2)"
+        )
+    if name in CHAT_TEMPLATE_REGISTRY:
+        return CHAT_TEMPLATE_REGISTRY[name](tokenizer, config, **kw)
+    raise ValueError(
+        f"unknown chat template {name!r}; known: {sorted(CHAT_TEMPLATE_REGISTRY)}"
+    )

@@ -43,8 +43,27 @@ def build_plaintext_transform(tokenizer, text_keys: str = "text", max_seq_len: i
 
 
 @DATA_TRANSFORM_REGISTRY.register("conversation")
-def build_conversation_transform(tokenizer, max_seq_len: int = 0, messages_key: str = "messages", **_):
-    """SFT chat transform: loss only on assistant turns (prompt masked)."""
+def build_conversation_transform(tokenizer, max_seq_len: int = 0,
+                                 messages_key: str = "messages",
+                                 chat_template: str = "default", **_):
+    """SFT chat transform: loss only on assistant turns (prompt masked).
+
+    ``chat_template`` other than "default" renders through the named
+    registry template (chatml/llama2/... — reference chat_template.py)
+    instead of the tokenizer's own jinja template."""
+    if chat_template and chat_template != "default":
+        from veomni_tpu.data.chat_template import build_chat_template
+
+        tmpl = build_chat_template(chat_template, tokenizer)
+
+        def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+            enc = tmpl.encode_messages(row[messages_key])
+            ids, labels = enc["input_ids"], enc["labels"]
+            if max_seq_len:
+                ids, labels = ids[:max_seq_len], labels[:max_seq_len]
+            return {"input_ids": ids, "labels": labels}
+
+        return transform
 
     def transform(row: Dict[str, Any]) -> Dict[str, Any]:
         messages = row[messages_key]
@@ -83,6 +102,7 @@ _LAZY_TRANSFORM_MODULES = {
     "qwen2_5_vl_conversation": "veomni_tpu.data.multimodal",
     "rl": "veomni_tpu.trainer.rl_trainer",
     "dpo": "veomni_tpu.trainer.dpo_trainer",
+    "vlm_dpo": "veomni_tpu.trainer.dpo_trainer",
     "distill": "veomni_tpu.trainer.distill_trainer",
 }
 

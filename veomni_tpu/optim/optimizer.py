@@ -36,6 +36,10 @@ def build_optimizer(
     if optimizer in ("adamw", "anyprecision_adamw"):
         import jax.numpy as jnp
 
+        if optimizer == "anyprecision_adamw" and mu_dtype is None:
+            # reference AnyPrecisionAdamW keeps momentum in bf16 to halve
+            # optimizer-state HBM; the variance stays f32 for stability
+            mu_dtype = "bfloat16"
         base = optax.adamw(
             learning_rate=lr,
             b1=betas[0],

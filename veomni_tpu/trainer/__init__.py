@@ -16,6 +16,10 @@ def __getattr__(name):  # lazy: the heavier trainers pull optional deps
         from veomni_tpu.trainer.dpo_trainer import DPOTrainer
 
         return DPOTrainer
+    if name == "VLMDPOTrainer":
+        from veomni_tpu.trainer.dpo_trainer import VLMDPOTrainer
+
+        return VLMDPOTrainer
     if name == "RLTrainer":
         from veomni_tpu.trainer.rl_trainer import RLTrainer
 
@@ -28,4 +32,5 @@ def __getattr__(name):  # lazy: the heavier trainers pull optional deps
 
 
 __all__ = ["BaseTrainer", "TextTrainer", "VLMTrainer", "OmniTrainer",
-           "DiTTrainer", "DPOTrainer", "RLTrainer", "DistillTrainer"]
+           "DiTTrainer", "DPOTrainer", "VLMDPOTrainer", "RLTrainer",
+           "DistillTrainer"]

@@ -194,7 +194,7 @@ class BaseTrainer:
         self.data_transform = build_data_transform(
             d.data_type, tokenizer=self.tokenizer,
             text_keys=d.text_keys, max_seq_len=d.max_seq_len,
-            channel_list=d.channel_list,
+            channel_list=d.channel_list, chat_template=d.chat_template,
         )
 
     def _build_dataset(self):
@@ -291,9 +291,21 @@ class BaseTrainer:
             jax.eval_shape(make_base, self.rng), plan, ps
         )
         if self.args.model.model_path:
-            base_params = model.load_hf(
-                self.args.model.model_path, target_shardings=param_shardings
-            )
+            # env var is the transport into the family loaders; scoped so a
+            # later load_hf in this process doesn't inherit the choice
+            prev = os.environ.get("VEOMNI_WEIGHTS_BROADCAST")
+            if t.broadcast_weights_from_rank0:
+                os.environ["VEOMNI_WEIGHTS_BROADCAST"] = "1"
+            try:
+                base_params = model.load_hf(
+                    self.args.model.model_path, target_shardings=param_shardings
+                )
+            finally:
+                if t.broadcast_weights_from_rank0:
+                    if prev is None:
+                        os.environ.pop("VEOMNI_WEIGHTS_BROADCAST", None)
+                    else:
+                        os.environ["VEOMNI_WEIGHTS_BROADCAST"] = prev
         else:
             base_params = jax.jit(make_base, out_shardings=param_shardings)(self.rng)
 

@@ -149,11 +149,12 @@ class TextDPOTrainer(BaseTrainer):
         model, cfg = self.model, self.model.config
         beta = float(self.args.train.dpo_beta)
         merge = self.merge_params
+        logprob_fn = self._logprob_fn()
 
         def dpo_loss(params, batch):
-            logps = sequence_logprob_sums(merge(params), cfg, batch)    # [2P]
-            ref_logps = sequence_logprob_sums(
-                jax.lax.stop_gradient(self.ref_params), cfg, batch
+            logps = logprob_fn(merge(params), batch)                    # [2P]
+            ref_logps = logprob_fn(
+                jax.lax.stop_gradient(self.ref_params), batch
             )
             p = logps.shape[0] // 2
             # even rows = chosen, odd rows = rejected (collator adjacency)
@@ -172,3 +173,162 @@ class TextDPOTrainer(BaseTrainer):
             max_grad_norm=self.args.train.max_grad_norm,
             grad_mask=self.grad_mask,
         )
+
+    def _logprob_fn(self):
+        """Per-row label-logprob sums [2P]; subclasses route through their
+        full (multimodal) forward."""
+        cfg = self.model.config
+        return lambda params, batch: sequence_logprob_sums(params, cfg, batch)
+
+
+# --------------------------------------------------------------- VLM variant
+@DATA_TRANSFORM_REGISTRY.register("vlm_dpo")
+def build_vlm_dpo_transform(tokenizer=None, vlm_config=None,
+                            max_seq_len: int = 0,
+                            max_patches_per_sample: int = 0, **_):
+    """Multimodal preference rows (reference multimodal chat template +
+    text_dpo pipeline): {"messages": [prompt messages incl. media parts],
+    "chosen": str|ids, "rejected": str|ids}. The prompt (with its expanded
+    image placeholders) is loss-masked in both branches; the media payload is
+    shared by the pair. Images downscale to ``max_patches_per_sample`` so
+    ordinary data can never blow the collator's static per-row budget."""
+    from veomni_tpu.data.chat_template import qwen_vl_chat_template
+
+    template = qwen_vl_chat_template(
+        tokenizer, vlm_config, max_patches_per_sample=max_patches_per_sample
+    )
+
+    def tok(x):
+        if isinstance(x, str):
+            return tokenizer(x, add_special_tokens=False)["input_ids"]
+        return list(x)
+
+    def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        enc = template.encode_messages(row["messages"])
+        # open the assistant turn; each branch supplies its own body + close
+        prompt_ids = enc["input_ids"] + template._tok(
+            f"{template.im_start}assistant\n"
+        )
+        close = template._tok(f"{template.im_end}\n")
+        out: Dict[str, Any] = {
+            "vis_patches": enc.get("vis_patches", []),
+            "vis_grids": enc.get("vis_grids", []),
+        }
+        for side in ("chosen", "rejected"):
+            resp = tok(row[side]) + close
+            ids = (prompt_ids + resp)[: max_seq_len or None]
+            labels = ([IGNORE_INDEX] * len(prompt_ids) + resp)[: len(ids)]
+            out[f"{side}_input_ids"] = ids
+            out[f"{side}_labels"] = labels
+        return out
+
+    return transform
+
+
+class VLMDPOPairCollator:
+    """Pairs -> per-row-budget VLM micro-batch [2P, S] (+ vision arrays with
+    a batch dim): row 2i = chosen, 2i+1 = rejected, both rows carrying the
+    pair's shared media. Delegates to Qwen25VLCollator in per-row mode."""
+
+    def __init__(self, seq_len: int, pairs: int, vlm_config, max_patches: int,
+                 sp_size: int = 1):
+        from veomni_tpu.data.multimodal import Qwen25VLCollator
+
+        self.pairs = pairs
+        self.inner = Qwen25VLCollator(
+            seq_len=seq_len, micro_batch_size=2 * pairs,
+            vlm_config=vlm_config, max_patches=max_patches,
+            sp_size=sp_size, per_row=True,
+        )
+
+    def __call__(self, samples):
+        rows = []
+        for sample in samples[: self.pairs]:
+            for side in ("chosen", "rejected"):
+                rows.append({
+                    "input_ids": sample[f"{side}_input_ids"],
+                    "labels": sample[f"{side}_labels"],
+                    "vis_patches": np.concatenate(sample["vis_patches"])
+                    if sample["vis_patches"] else None,
+                    "vis_grids": list(sample["vis_grids"]),
+                })
+        return self.inner(rows)
+
+
+class VLMDPOTrainer(TextDPOTrainer):
+    """DPO over a vision-language policy (qwen2_5_vl family): identical
+    preference math, log-probs through the full VLM forward."""
+
+    def _build_data_transform(self):
+        import jax as _jax
+
+        from veomni_tpu.data.data_transform import build_data_transform
+
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        nproc = _jax.process_count()
+        pairs = max(1, t.micro_batch_size * ps.dp_size // nproc)
+        budget = d.max_patches // nproc if nproc > 1 else d.max_patches
+        self.data_transform = build_data_transform(
+            "vlm_dpo", tokenizer=self.tokenizer, vlm_config=self.model.config,
+            max_seq_len=d.max_seq_len,
+            # per-row budget of the pair collator (2 rows per pair)
+            max_patches_per_sample=max(
+                self.model.config.vision.merge_unit, budget // (2 * pairs)
+            ),
+        )
+
+    def _build_dataloader(self):
+        from veomni_tpu.data.data_loader import build_dataloader
+
+        t, d = self.args.train, self.args.data
+        ps = self.parallel_state
+        self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
+        nproc = jax.process_count()
+        pairs = t.micro_batch_size * ps.dp_size // nproc
+        collator = VLMDPOPairCollator(
+            d.max_seq_len, pairs, vlm_config=self.model.config,
+            max_patches=d.max_patches // nproc if nproc > 1 else d.max_patches,
+            sp_size=ps.sp_size,
+        )
+        self.dataloader = build_dataloader(
+            d.dataloader_type,
+            dataset=self.dataset,
+            collate_fn=collator,
+            micro_batch_size=pairs,
+            grad_accum_steps=self.grad_accum_steps,
+            samples_per_micro_batch=pairs,
+            seed=t.seed,
+            dp_rank=jax.process_index(),
+            dp_size=nproc,
+            infinite=True,
+        )
+
+    def _batch_sharding_map(self):
+        from jax.sharding import PartitionSpec as P
+
+        ps = self.parallel_state
+        return {
+            "input_ids": P(None, ps.dp_axes, ps.sp_axes),
+            "labels": P(None, ps.dp_axes, ps.sp_axes),
+            "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
+            "position_ids": P(None, ps.dp_axes, None, ps.sp_axes),
+            "pixel_values": P(None, ps.dp_axes, None, None),
+            "vis_pos_hw": P(None, ps.dp_axes, None, None),
+            "vis_seg_window": P(None, ps.dp_axes, None),
+            "vis_seg_full": P(None, ps.dp_axes, None),
+            "vis_reverse": P(None, ps.dp_axes, None),
+            "vis_merged_mask": P(None, ps.dp_axes, None),
+        }
+
+    def _logprob_fn(self):
+        from veomni_tpu.models import qwen2_5_vl
+
+        cfg = self.model.config
+        return lambda params, batch: qwen2_5_vl.sequence_logprob_sums(
+            params, cfg, batch
+        )
+
+
+# package-level name (veomni_tpu.trainer.DPOTrainer)
+DPOTrainer = TextDPOTrainer

@@ -168,3 +168,7 @@ class BaseRLTrainer(BaseTrainer):
             max_grad_norm=self.args.train.max_grad_norm,
             grad_mask=self.grad_mask,
         )
+
+
+# package-level name (veomni_tpu.trainer.RLTrainer)
+RLTrainer = BaseRLTrainer

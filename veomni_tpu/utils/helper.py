@@ -34,15 +34,20 @@ class EnvironMeter:
     empty_cache_steps: int = 0
     consumed_tokens: int = 0
     _step_tokens: int = 0
-    _step_seq_len: int = 0
+    _step_token_seq: float = 0.0
     _step_extra_flops: float = 0.0
     _t_start: float = field(default_factory=time.perf_counter)
 
     def add(self, ntokens: int, seq_len: int, extra_flops: float = 0.0) -> None:
         """extra_flops: promised FORWARD flops outside the LM formula (ViT /
-        audio towers, DiT) for this batch; backward-scaled with the rest."""
+        audio towers, DiT) for this batch; backward-scaled with the rest.
+
+        Attention FLOPs are linear in seq_len per token, so accumulating
+        ``ntokens * seq_len`` makes the token-weighted mean seq-len EXACT for
+        mixed-length accumulation windows (a max would over-credit MFU the
+        moment dynamic batching mixes pack lengths)."""
         self._step_tokens += int(ntokens)
-        self._step_seq_len = max(self._step_seq_len, int(seq_len))
+        self._step_token_seq += float(ntokens) * float(seq_len)
         self._step_extra_flops += float(extra_flops)
 
     def step(self) -> Dict[str, float]:
@@ -57,13 +62,14 @@ class EnvironMeter:
             "consumed_tokens": float(self.consumed_tokens),
         }
         if self.flops_counter is not None and (tokens or self._step_extra_flops):
-            achieved = self.flops_counter.batch_flops(tokens, self._step_seq_len or tokens)
+            eff_seq = self._step_token_seq / tokens if tokens else 0.0
+            achieved = self.flops_counter.batch_flops(tokens, eff_seq or tokens)
             achieved += 3.0 * self._step_extra_flops
             peak = get_device_peak_flops() * max(1, self.world_size)
             metrics["tflops"] = achieved / dt / 1e12
             metrics["mfu"] = 100.0 * achieved / dt / peak
         self._step_tokens = 0
-        self._step_seq_len = 0
+        self._step_token_seq = 0.0
         self._step_extra_flops = 0.0
         self._t_start = time.perf_counter()
         return metrics
