@@ -1,0 +1,151 @@
+"""Offline condition-model cache for DiT training.
+
+Reference: ``veomni/trainer/dit_trainer.py:168-595`` runs the frozen
+condition models (VAE + text encoder) inline on GPU; the TPU design keeps
+the train step pure DiT and feeds it pre-computed rows — this script is the
+producer. It walks a jsonl of {"image": path | array, "caption": str} rows
+and writes the trainer's row format:
+
+  wan/qwen_image/flux:  {"latents": [...], "text_states": [[...], ...]}
+  slot-dit (cond_dim):  {"latents": [...], "cond": [...]}  (--cond_dim N
+                        mean-pools the text states into one [N] vector)
+
+Encoders (all frozen, run on CPU via torch — no TPU claim):
+  * text: any HF T5/CLIP encoder (``--text_encoder google/t5-v1_1-base``)
+  * vae:  a diffusers AutoencoderKL if the package+weights are available
+          (``--vae <dir>``); otherwise ``--pixel_latents`` area-downsamples
+          pixels into the latent grid — a stand-in that keeps the pipeline
+          runnable end-to-end where no VAE weights exist (tests, smoke).
+
+Usage:
+  python scripts/cache_dit_conditions.py --in data.jsonl --out cached.jsonl \
+      --latent_shape 16,8,8 --text_encoder google/t5-v1_1-base --text_len 64
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load_image(spec) -> np.ndarray:
+    if isinstance(spec, str):
+        from PIL import Image
+
+        return np.asarray(Image.open(spec).convert("RGB"), np.float32) / 255.0
+    arr = np.asarray(spec, np.float32)
+    return arr / 255.0 if arr.max() > 1.5 else arr
+
+
+def _pixel_latents(img: np.ndarray, shape) -> np.ndarray:
+    """Area-downsample pixels into [C, H, W] (or [C, F, H, W]) — the
+    VAE-free fallback encoder."""
+    c = shape[0]
+    h, w = shape[-2], shape[-1]
+    ys = np.linspace(0, img.shape[0] - 1, h).astype(np.int64)
+    xs = np.linspace(0, img.shape[1] - 1, w).astype(np.int64)
+    small = img[ys][:, xs]  # [h, w, 3]
+    reps = int(np.ceil(c / 3))
+    lat = np.tile(small.transpose(2, 0, 1), (reps, 1, 1))[:c]
+    lat = (lat - 0.5) * 2.0
+    if len(shape) == 4:  # video latent: single frame broadcast
+        lat = np.repeat(lat[:, None], shape[1], axis=1)
+    return lat
+
+
+def build_text_encoder(name: str, text_len: int):
+    import torch
+    from transformers import AutoModel, AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(name)
+    model = AutoModel.from_pretrained(name)
+    enc = getattr(model, "encoder", model)
+    enc.eval()
+
+    @torch.no_grad()
+    def encode(caption: str) -> np.ndarray:
+        ids = tok(caption, return_tensors="pt", truncation=True,
+                  max_length=text_len, padding="max_length")
+        out = enc(input_ids=ids["input_ids"],
+                  attention_mask=ids["attention_mask"])
+        return out.last_hidden_state[0].float().numpy()
+
+    return encode
+
+
+def build_vae(vae_dir: str):
+    try:
+        import torch
+        from diffusers import AutoencoderKL
+    except ImportError:
+        return None
+    vae = AutoencoderKL.from_pretrained(vae_dir)
+    vae.eval()
+
+    @torch.no_grad()
+    def encode(img: np.ndarray) -> np.ndarray:
+        x = torch.from_numpy(img.transpose(2, 0, 1))[None] * 2.0 - 1.0
+        lat = vae.encode(x).latent_dist.mode()[0]
+        return (lat * vae.config.scaling_factor).float().numpy()
+
+    return encode
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--in", dest="inp", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--latent_shape", required=True,
+                    help="C,H,W or C,F,H,W (comma separated)")
+    ap.add_argument("--text_encoder", default="")
+    ap.add_argument("--text_len", type=int, default=64)
+    ap.add_argument("--vae", default="")
+    ap.add_argument("--pixel_latents", action="store_true",
+                    help="VAE-free fallback latent encoder")
+    ap.add_argument("--caption_key", default="caption")
+    ap.add_argument("--image_key", default="image")
+    ap.add_argument("--cond_dim", type=int, default=0,
+                    help="emit a pooled 'cond' [N] vector instead of "
+                         "'text_states' (slot-dit row format)")
+    args = ap.parse_args()
+
+    shape = tuple(int(x) for x in args.latent_shape.split(","))
+    vae = build_vae(args.vae) if args.vae else None
+    if vae is None and not args.pixel_latents:
+        raise SystemExit(
+            "no VAE available: pass --vae <diffusers dir> or opt into the "
+            "--pixel_latents fallback explicitly"
+        )
+    text = build_text_encoder(args.text_encoder, args.text_len) \
+        if args.text_encoder else None
+
+    n = 0
+    with open(args.inp) as f_in, open(args.out, "w") as f_out:
+        for line in f_in:
+            row = json.loads(line)
+            img = _load_image(row[args.image_key])
+            lat = vae(img) if vae is not None else _pixel_latents(img, shape)
+            out = {"latents": np.asarray(lat, np.float32).tolist()}
+            if text is not None:
+                states = text(row.get(args.caption_key, ""))
+                if args.cond_dim:
+                    pooled = states.mean(0)
+                    cond = np.zeros(args.cond_dim, np.float32)
+                    n_c = min(args.cond_dim, len(pooled))
+                    cond[:n_c] = pooled[:n_c]
+                    out["cond"] = cond.tolist()
+                else:
+                    out["text_states"] = states.tolist()
+            elif args.cond_dim:
+                out["cond"] = np.zeros(args.cond_dim, np.float32).tolist()
+            f_out.write(json.dumps(out) + "\n")
+            n += 1
+    print(f"cached {n} rows -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

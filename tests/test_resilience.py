@@ -1,0 +1,111 @@
+"""Adversarial resilience tests (VERDICT r4 weak #8): capacity-overflow
+TRAINING behavior and dataloader resume across a topology change."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from veomni_tpu.arguments import VeOmniArguments
+
+
+def _write_data(path, n=96, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            f.write(json.dumps({
+                "input_ids": rng.integers(0, vocab, int(rng.integers(16, 80))).tolist(),
+            }) + "\n")
+
+
+def _args(tmp_path, **overrides):
+    args = VeOmniArguments()
+    args.model.config_overrides = {
+        "model_type": "qwen3_moe", "vocab_size": 256, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 16,
+        "qk_norm": True, "num_experts": 4, "num_experts_per_tok": 2,
+        "moe_intermediate_size": 32, **overrides,
+    }
+    args.data.train_path = str(tmp_path / "data.jsonl")
+    args.data.data_type = "pretokenized"
+    args.data.max_seq_len = 64
+    args.train.output_dir = str(tmp_path / "out")
+    args.train.micro_batch_size = 2
+    args.train.train_steps = 4
+    args.train.lr = 1e-3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.save_hf_weights = False
+    args.train.log_steps = 1
+    return args
+
+
+def test_capacity_overflow_training_stays_finite(tmp_path):
+    """A drastically undersized expert capacity (most tokens dropped) must
+    degrade throughput, not stability: finite loss/grad at every step."""
+    from veomni_tpu.trainer import TextTrainer
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _args(tmp_path, moe_capacity_factor=0.25)
+    trainer = TextTrainer(args)
+    losses = []
+
+    from veomni_tpu.trainer.callbacks import Callback
+
+    class Rec(Callback):
+        def on_step_end(self, t, state):
+            if state.synced:
+                losses.append(float(state.metrics["loss"]))
+                assert np.isfinite(state.metrics["grad_norm"])
+
+    trainer.callbacks.append(Rec())
+    ctl = trainer.train()
+    assert ctl.global_step == 4
+    assert all(np.isfinite(l) for l in losses) and len(losses) == 4
+    trainer.checkpointer.close()
+
+
+def test_resume_after_topology_change_warns_and_continues(tmp_path):
+    """A checkpoint whose per-rank extra state doesn't cover this rank
+    (process count changed between save and resume) must warn about the
+    dataloader cursor and still restore the train state + continue."""
+    from veomni_tpu.trainer import TextTrainer
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _args(tmp_path)
+    args.train.save_steps = 2
+    trainer = TextTrainer(args)
+    trainer.train()
+    trainer.checkpointer.close()
+
+    # simulate "saved by a different topology": this rank's extra-state file
+    # is absent, another rank's is present
+    step_dir = os.path.join(args.train.output_dir, "checkpoints", "global_step_4")
+    os.rename(
+        os.path.join(step_dir, "extra_state_rank0.json"),
+        os.path.join(step_dir, "extra_state_rank7.json"),
+    )
+
+    args2 = _args(tmp_path)
+    args2.train.train_steps = 6
+    trainer2 = TextTrainer(args2)
+    import logging
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    target = logging.getLogger("veomni_tpu.checkpoint.checkpointer")
+    target.addHandler(handler)
+    try:
+        restored, extra = trainer2.try_resume()
+    finally:
+        target.removeHandler(handler)
+    assert restored
+    assert any("topology" in r.getMessage() for r in records)
+    # training continues from the restored params
+    ctl = trainer2.train()
+    assert ctl.global_step == 6
+    assert np.isfinite(ctl.metrics["loss"])
+    trainer2.checkpointer.close()

@@ -19,6 +19,9 @@ import numpy as np
 from veomni_tpu.data.data_collator import IGNORE_INDEX
 from veomni_tpu.data.data_transform import DATA_TRANSFORM_REGISTRY
 from veomni_tpu.models.vision import ViTConfig
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 def load_image(source, image_size: int) -> np.ndarray:
@@ -192,6 +195,7 @@ def build_qwen25_vl_transform(
     max_seq_len: int = 0,
     max_patches_per_sample: int = 0,
     text_keys: str = "text",
+    channel_list=None,
     **_,
 ):
     """Rows: {"text" | "input_ids", "images": [HWC arrays or paths]}.
@@ -200,6 +204,7 @@ def build_qwen25_vl_transform(
     concern, handled by the conversation transform)."""
     cfg = vlm_config
     vcfg = cfg.vision
+    channel_index = {name: i for i, name in enumerate(channel_list or [])}
 
     def transform(row: Dict[str, Any]) -> Dict[str, Any]:
         patches_list, grids = [], []
@@ -248,13 +253,28 @@ def build_qwen25_vl_transform(
         labels += text_labels
         if max_seq_len:
             ids, labels = ids[:max_seq_len], labels[:max_seq_len]
-        return {
+        out = {
             "input_ids": ids,
             "labels": labels,
             "vis_patches": np.concatenate(patches_list)
             if patches_list else np.zeros((0, vcfg.patch_dim), np.float32),
             "vis_grids": grids,
         }
+        if "channel" in row:
+            ch = row["channel"]
+            if isinstance(ch, (int, np.integer)):
+                out["channel"] = int(ch)
+            elif ch in channel_index:
+                out["channel"] = channel_index[ch]
+            else:
+                # -1 drops the row from accounting; silence here would make
+                # a typo'd source name look like healthy under-counting
+                logger.warning_once(
+                    "unknown channel %r (known: %s) — tokens excluded from "
+                    "per-channel accounting", ch, sorted(channel_index),
+                )
+                out["channel"] = -1
+        return out
 
     return transform
 
@@ -341,7 +361,8 @@ class Qwen25VLCollator:
     per-row budget variant (follow-up)."""
 
     def __init__(self, seq_len: int, micro_batch_size: int, vlm_config,
-                 max_patches: int, sp_size: int = 1, per_row: bool = False):
+                 max_patches: int, sp_size: int = 1, per_row: bool = False,
+                 with_channels: bool = False):
         """``per_row=True`` switches to the per-row patch-budget layout
         (reference multihost slicing, ``data/data_collator.py:317-431``):
         every row gets its own ``max_patches // micro_batch_size`` buffer and
@@ -356,6 +377,7 @@ class Qwen25VLCollator:
         self.micro_batch_size = micro_batch_size
         self.cfg = vlm_config
         self.per_row = per_row
+        self.with_channels = with_channels
         if per_row:
             row = max_patches // micro_batch_size
             row -= row % unit
@@ -414,6 +436,8 @@ class Qwen25VLCollator:
             "labels": np.full((b, s), IGNORE_INDEX, np.int32),
             "segment_ids": np.zeros((b, s), np.int32),
         }
+        if self.with_channels:
+            out["channel_ids"] = np.full((b, s), -1, np.int32)
         row_patches: List[Any] = [None] * b
         row_grids: List[list] = [[] for _ in range(b)]
         total = 0
@@ -439,6 +463,8 @@ class Qwen25VLCollator:
             out["input_ids"][i, :n] = ids
             out["labels"][i, :n] = shifted
             out["segment_ids"][i, :n] = 1
+            if self.with_channels:
+                out["channel_ids"][i, :n] = int(sample.get("channel", -1))
         if self.per_row:
             px = np.zeros((b, self.max_patches, vcfg.patch_dim), np.float32)
             for i, rp in enumerate(row_patches):

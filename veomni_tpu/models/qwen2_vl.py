@@ -294,10 +294,9 @@ def vision_forward(
 # loss
 # ---------------------------------------------------------------------------
 
-def loss_fn(params, cfg: Qwen2VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """batch: input_ids/labels/segment_ids [B,S]; position_ids [B,3,S]
-    (mrope); pixel_values [N, patch_dim]; vis_pos_hw [N,2]; vis_seg [N];
-    vis_merged_mask [M]."""
+def _vision_merged_hidden(params, cfg: Qwen2VLConfig, batch):
+    """Vision tower + placeholder merge + text transformer; returns
+    (lm params, hidden [B,S,H], moe_aux, moe_dropped)."""
     tcfg = cfg.text
     vp = params["vision_tower"]
     if cfg.freeze_vision:
@@ -322,8 +321,16 @@ def loss_fn(params, cfg: Qwen2VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax
         lm, tcfg, batch["input_ids"], batch["position_ids"],
         batch.get("segment_ids"), inputs_embeds=embeds,
     )
+    return lm, hidden, moe_aux, moe_dropped
+
+
+def loss_fn(params, cfg: Qwen2VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: input_ids/labels/segment_ids [B,S]; position_ids [B,3,S]
+    (mrope); pixel_values [N, patch_dim]; vis_pos_hw [N,2]; vis_seg [N];
+    vis_merged_mask [M]."""
+    lm, hidden, moe_aux, moe_dropped = _vision_merged_hidden(params, cfg, batch)
     return transformer.head_loss(
-        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+        lm, cfg.text, hidden, batch["labels"], moe_aux, moe_dropped
     )
 
 

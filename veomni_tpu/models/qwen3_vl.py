@@ -456,11 +456,9 @@ def scatter_vision_features(input_ids, feats, merged_mask,
     )
 
 
-def loss_fn(params, cfg: Qwen3VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """batch: input_ids/labels/segment_ids [B,S]; position_ids [B,3,S]
-    (mrope); pixel_values [N, patch_dim] merge-block order; vis_pos_hw [N,2];
-    vis_pos_interp_idx/[4,N] vis_pos_interp_w [4,N]; vis_seg_full [N];
-    vis_merged_mask [M]."""
+def _vision_merged_hidden(params, cfg: Qwen3VLConfig, batch):
+    """Vision tower + deepstack scatter + text transformer; returns
+    (lm params, hidden [B,S,H], moe_aux, moe_dropped)."""
     tcfg = cfg.text
     vp = params["vision_tower"]
     if cfg.freeze_vision:
@@ -501,8 +499,17 @@ def loss_fn(params, cfg: Qwen3VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax
         batch.get("segment_ids"), inputs_embeds=embeds,
         post_layer_residuals=residuals,
     )
+    return lm, hidden, moe_aux, moe_dropped
+
+
+def loss_fn(params, cfg: Qwen3VLConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: input_ids/labels/segment_ids [B,S]; position_ids [B,3,S]
+    (mrope); pixel_values [N, patch_dim] merge-block order; vis_pos_hw [N,2];
+    vis_pos_interp_idx/[4,N] vis_pos_interp_w [4,N]; vis_seg_full [N];
+    vis_merged_mask [M]."""
+    lm, hidden, moe_aux, moe_dropped = _vision_merged_hidden(params, cfg, batch)
     return transformer.head_loss(
-        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+        lm, cfg.text, hidden, batch["labels"], moe_aux, moe_dropped
     )
 
 

@@ -22,18 +22,66 @@ from veomni_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
-def make_channel_loss_fn(model, num_channels: int) -> Callable:
-    """Wrap the text loss to additionally emit per-channel sums.
-    batch needs 'channel_ids' [B,S] (-1 on ignored/pad tokens)."""
-    cfg = model.config
+def _find_vlm_module(model_type: str):
+    """The module owning this VL family's ``_vision_merged_hidden`` preamble
+    (probe, not a table — a new family supports channel loss the moment its
+    module grows the preamble). MoE variants share the dense module."""
+    import importlib
 
-    def loss_fn(params, batch):
+    candidates = [model_type]
+    if model_type.endswith("_moe"):
+        candidates.append(model_type[: -len("_moe")])
+    for name in candidates:
+        try:
+            mod = importlib.import_module(f"veomni_tpu.models.{name}")
+        except ImportError:
+            continue
+        if hasattr(mod, "_vision_merged_hidden"):
+            return mod
+    return None
+
+
+def supports_channel_loss(model) -> bool:
+    """Text trees and any VL family exposing the merged-hidden preamble."""
+    return (
+        "embed_tokens" in model.abstract()
+        or _find_vlm_module(getattr(model.config, "model_type", "")) is not None
+    )
+
+
+def _hidden_fn(cfg):
+    """(params, batch) -> (head params, text cfg, hidden, moe_aux) for text
+    AND VL-family models (the per-channel CE only needs the pre-head hidden
+    states; each VL family exposes its merged-hidden preamble)."""
+    mod = _find_vlm_module(getattr(cfg, "model_type", ""))
+    if mod is not None:
+        def fn(params, batch):
+            lm, hidden, moe_aux, _ = mod._vision_merged_hidden(params, cfg, batch)
+            return lm, cfg.text, hidden, moe_aux
+
+        return fn
+
+    def fn(params, batch):
         hidden, moe_aux, _ = transformer.forward_hidden(
             params, cfg, batch["input_ids"], batch["position_ids"],
             batch.get("segment_ids"),
         )
+        return params, cfg, hidden, moe_aux
+
+    return fn
+
+
+def make_channel_loss_fn(model, num_channels: int) -> Callable:
+    """Wrap the model loss to additionally emit per-channel sums.
+    batch needs 'channel_ids' [B,S] (-1 on ignored/pad tokens). Works for
+    text and VL families (reference channel_loss_callback.py tracks every
+    trainer; omni composites remain out of scope)."""
+    hidden_fn = _hidden_fn(model.config)
+
+    def loss_fn(params, batch):
+        head_params, cfg, hidden, moe_aux = hidden_fn(params, batch)
         b, s, h = hidden.shape
-        kernel = transformer.lm_head_kernel(params, cfg).astype(cfg.dtype)
+        kernel = transformer.lm_head_kernel(head_params, cfg).astype(cfg.dtype)
         nll = fused_linear_cross_entropy_per_token(
             hidden.reshape(b * s, h), kernel, batch["labels"].reshape(b * s),
             logit_softcap=cfg.final_logit_softcap or None,

@@ -401,12 +401,15 @@ class BaseTrainer:
     def _inner_loss_fn(self, model):
         """Loss over FULL model params (LoRA merge, if any, wraps outside)."""
         if self.args.data.channel_list:
-            from veomni_tpu.train.channel_loss import make_channel_loss_fn
+            from veomni_tpu.train.channel_loss import (
+                make_channel_loss_fn,
+                supports_channel_loss,
+            )
 
-            if "embed_tokens" not in model.abstract():
+            if not supports_channel_loss(model):
                 raise NotImplementedError(
-                    "data.channel_list is only wired for text-family "
-                    "models (composite VLM/omni param trees unsupported)"
+                    "data.channel_list is wired for text and VL families; "
+                    "omni composite param trees are unsupported"
                 )
             return make_channel_loss_fn(model, len(self.args.data.channel_list))
         return lambda params, batch: model.loss_fn(params, batch)

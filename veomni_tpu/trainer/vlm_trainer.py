@@ -73,6 +73,7 @@ class VLMTrainer(BaseTrainer):
                     self.model.config.vision.merge_unit, per_sample
                 ),
                 text_keys=d.text_keys,
+                channel_list=d.channel_list,
             )
             return
         self.data_transform = build_data_transform(
@@ -114,6 +115,7 @@ class VLMTrainer(BaseTrainer):
                 max_patches=d.max_patches // nproc if nproc > 1 else d.max_patches,
                 sp_size=ps.sp_size,
                 per_row=self._vlm_per_row,
+                with_channels=bool(d.channel_list),
             )
         else:
             collator = VLMCollator(
@@ -145,6 +147,8 @@ class VLMTrainer(BaseTrainer):
             "labels": P(None, ps.dp_axes, ps.sp_axes),
             "segment_ids": P(None, ps.dp_axes, ps.sp_axes),
         }
+        if self.args.data.channel_list:
+            text["channel_ids"] = P(None, ps.dp_axes, ps.sp_axes)
         # per-row mode: every vision array gains a batch dim and shards over
         # dp exactly like the text; packed mode: one replicated global buffer
         pr = self._vlm_per_row
