@@ -44,8 +44,13 @@ def main():
             r = run_bench(int(seq_len), int(micro_bs), steps,
                           attention_impl=attn, remat_policy=remat)
         except Exception as e:  # OOM etc: record and continue the ladder
+            import re
+
+            msg = re.sub(r"\x1b\[[0-9;]*m", "", str(e))  # strip ANSI
+            oom = re.search(r"Ran out of memory.*?hbm capacity by [0-9.]+\w", msg)
             r = {"seq_len": seq_len, "micro_bs": micro_bs, "attention": attn,
-                 "remat_policy": remat, "error": repr(e)[:200]}
+                 "remat_policy": remat,
+                 "error": oom.group(0) if oom else msg[:600]}
         results.append(r)
         print(json.dumps(r), flush=True)
     ok = [r for r in results if "mfu" in r]

@@ -28,6 +28,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from veomni_tpu import ops
 from veomni_tpu.models.config import TransformerConfig
@@ -37,9 +38,31 @@ Params = Dict[str, Any]
 
 def _remat_policy(cfg: TransformerConfig):
     """Map cfg.remat_policy to a jax.checkpoint policy (the TPU analogue of
-    the reference's activation-offload contexts, ``offloading.py:32-74``)."""
+    the reference's activation-offload contexts, ``offloading.py:32-74``).
+
+    Policies, by saved-activation footprint (measured on qwen3-0.6B,
+    seq 4096 x mb 8, 15.75G-HBM v5e — BENCH_NOTES r5):
+    - "dots": every no-batch-dim dot output (~22G — OOMs one v5e chip next
+      to f32 optimizer state; the right default on pods where FSDP shards
+      the state).
+    - "ctx": ONLY the attention context (the post-softmax [B,S,nh*hd]
+      tensor, named "attn_ctx") + scan-carry layer boundaries. Backward
+      re-runs the cheap projection/FFN matmuls but never the O(S^2)
+      attention — the sweet spot on a single chip.
+    - "ctx_offload": same saves, parked in pinned host RAM.
+    - "offload": dot saves of "dots" parked in pinned host RAM.
+    - "nothing": full recompute.
+    """
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "ctx":
+        return jax.checkpoint_policies.save_only_these_names("attn_ctx")
+    if cfg.remat_policy == "ctx_offload":
+        return jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["attn_ctx"],
+            offload_src="device", offload_dst="pinned_host",
+        )
     if cfg.remat_policy == "offload":
         return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host"
@@ -379,6 +402,7 @@ def _standard_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, wi
         q, kk, v, segment_ids=segment_ids, causal=True,
         softmax_scale=scale, sliding_window=window, sinks=sinks,
     )
+    attn = checkpoint_name(attn, "attn_ctx")
     out = jnp.dot(attn.reshape(b, s, cfg.q_dim), lp["o_proj"])
     if "o_bias" in lp:
         out = out + lp["o_bias"]
@@ -484,6 +508,7 @@ def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window,
             q, k, v, segment_ids=segment_ids, causal=True,
             softmax_scale=scale, sliding_window=window,
         )
+    attn = checkpoint_name(attn, "attn_ctx")
     return jnp.dot(attn.reshape(b, s, nh * dv), lp["o_proj"])
 
 

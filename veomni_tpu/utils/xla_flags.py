@@ -48,6 +48,19 @@ def enable_compilation_cache() -> str | None:
     return cache_dir
 
 
+def _axon_plugin_registered() -> bool:
+    """Whether the axon relay PJRT plugin is registered (pre-init check —
+    reading ``jax.devices()`` here would trigger the very parse abort we are
+    avoiding)."""
+    try:
+        import jax
+        from jax._src import xla_bridge
+
+        return "axon" in xla_bridge._backend_factories
+    except Exception:
+        return False
+
+
 def apply_performance_flags() -> bool:
     """Append the TPU perf flags to XLA_FLAGS (idempotent) and enable the
     persistent compilation cache. Returns whether the flags are active."""
@@ -59,6 +72,16 @@ def apply_performance_flags() -> bool:
     import jax
 
     if jax._src.xla_bridge._backends:  # backend already up: flags won't apply
+        return False
+    if _axon_plugin_registered() and os.environ.get(
+        "VEOMNI_XLA_PERF_FLAGS"
+    ) != "force":
+        # The axon relay's plugin FATALS at XLA_FLAGS parse time on flags its
+        # XLA build doesn't know (parse_flags_from_env.cc "Unknown flags"
+        # abort, observed r5 with all three --xla_tpu_* scheduler flags).
+        # Its remote-compile terminal also overrides client XLA_FLAGS with
+        # its own compile env, so client-side flags would not reach the real
+        # compile anyway. Skip them; VEOMNI_XLA_PERF_FLAGS=force re-enables.
         return False
     current = os.environ.get("XLA_FLAGS", "")
     present = {tok.split("=")[0] for tok in current.split()}
