@@ -73,7 +73,9 @@ def run_point(seq_len: int, layout: dict, *, hidden=512, layers=2,
         )
 
         rng = np.random.default_rng(0)
-        ids = rng.integers(0, vocab, (1, 1, seq_len))
+        # batch dim must cover the dp axes (fsdp8 layout: 8-way dp shard
+        # needs 8 rows; sp layouts keep dp=1 and shard the sequence)
+        ids = rng.integers(0, vocab, (1, max(ps.dp_size, 1), seq_len))
         batch = {
             "input_ids": jnp.asarray(ids, jnp.int32),
             "labels": jnp.asarray(ids, jnp.int32),

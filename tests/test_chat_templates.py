@@ -128,3 +128,63 @@ def test_vlm_dpo_transform_collate_and_logprobs():
     assert logps.shape == (4,)
     assert np.all(np.isfinite(np.asarray(logps)))
     assert np.all(np.asarray(logps) < 0)
+
+
+def _small_vl_cfg():
+    return build_config("qwen2_5_vl", **{
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "rope_scaling": {"type": "mrope", "mrope_section": [2, 3, 3]},
+        "vision": {
+            "depth": 2, "hidden_size": 32, "intermediate_size": 64,
+            "num_heads": 2, "patch_size": 2, "spatial_merge_size": 2,
+            "window_size": 8, "fullatt_block_indexes": [1],
+            "out_hidden_size": 64,
+        },
+        "image_token_id": 9, "video_token_id": 10, "vision_start_token_id": 8,
+    })
+
+
+def test_cap_resize_counts_still_image_patches_without_temporal_factor():
+    """A still image yields t=1 patch rows — an image exactly at the budget
+    must NOT be resized (the old tp-inflated count halved its resolution)."""
+    from veomni_tpu.data.chat_template import qwen_vl_chat_template
+
+    cfg = _small_vl_cfg()
+    # 8x8 px, patch 2 -> (8/2)^2 = 16 patch rows exactly
+    tmpl = qwen_vl_chat_template(FakeTok(), cfg, max_patches_per_sample=16)
+    enc = tmpl.encode_messages([
+        {"role": "user", "content": [
+            {"type": "image", "image": np.random.default_rng(0).random((8, 8, 3))},
+        ]},
+    ])
+    assert enc["vis_grids"][0] == (1, 4, 4)        # untouched grid
+    assert enc["vis_patches"][0].shape[0] == 16    # not downscaled
+
+
+def test_vlm_dpo_multi_image_row_respects_total_budget():
+    """3 images in one preference row must fit the per-sample budget TOTAL
+    (the per-item cap alone would overflow the collator's row budget 3x)."""
+    from veomni_tpu.data.data_transform import build_data_transform
+
+    cfg = _small_vl_cfg()
+    budget = 48
+    transform = build_data_transform(
+        "vlm_dpo", tokenizer=FakeTok(), vlm_config=cfg, max_seq_len=256,
+        max_patches_per_sample=budget,
+    )
+    rng = np.random.default_rng(1)
+    out = transform({
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "compare"},
+            *({"type": "image", "image": rng.random((32, 32, 3))}
+              for _ in range(3)),
+        ]}],
+        "chosen": "first",
+        "rejected": "second",
+    })
+    total = sum(p.shape[0] for p in out["vis_patches"])
+    assert total <= budget, f"{total} patches exceed the {budget} budget"
+    # all three images survived (downscaled, not dropped)
+    assert len(out["vis_grids"]) == 3

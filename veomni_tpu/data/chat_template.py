@@ -122,16 +122,27 @@ def qwen_vl_chat_template(
             out.append(vision_end)
         return out
 
+    # per-ITEM patch budget; a mutable cell so callers that know the row's
+    # media count can split a per-SAMPLE total across items
+    # (``set_patch_budget``, used by the vlm_dpo transform — the reference
+    # enforces the same per-sample cap in its collator budget walk,
+    # ``data/data_collator.py:317-431``)
+    item_budget = [int(max_patches_per_sample)]
+
     def _cap_resize(arr: np.ndarray) -> np.ndarray:
-        if not max_patches_per_sample:
+        budget = item_budget[0]
+        if not budget:
             return arr
         ps = vcfg.patch_size
         unit_px = ps * m
         h, w = arr.shape[:2]
-        n_patches = vcfg.temporal_patch_size * (h // ps) * (w // ps)
-        if n_patches <= max_patches_per_sample:
+        # a still image yields t=1 patch rows (the temporal_patch_size
+        # duplicate copies live inside patch_dim, not the row count —
+        # frames_to_qwen_patches returns [t*gh*gw, patch_dim])
+        n_patches = (h // ps) * (w // ps)
+        if n_patches <= budget:
             return arr
-        scale = (max_patches_per_sample / max(n_patches, 1)) ** 0.5
+        scale = (budget / max(n_patches, 1)) ** 0.5
         nh = max(unit_px, int(h * scale) // unit_px * unit_px)
         nw = max(unit_px, int(w * scale) // unit_px * unit_px)
         ys = np.linspace(0, h - 1, nh).astype(np.int64)
@@ -157,6 +168,21 @@ def qwen_vl_chat_template(
         from veomni_tpu.data.multimodal import frames_to_qwen_patches
 
         tp = vcfg.temporal_patch_size
+        if item_budget[0]:
+            # spatial cap first (one temporal unit must fit the budget),
+            # then bound the temporal extent to the remaining ratio
+            small = _cap_resize(frames[0])
+            if small.shape[:2] != frames.shape[1:3]:
+                h, w = frames.shape[1:3]
+                ys = np.linspace(0, h - 1, small.shape[0]).astype(np.int64)
+                xs = np.linspace(0, w - 1, small.shape[1]).astype(np.int64)
+                frames = frames[:, ys][:, :, xs]
+            ps_ = vcfg.patch_size
+            per_unit = max(
+                1, (frames.shape[1] // ps_) * (frames.shape[2] // ps_)
+            )
+            max_t = max(1, item_budget[0] // per_unit)
+            frames = frames[: max_t * tp]
         usable = (len(frames) // tp) * tp
         if not usable:
             frames = np.concatenate([frames] * tp)[:tp]
@@ -167,10 +193,18 @@ def qwen_vl_chat_template(
             "vis_patches": patches, "vis_grids": (t, gh, gw),
         }
 
-    return MultimodalChatTemplate(
+    template = MultimodalChatTemplate(
         tokenizer=tokenizer,
         expanders={"image": expand_image, "video": expand_video},
     )
+
+    def set_patch_budget(n: int) -> None:
+        """Override the per-item patch budget (e.g. per-sample total split
+        across the row's media count). Minimum: one merge block."""
+        item_budget[0] = max(m * m, int(n)) if n else 0
+
+    template.set_patch_budget = set_patch_budget
+    return template
 
 
 def omni_chat_template(
@@ -244,18 +278,12 @@ def janus_chat_template(tokenizer, janus_config) -> MultimodalChatTemplate:
 
 
 # ----------------------------------------------------------- text templates
-@dataclass
-class ChatmlTemplate:
+def ChatmlTemplate(tokenizer) -> MultimodalChatTemplate:
     """Tokenizer-independent chatml rendering (reference ChatmlTemplate):
     works when the tokenizer ships no jinja chat template. Labels supervise
-    assistant turns (incl. the closing tag)."""
-
-    tokenizer: Any
-
-    def encode_messages(self, messages: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
-        return MultimodalChatTemplate(tokenizer=self.tokenizer).encode_messages(
-            messages
-        )
+    assistant turns (incl. the closing tag). A text-only
+    MultimodalChatTemplate (no expanders) IS the chatml renderer."""
+    return MultimodalChatTemplate(tokenizer=tokenizer)
 
 
 @dataclass

@@ -203,7 +203,25 @@ def build_vlm_dpo_transform(tokenizer=None, vlm_config=None,
             return tokenizer(x, add_special_tokens=False)["input_ids"]
         return list(x)
 
+    def _media_count(messages) -> int:
+        n = 0
+        for msg in messages:
+            content = msg.get("content", "")
+            for part in content if isinstance(content, list) else [content]:
+                if isinstance(part, dict) and part.get("type") in ("image", "video"):
+                    n += 1
+        return n
+
     def transform(row: Dict[str, Any]) -> Dict[str, Any]:
+        # split the per-sample budget across the row's media so multi-image
+        # / video rows stay under the collator's static per-row budget (the
+        # per-item cap alone would let 3 images overflow it 3x)
+        if max_patches_per_sample:
+            # max(1, ...): a floor of 0 would mean "uncapped" to the
+            # template; set_patch_budget's merge-block minimum then applies
+            template.set_patch_budget(max(
+                1, max_patches_per_sample // max(1, _media_count(row["messages"]))
+            ))
         enc = template.encode_messages(row["messages"])
         # open the assistant turn; each branch supplies its own body + close
         prompt_ids = enc["input_ids"] + template._tok(
@@ -259,15 +277,24 @@ class VLMDPOTrainer(TextDPOTrainer):
     """DPO over a vision-language policy (qwen2_5_vl family): identical
     preference math, log-probs through the full VLM forward."""
 
-    def _build_data_transform(self):
-        import jax as _jax
+    def _pairs_per_process(self) -> int:
+        t = self.args.train
+        ps = self.parallel_state
+        nproc = jax.process_count()
+        global_pairs = t.micro_batch_size * ps.dp_size
+        if global_pairs % nproc:
+            raise ValueError(
+                f"global pair count {global_pairs} not divisible by "
+                f"process count {nproc}"
+            )
+        return global_pairs // nproc
 
+    def _build_data_transform(self):
         from veomni_tpu.data.data_transform import build_data_transform
 
-        t, d = self.args.train, self.args.data
-        ps = self.parallel_state
-        nproc = _jax.process_count()
-        pairs = max(1, t.micro_batch_size * ps.dp_size // nproc)
+        d = self.args.data
+        nproc = jax.process_count()
+        pairs = self._pairs_per_process()
         budget = d.max_patches // nproc if nproc > 1 else d.max_patches
         self.data_transform = build_data_transform(
             "vlm_dpo", tokenizer=self.tokenizer, vlm_config=self.model.config,
@@ -285,7 +312,7 @@ class VLMDPOTrainer(TextDPOTrainer):
         ps = self.parallel_state
         self.grad_accum_steps = self.args.compute_grad_accum(ps.dp_size)
         nproc = jax.process_count()
-        pairs = t.micro_batch_size * ps.dp_size // nproc
+        pairs = self._pairs_per_process()
         collator = VLMDPOPairCollator(
             d.max_seq_len, pairs, vlm_config=self.model.config,
             max_patches=d.max_patches // nproc if nproc > 1 else d.max_patches,
