@@ -197,12 +197,12 @@ def main():
         daemon=True,
     ).start()
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))
-    micro_bs = int(os.environ.get("BENCH_MICRO_BS", 8))
+    micro_bs = int(os.environ.get("BENCH_MICRO_BS", 4))
     steps = int(os.environ.get("BENCH_STEPS", 10))
     r = run_bench(
         seq_len, micro_bs, steps,
         attention_impl=os.environ.get("BENCH_ATTN_IMPL") or None,
-        remat_policy=os.environ.get("BENCH_REMAT", "dots"),
+        remat_policy=os.environ.get("BENCH_REMAT", "ctx"),
     )
     _done.set()  # before printing: the watchdog must never race the
     # real record out of a block-buffered stdout via os._exit
