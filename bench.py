@@ -41,17 +41,28 @@ def _watchdog(timeout_s: float):
     os._exit(3)
 
 
-def bench_config(remat_policy: str = "dots"):
+BENCH_PRESETS = {
+    # headline metric (largest of the family that fits one v5e with FULL
+    # f32 AdamW state)
+    "qwen3_0p6b": dict(hidden_size=1024, intermediate_size=3072,
+                       num_hidden_layers=28, param_dtype="float32"),
+    # MXU-representative point: hidden-2048 matmuls; fits one v5e only with
+    # a momentum-only optimizer (Muon) — bf16 params 3.4G + bf16 momentum
+    # 3.4G vs AdamW's 9.6G f32 state
+    "qwen3_1p7b": dict(hidden_size=2048, intermediate_size=6144,
+                       num_hidden_layers=28, param_dtype="bfloat16"),
+}
+
+
+def bench_config(remat_policy: str = "dots", preset: str = "qwen3_0p6b"):
     import jax.numpy as jnp
 
     from veomni_tpu.models import TransformerConfig
 
+    dims = dict(BENCH_PRESETS[preset])
     return TransformerConfig(
         model_type="qwen3",
         vocab_size=151936,
-        hidden_size=1024,
-        intermediate_size=3072,
-        num_hidden_layers=28,
         num_attention_heads=16,
         num_key_value_heads=8,
         head_dim=128,
@@ -60,7 +71,9 @@ def bench_config(remat_policy: str = "dots"):
         max_position_embeddings=131072,
         rope_theta=1e6,
         dtype=jnp.bfloat16,
+        param_dtype=getattr(jnp, dims.pop("param_dtype")),
         remat_policy=remat_policy,
+        **dims,
     )
 
 
@@ -104,6 +117,8 @@ def run_bench(
     attention_impl: str = None,
     remat_policy: str = "dots",
     donate: bool = True,
+    preset: str = "qwen3_0p6b",
+    optimizer: str = "adamw",
 ) -> dict:
     """One full train-throughput measurement; returns {tok_s_chip, mfu, dt}."""
     import jax
@@ -126,10 +141,13 @@ def run_bench(
     ps = init_parallel_state()
 
     with use_parallel_state(ps):
-        cfg = bench_config(remat_policy)
+        cfg = bench_config(remat_policy, preset)
         model = build_foundation_model(config=cfg)
         plan = model.get_parallel_plan()
-        opt = build_optimizer(model.abstract(), lr=build_lr_scheduler(lr=1e-4, train_steps=1000))
+        opt = build_optimizer(
+            model.abstract(), optimizer=optimizer,
+            lr=build_lr_scheduler(lr=1e-4, train_steps=1000),
+        )
 
         def make_state(rng):
             return build_train_state(model.family.init_params(rng, cfg), opt)
@@ -184,7 +202,8 @@ def run_bench(
         return {"tok_s_chip": tok_per_sec_chip, "mfu": mfu, "dt": dt,
                 "seq_len": seq_len, "micro_bs": micro_bs, "steps": steps,
                 "attention": attention_impl or "auto",
-                "remat_policy": remat_policy}
+                "remat_policy": remat_policy, "preset": preset,
+                "optimizer": optimizer}
 
 
 def main():
@@ -196,6 +215,11 @@ def main():
         args=(float(os.environ.get("BENCH_WATCHDOG_S", 900)),),
         daemon=True,
     ).start()
+    preset = os.environ.get("BENCH_PRESET", "qwen3_0p6b")
+    if preset not in BENCH_PRESETS:  # fail fast, BEFORE the chip claim
+        raise SystemExit(
+            f"unknown BENCH_PRESET {preset!r}; choose from {sorted(BENCH_PRESETS)}"
+        )
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))
     micro_bs = int(os.environ.get("BENCH_MICRO_BS", 4))
     steps = int(os.environ.get("BENCH_STEPS", 10))
@@ -203,14 +227,16 @@ def main():
         seq_len, micro_bs, steps,
         attention_impl=os.environ.get("BENCH_ATTN_IMPL") or None,
         remat_policy=os.environ.get("BENCH_REMAT", "ctx"),
+        preset=preset,
+        optimizer=os.environ.get("BENCH_OPT", "adamw"),
     )
     _done.set()  # before printing: the watchdog must never race the
     # real record out of a block-buffered stdout via os._exit
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(r["tok_s_chip"], 1),
-        "unit": f"tokens/s/chip (qwen3-0.6B bf16, seq{seq_len}, "
-                f"mfu={r['mfu']:.1f}%)",
+        "unit": f"tokens/s/chip ({r['preset']} bf16 {r['optimizer']}, "
+                f"seq{seq_len}, mfu={r['mfu']:.1f}%)",
         "vs_baseline": round(r["mfu"] / 40.0, 4),
     }), flush=True)
 

@@ -1,7 +1,14 @@
 """MFU ladder: sweep attention impl x micro-batch x remat on the real chip.
 
+Config entries: [seq_len, micro_bs, attention_impl, remat_policy] with two
+optional trailing fields [, preset [, optimizer]] — preset one of
+bench.BENCH_PRESETS (default qwen3_0p6b), optimizer passed to
+build_optimizer (default adamw; "muon" fits the 1p7b preset on one v5e).
+
 Run:  python scripts/mfu_sweep.py            # full ladder
-      SWEEP_CONFIGS='[[4096,8,"xla","dots"]]' python scripts/mfu_sweep.py
+      SWEEP_CONFIGS='[[4096,8,"xla","dots"],
+                      [2048,4,"xla_twopass","ctx","qwen3_1p7b","muon"]]' \
+          python scripts/mfu_sweep.py
 
 Appends one JSON line per config to stdout; the best config should become
 bench.py's default (see BENCH_NOTES.md for the recorded ladder).
@@ -39,17 +46,20 @@ def main():
     configs = json.loads(os.environ.get("SWEEP_CONFIGS", "null")) or DEFAULT
     steps = int(os.environ.get("SWEEP_STEPS", 8))
     results = []
-    for seq_len, micro_bs, attn, remat in configs:
+    for seq_len, micro_bs, attn, remat, *extra in configs:
+        preset = extra[0] if extra else "qwen3_0p6b"
+        opt = extra[1] if len(extra) > 1 else "adamw"
         try:
             r = run_bench(int(seq_len), int(micro_bs), steps,
-                          attention_impl=attn, remat_policy=remat)
+                          attention_impl=attn, remat_policy=remat,
+                          preset=preset, optimizer=opt)
         except Exception as e:  # OOM etc: record and continue the ladder
             import re
 
             msg = re.sub(r"\x1b\[[0-9;]*m", "", str(e))  # strip ANSI
             oom = re.search(r"Ran out of memory.*?hbm capacity by [0-9.]+\w", msg)
             r = {"seq_len": seq_len, "micro_bs": micro_bs, "attention": attn,
-                 "remat_policy": remat,
+                 "remat_policy": remat, "preset": preset, "optimizer": opt,
                  "error": oom.group(0) if oom else msg[:600]}
         results.append(r)
         print(json.dumps(r), flush=True)
