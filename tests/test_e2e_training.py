@@ -224,3 +224,35 @@ def test_e2e_eval_loop(tmp_path):
         assert "eval_loss" in ctl.metrics
     finally:
         destroy_parallel_state()
+
+
+def test_e2e_training_ctx_remat_policy(tmp_path):
+    """bench.py's default remat policy ("ctx": save only the named attention
+    context) must train end-to-end through the CLI argument plumbing
+    (train.gradient_checkpointing_policy -> cfg.remat_policy) with losses
+    matching the nothing-policy run exactly (same seeds, pure remat change)."""
+    from veomni_tpu.trainer import TextTrainer
+
+    _write_dummy_data(tmp_path / "data.jsonl")
+    losses = {}
+    for policy in ("ctx", "nothing"):
+        args = _make_args(
+            tmp_path, train_steps=4,
+            gradient_checkpointing_policy=policy,
+        )
+        args.model.config_overrides = {**TOY, "remat": True}
+        args.train.output_dir = str(tmp_path / f"out_{policy}")
+        trainer = TextTrainer(args)
+        orig_step = trainer.train_step
+        seen = []
+
+        def wrapped(state, batch, _s=seen, _o=orig_step):
+            out = _o(state, batch)
+            _s.append(float(out[1]["loss"]))
+            return out
+
+        trainer.train_step = wrapped
+        trainer.train()
+        losses[policy] = seen
+    assert len(losses["ctx"]) == 4
+    np.testing.assert_allclose(losses["ctx"], losses["nothing"], rtol=1e-6)

@@ -8,8 +8,25 @@ def force_cpu_devices(n: int = 8) -> None:
 
     The axon TPU plugin overrides JAX_PLATFORMS via jax.config at import, so
     env vars alone don't stick — we must update the config directly.
+
+    Also raises the XLA:CPU collective-rendezvous stuck/terminate timeouts:
+    N virtual devices time-share a few (often 1) physical cores, so a slow
+    participant can exceed the default 40s and SIGABRT the process mid-step
+    (observed: CollectivePermute AwaitAndLogIfStuck at seq 32k — the flags
+    only apply at first backend init, hence here).
     """
+    import os
+
     import jax
 
+    flags = os.environ.get("XLA_FLAGS", "")
+    for f in (
+        "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
+        "--xla_cpu_collective_call_terminate_timeout_seconds=1800",
+        "--xla_cpu_collective_timeout_seconds=1800",
+    ):
+        if f.split("=")[0] not in flags:
+            flags += " " + f
+    os.environ["XLA_FLAGS"] = flags.strip()
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", n)
