@@ -1,0 +1,47 @@
+"""Import-time device hygiene (TPU analogue of the reference's
+``tests/special_sanity/check_device_api_usage.py`` .cuda()-literal gate).
+
+On this stack the portable-device sin is *initializing a JAX backend at
+import time*: under the axon relay a backend init is a (possibly blocking,
+exclusive) TPU chip claim, so any module that calls jax.devices() /
+jax.device_count() at import turns `import veomni_tpu.x` into a second chip
+claimant — see BENCH_NOTES r5 "parse-time backend-init hazard". Every
+veomni_tpu module must import cleanly with backend construction forbidden.
+"""
+
+import importlib
+import pkgutil
+
+import pytest
+
+
+def _walk_modules():
+    import veomni_tpu
+
+    for m in pkgutil.walk_packages(veomni_tpu.__path__, "veomni_tpu."):
+        yield m.name
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_no_backend_init_at_import(monkeypatch):
+    from jax._src import xla_bridge
+
+    def _forbidden(*a, **k):
+        raise AssertionError(
+            "JAX backend initialized at import time — on the axon relay "
+            "this is a blocking exclusive TPU chip claim"
+        )
+
+    monkeypatch.setattr(xla_bridge, "backends", _forbidden)
+    monkeypatch.setattr(xla_bridge, "get_backend", _forbidden)
+    # jax.devices()/device_count()/local_devices() all route through these
+    failures = []
+    for name in _walk_modules():
+        try:
+            importlib.import_module(name)
+        except AssertionError as e:
+            failures.append((name, str(e).split(" — ")[0]))
+        except Exception:
+            # unrelated import errors (optional deps) are other tests' job
+            pass
+    assert not failures, f"backend init at import: {failures}"

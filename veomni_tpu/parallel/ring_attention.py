@@ -35,7 +35,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-_NEG = jnp.float32(-1e30)
+_NEG = -1e30  # plain float: a jnp scalar here would claim a device at import
 
 
 def _best_chunk(n: int, target: int) -> int:
