@@ -10,33 +10,82 @@ Run:  python scripts/mfu_sweep.py            # full ladder
                       [2048,4,"xla_twopass","ctx","qwen3_1p7b","muon"]]' \
           python scripts/mfu_sweep.py
 
+Modes:
+  in-process (default): one backend init for the whole ladder — fastest,
+      but a hung remote execution (observed: sweep-1's seq32k point sat
+      >25 min asleep) strands every remaining config.
+  SWEEP_SUBPROCESS=1: each config runs in its own python subprocess with a
+      SWEEP_CONFIG_TIMEOUT_S kill budget (default 1500s) — a hang costs one
+      config. Pays one chip claim (~25-45s when the relay is healthy) per
+      config; the claim risk of killing a hung child is confined to a
+      config that was already lost.
+
 Appends one JSON line per config to stdout; the best config should become
 bench.py's default (see BENCH_NOTES.md for the recorded ladder).
 """
 
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import run_bench  # noqa: E402
-
 
 DEFAULT = [
-    # [seq_len, micro_bs, attention_impl, remat_policy] — the VERDICT ladder:
-    # seq 2k -> 32k x attention impl x remat x micro-bs (xla_twopass is the
-    # measured-best attention on the relay-attached v5e, BENCH_NOTES r2)
-    [2048, 8, "xla_twopass", "dots"],
-    [4096, 8, "xla_twopass", "dots"],
-    [4096, 8, "xla_twopass", "nothing"],
-    [4096, 16, "xla_twopass", "dots"],
-    [4096, 8, "xla", "dots"],
-    [4096, 8, "pallas_flash", "dots"],
-    [8192, 4, "xla_twopass", "dots"],
-    [16384, 2, "xla_twopass", "dots"],
-    [32768, 1, "xla_twopass", "dots"],
+    # [seq_len, micro_bs, attention_impl, remat_policy] — the r5 ladder:
+    # ctx policy (the only one that fits beside f32 AdamW state on one
+    # v5e at real batch sizes, see docs/performance.md) + impl A/B
+    [2048, 8, "xla_twopass", "ctx"],
+    [4096, 4, "xla_twopass", "ctx"],
+    [4096, 8, "xla_twopass", "ctx"],
+    [2048, 2, "xla_twopass", "dots"],
+    [2048, 8, "xla", "ctx"],
+    [2048, 4, "xla_twopass", "ctx", "qwen3_1p7b", "muon"],
+    [4096, 2, "xla_twopass", "ctx", "qwen3_1p7b", "muon"],
+    [2048, 8, "pallas_flash", "ctx"],
 ]
+
+_CHILD = """
+import json, os, sys
+sys.path.insert(0, {root!r})
+from bench import run_bench
+r = run_bench({seq}, {mb}, {steps}, attention_impl={attn!r},
+              remat_policy={remat!r}, preset={preset!r}, optimizer={opt!r})
+print("SWEEPRESULT " + json.dumps(r), flush=True)
+"""
+
+
+def _norm(seq_len, micro_bs, attn, remat, preset, opt):
+    return dict(seq_len=seq_len, micro_bs=micro_bs, attention=attn,
+                remat_policy=remat, preset=preset, optimizer=opt)
+
+
+def _error_record(base, msg: str) -> dict:
+    import re
+
+    msg = re.sub(r"\x1b\[[0-9;]*m", "", msg)  # strip ANSI
+    oom = re.search(r"Ran out of memory.*?hbm capacity by [0-9.]+\w", msg)
+    return {**base, "error": oom.group(0) if oom else msg[-600:]}
+
+
+def _run_subprocess(seq_len, micro_bs, steps, attn, remat, preset, opt):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = _CHILD.format(root=root, seq=seq_len, mb=micro_bs, steps=steps,
+                         attn=attn, remat=remat, preset=preset, opt=opt)
+    base = _norm(seq_len, micro_bs, attn, remat, preset, opt)
+    timeout = float(os.environ.get("SWEEP_CONFIG_TIMEOUT_S", 1500))
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode() if isinstance(e.stderr, bytes)
+                else (e.stderr or ""))[-300:]
+        return {**base, "error": f"HANG >{int(timeout)}s (killed); {tail}"}
+    for line in p.stdout.splitlines():
+        if line.startswith("SWEEPRESULT "):
+            return json.loads(line[len("SWEEPRESULT "):])
+    return _error_record(base, p.stderr or p.stdout or f"exit {p.returncode}")
 
 
 def main():
@@ -45,22 +94,25 @@ def main():
     apply_performance_flags()
     configs = json.loads(os.environ.get("SWEEP_CONFIGS", "null")) or DEFAULT
     steps = int(os.environ.get("SWEEP_STEPS", 8))
+    use_subprocess = os.environ.get("SWEEP_SUBPROCESS") == "1"
     results = []
     for seq_len, micro_bs, attn, remat, *extra in configs:
         preset = extra[0] if extra else "qwen3_0p6b"
         opt = extra[1] if len(extra) > 1 else "adamw"
-        try:
-            r = run_bench(int(seq_len), int(micro_bs), steps,
-                          attention_impl=attn, remat_policy=remat,
-                          preset=preset, optimizer=opt)
-        except Exception as e:  # OOM etc: record and continue the ladder
-            import re
+        if use_subprocess:
+            r = _run_subprocess(int(seq_len), int(micro_bs), steps,
+                                attn, remat, preset, opt)
+        else:
+            from bench import run_bench
 
-            msg = re.sub(r"\x1b\[[0-9;]*m", "", str(e))  # strip ANSI
-            oom = re.search(r"Ran out of memory.*?hbm capacity by [0-9.]+\w", msg)
-            r = {"seq_len": seq_len, "micro_bs": micro_bs, "attention": attn,
-                 "remat_policy": remat, "preset": preset, "optimizer": opt,
-                 "error": oom.group(0) if oom else msg[:600]}
+            try:
+                r = run_bench(int(seq_len), int(micro_bs), steps,
+                              attention_impl=attn, remat_policy=remat,
+                              preset=preset, optimizer=opt)
+            except Exception as e:  # OOM etc: record and continue the ladder
+                r = _error_record(
+                    _norm(seq_len, micro_bs, attn, remat, preset, opt), str(e)
+                )
         results.append(r)
         print(json.dumps(r), flush=True)
     ok = [r for r in results if "mfu" in r]
