@@ -133,18 +133,31 @@ def main():
     ap.add_argument("--layers", type=int, default=2)
     args = ap.parse_args()
 
+    if len(args.seq) > 1:
+        # one seq per process: a second AOT lower/compile/call cycle in the
+        # same process trips a JAX const-args miscount ("compiled for N
+        # inputs but called with N-2") after the parallel-state rebuild
+        import subprocess
+
+        for seq in args.seq:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--seq", str(seq), "--sp", args.sp,
+                   "--remat", args.remat, "--chunk_mbs", str(args.chunk_mbs),
+                   "--hidden", str(args.hidden), "--layers", str(args.layers)]
+            subprocess.run(cmd, check=False)
+        return
+
     force_cpu_devices(8)
     import jax
 
     # reruns of the same points skip the multi-minute XLA:CPU compiles
     jax.config.update("jax_compilation_cache_dir", "/tmp/veomni_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
-    for seq in args.seq:
-        point = run_point(
-            seq, LAYOUTS[args.sp], remat_policy=args.remat,
-            chunk_mbs=args.chunk_mbs, hidden=args.hidden, layers=args.layers,
-        )
-        print(json.dumps(point), flush=True)
+    point = run_point(
+        args.seq[0], LAYOUTS[args.sp], remat_policy=args.remat,
+        chunk_mbs=args.chunk_mbs, hidden=args.hidden, layers=args.layers,
+    )
+    print(json.dumps(point), flush=True)
 
 
 if __name__ == "__main__":
