@@ -20,9 +20,14 @@ from veomni_tpu.models.transformer import forward_logits
 
 
 def generate(model, params, input_ids, max_new_tokens: int = 64, eos_id: int = -1):
-    """Greedy generation over a fixed window (re-runs the full prefix each
-    step; fine for interactive use — a KV-cache decode loop is the serving
-    engine's job)."""
+    """Greedy generation: KV-cache scan decode where the dialect supports it
+    (models/decode.py — the TPU equivalent of HF generate()'s cache), else
+    the fixed-window rescoring fallback (MLA/DSA/hybrid families)."""
+    from veomni_tpu.models.decode import greedy_generate, supports_cached_decode
+
+    if supports_cached_decode(model.config):
+        return greedy_generate(params, model.config, input_ids,
+                               max_new_tokens=max_new_tokens, eos_id=eos_id)
     cfg = model.config
     ids = list(map(int, input_ids))
     total = len(ids) + max_new_tokens

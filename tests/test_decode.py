@@ -1,0 +1,79 @@
+"""KV-cache greedy decode vs full-prefix rescoring parity.
+
+The cache path (models/decode.py) reimplements the layer walk; these tests
+anchor it to the training forward (models/transformer.py forward_logits) on
+the dialect extremes: qwen3 (GQA+qk_norm), gemma3-style (sandwich norms,
+sliding windows, dual rope, embed_scale, softcap), and qwen3_moe."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veomni_tpu.models import TransformerConfig, build_foundation_model
+from veomni_tpu.models.decode import greedy_generate, supports_cached_decode
+from veomni_tpu.models.transformer import forward_logits
+
+
+def _rescoring_generate(params, cfg, prompt, n, eos_id=-1):
+    ids = list(prompt)
+    total = len(ids) + n
+    for _ in range(n):
+        tokens = np.zeros((1, total), np.int32)
+        tokens[0, : len(ids)] = ids
+        pos = np.arange(total)[None]
+        seg = (np.arange(total) < len(ids)).astype(np.int32)[None]
+        logits = forward_logits(
+            params, cfg, jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(seg)
+        )
+        nxt = int(jnp.argmax(logits[0, len(ids) - 1]))
+        ids.append(nxt)
+        if nxt == eos_id:
+            break
+    return ids
+
+
+CONFIGS = {
+    "qwen3": dict(
+        model_type="qwen3", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, qk_norm=True,
+    ),
+    "gemma3ish": dict(
+        model_type="gemma3", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, qk_norm=True,
+        sandwich_norms=True, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+        rope_local_base_freq=10000.0,
+        query_pre_attn_scalar=16, final_logit_softcap=30.0,
+    ),
+    "qwen3_moe": dict(
+        model_type="qwen3_moe", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, qk_norm=True, num_experts=4,
+        num_experts_per_tok=2, moe_intermediate_size=32,
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_cached_decode_matches_rescoring(name):
+    cfg = TransformerConfig(dtype=jnp.float32, **CONFIGS[name])
+    assert supports_cached_decode(cfg)
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = list(np.random.default_rng(0).integers(1, 128, 9))
+    got = greedy_generate(params, cfg, prompt, max_new_tokens=6)
+    want = _rescoring_generate(params, cfg, prompt, 6)
+    assert got == want, (got, want)
+
+
+def test_cached_decode_rejects_mla():
+    cfg = TransformerConfig(
+        model_type="deepseek_v3", vocab_size=64, hidden_size=64,
+        num_hidden_layers=1, num_attention_heads=4,
+        kv_lora_rank=16, qk_nope_head_dim=8, qk_rope_head_dim=8,
+        v_head_dim=8,
+    )
+    assert not supports_cached_decode(cfg)

@@ -1,0 +1,325 @@
+"""KV-cache greedy decoding for the dense/MoE transformer families.
+
+Reference parity: the reference's ``tasks/infer/infer_text.py`` delegates to
+HF ``model.generate()``, which carries a KV cache; this module is the
+TPU-native equivalent — a jitted prefill that records per-layer k/v, and a
+``lax.scan`` decode loop over a static-shape cache (XLA-friendly: no dynamic
+shapes, one compile per (prompt_bucket, max_new) pair).
+
+Scope: the standard-attention dialect set of ``models/transformer.py``
+(GQA + qk-norm, partial/dual rotary, sliding windows, sinks, sandwich
+norms, dense or MoE MLP). MLA (deepseek), DSA, and hybrid linear-attention
+(qwen3_next) families fall back to the caller's rescoring path —
+``supports_cached_decode`` says which.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu import ops
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.models.transformer import (
+    _moe_mlp,
+    _norm,
+    gated_act,
+    lm_head_kernel,
+)
+
+
+def supports_cached_decode(cfg: TransformerConfig) -> bool:
+    return not (
+        getattr(cfg, "use_mla", False)
+        or getattr(cfg, "use_dsa", False)
+        or cfg.model_type in ("qwen3_next",)
+    )
+
+
+def _rope_tables(cfg: TransformerConfig, positions: jax.Array):
+    """(cos_g, sin_g, cos_l, sin_l) for global + (optional) local rope."""
+    rope_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
+    cos_g, sin_g = ops.rotary_tables(
+        positions, rope_dim, cfg.rope_theta, rope_scaling=cfg.rope_scaling
+    )
+    if cfg.rope_local_base_freq:
+        cos_l, sin_l = ops.rotary_tables(positions, rope_dim, cfg.rope_local_base_freq)
+    else:
+        cos_l, sin_l = cos_g, sin_g
+    to = lambda t: t.astype(cfg.dtype)
+    return to(cos_g), to(sin_g), to(cos_l), to(sin_l)
+
+
+def _qkv(x, lp, cfg: TransformerConfig, cos, sin):
+    """x [B,T,H] -> q [B,T,hq,d], k/v [B,T,hkv,d] with norms + rope applied."""
+    b, t, _ = x.shape
+    q = jnp.dot(x, lp["q_proj"])
+    k = jnp.dot(x, lp["k_proj"])
+    v = jnp.dot(x, lp["v_proj"])
+    if cfg.attention_bias:
+        q, k, v = q + lp["q_bias"], k + lp["k_bias"], v + lp["v_bias"]
+    q = q.reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = _norm(q, lp["q_norm"], cfg)
+        k = _norm(k, lp["k_norm"], cfg)
+    rot = cos.shape[-1]
+    if rot < cfg.head_dim:
+        q_r, k_r = ops.apply_rotary(q[..., :rot], k[..., :rot], cos, sin)
+        q = jnp.concatenate([q_r, q[..., rot:]], axis=-1)
+        k = jnp.concatenate([k_r, k[..., rot:]], axis=-1)
+    else:
+        q, k = ops.apply_rotary(q, k, cos, sin)
+    return q, k, v
+
+
+def _cache_attend(q, k_cache, v_cache, valid_mask, cfg: TransformerConfig,
+                  sinks=None):
+    """q [B,T,hq,d] against the full static cache [B,M,hkv,d]; valid_mask
+    [B,T,M] bool (causal+window+length). Dense math — decode T is 1 (or the
+    short prefill), the cache is the long axis."""
+    nrep = cfg.num_attention_heads // cfg.num_key_value_heads
+    if nrep > 1:
+        b, m, hk, d = k_cache.shape
+        k_cache = jnp.broadcast_to(
+            k_cache[:, :, :, None, :], (b, m, hk, nrep, d)
+        ).reshape(b, m, hk * nrep, d)
+        v_cache = jnp.broadcast_to(
+            v_cache[:, :, :, None, :], (b, m, hk, nrep, d)
+        ).reshape(b, m, hk * nrep, d)
+    scale = (
+        cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar
+        else cfg.head_dim ** -0.5
+    )
+    s = jnp.einsum("bthd,bmhd->bhtm", q, k_cache,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(valid_mask[:, None], s, -jnp.inf)
+    m_ = jnp.max(s, axis=-1, keepdims=True)
+    if sinks is not None:
+        sink = sinks.astype(jnp.float32)[None, :, None, None]
+        m_ = jnp.maximum(m_, sink)
+    p = jnp.exp(s - m_)
+    l = p.sum(-1)
+    if sinks is not None:
+        l = l + jnp.exp(sink[..., 0] - m_[..., 0])
+    o = jnp.einsum("bhtm,bmhd->bthd", p.astype(q.dtype), v_cache,
+                   preferred_element_type=jnp.float32)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def _mlp(x, lp, cfg: TransformerConfig, is_moe: bool):
+    if is_moe:
+        b, t, h = x.shape
+        out, _ = _moe_mlp(x.reshape(b * t, h), lp, cfg)
+        return out.reshape(b, t, h)
+    gate = jnp.dot(x, lp["gate_proj"])
+    up = jnp.dot(x, lp["up_proj"])
+    if cfg.mlp_bias:
+        gate, up = gate + lp["gate_bias"], up + lp["up_bias"]
+    o = jnp.dot(gated_act(gate, up, cfg), lp["down_proj"])
+    if cfg.mlp_bias:
+        o = o + lp["down_bias"]
+    return o
+
+
+def _layer(hidden, lp, cfg: TransformerConfig, cos, sin, k_cache, v_cache,
+           valid_mask, write_idx, is_moe):
+    """One decoder layer against the cache. Returns (hidden, k_cache,
+    v_cache) with this layer's new k/v written at ``write_idx``."""
+    x = _norm(hidden, lp["input_layernorm"], cfg)
+    q, k_new, v_new = _qkv(x, lp, cfg, cos, sin)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_new, write_idx, 1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_new, write_idx, 1)
+    attn = _cache_attend(q, k_cache, v_cache, valid_mask, cfg,
+                         sinks=lp.get("sinks"))
+    b, t, _, _ = attn.shape
+    out = jnp.dot(attn.reshape(b, t, cfg.q_dim), lp["o_proj"])
+    if "o_bias" in lp:
+        out = out + lp["o_bias"]
+    if cfg.sandwich_norms:
+        out = _norm(out, lp["post_attention_layernorm"], cfg)
+    hidden = hidden + out
+    pre = (lp["pre_feedforward_layernorm"] if cfg.sandwich_norms
+           else lp["post_attention_layernorm"])
+    x = _norm(hidden, pre, cfg)
+    out = _mlp(x, lp, cfg, is_moe)
+    if cfg.sandwich_norms:
+        out = _norm(out, lp["post_feedforward_layernorm"], cfg)
+    return hidden + out, k_cache, v_cache
+
+
+def _layer_meta(cfg: TransformerConfig):
+    """Per-layer static arrays: window sizes [L] (0 = full) and local-rope
+    flags [L]; plus the (possibly two-segment) stacked param trees."""
+    L = cfg.num_hidden_layers
+    windows = jnp.asarray(
+        [cfg.window_for_layer(i) or 0 for i in range(L)], jnp.int32
+    )
+    local = jnp.asarray(
+        [bool(cfg.rope_local_base_freq) and (cfg.window_for_layer(i) or 0) > 0
+         for i in range(L)]
+    )
+    return windows, local
+
+
+def _walk(compute, cfg: TransformerConfig, hidden, caches, write_idx,
+          cos_g, sin_g, cos_l, sin_l, valid_base):
+    """Scan all layers (dense segment then MoE segment), threading caches.
+
+    caches: (k [L,B,M,hkv,d], v [L,B,M,hkv,d]); valid_base [B,T,M] is the
+    causal+length mask — per-layer windows are AND-ed inside the scan."""
+    windows, local_flags = _layer_meta(cfg)
+    k_all, v_all = caches
+    M = k_all.shape[2]
+    kpos = jnp.arange(M)[None, None]  # [1,1,M]
+    t = hidden.shape[1]
+    qpos = write_idx + jnp.arange(t)[None, :, None]  # [1,T,1]
+
+    L = cfg.num_hidden_layers
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
+    segments = []
+    if k_dense:
+        segments.append(("dense_layers", 0, k_dense, False))
+    segments.append(("layers", k_dense, L - k_dense, cfg.is_moe))
+
+    for name, offset, count, is_moe_seg in segments:
+        tree = compute[name]
+
+        def body(carry, xs):
+            hidden, = carry
+            lp, k_c, v_c, win, loc = xs
+            cos = jnp.where(loc, cos_l, cos_g)
+            sin = jnp.where(loc, sin_l, sin_g)
+            in_window = jnp.where(win > 0, qpos - kpos < win, True)
+            mask = valid_base & in_window
+            hidden, k_c, v_c = _layer(
+                hidden, lp, cfg, cos, sin, k_c, v_c, mask, write_idx,
+                is_moe_seg,
+            )
+            return (hidden,), (k_c, v_c)
+
+        sl = slice(offset, offset + count)
+        (hidden,), (k_seg, v_seg) = jax.lax.scan(
+            body, (hidden,),
+            (tree, k_all[sl], v_all[sl], windows[sl], local_flags[sl]),
+        )
+        k_all = k_all.at[sl].set(k_seg)
+        v_all = v_all.at[sl].set(v_seg)
+    return hidden, (k_all, v_all)
+
+
+def _logits(params, compute, cfg: TransformerConfig, hidden):
+    hidden = _norm(hidden, compute["norm"], cfg)
+    kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
+    logits = jnp.dot(hidden, kernel, preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap:
+        logits = cfg.final_logit_softcap * jnp.tanh(
+            logits / cfg.final_logit_softcap
+        )
+    return logits
+
+
+def _prefill_impl(params, cfg: TransformerConfig, tokens, prompt_len: int,
+                  max_len: int):
+    """tokens [B,max_len] (prompt in [:prompt_len]) -> (last-token logits,
+    caches)."""
+    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    b = tokens.shape[0]
+    hd, hkv = cfg.head_dim, cfg.num_key_value_heads
+    L = cfg.num_hidden_layers
+    k_all = jnp.zeros((L, b, max_len, hkv, hd), cfg.dtype)
+    v_all = jnp.zeros_like(k_all)
+
+    ids = tokens[:, :prompt_len]
+    hidden = compute["embed_tokens"][ids]
+    if cfg.embed_scale:
+        hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions)
+
+    kpos = jnp.arange(max_len)[None, None]
+    qpos = jnp.arange(prompt_len)[None, :, None]
+    valid = kpos <= qpos  # causal over the cache; future rows still zero
+    hidden, caches = _walk(compute, cfg, hidden, (k_all, v_all), 0,
+                           cos_g, sin_g, cos_l, sin_l, valid)
+    logits = _logits(params, compute, cfg, hidden[:, -1:])
+    return logits[:, 0], caches
+
+
+def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
+                 start_pos, n_steps: int):
+    """Greedy scan: emit n_steps tokens starting from first_token at
+    start_pos (the prompt length)."""
+    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    max_len = caches[0].shape[2]
+    kpos = jnp.arange(max_len)[None, None]
+
+    def step(carry, _):
+        token, pos, caches = carry
+        positions = jnp.full((token.shape[0], 1), pos, jnp.int32)
+        cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions)
+        hidden = compute["embed_tokens"][token[:, None]]
+        if cfg.embed_scale:
+            hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
+        valid = kpos <= pos  # [1,1,M] broadcasts over [B,1,M]
+        hidden, caches = _walk(compute, cfg, hidden, caches, pos,
+                               cos_g, sin_g, cos_l, sin_l, valid)
+        logits = _logits(params, compute, cfg, hidden)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, pos + 1, caches), nxt
+
+    (_, _, _), out = jax.lax.scan(
+        step, (first_token, jnp.int32(start_pos), caches), None,
+        length=n_steps,
+    )
+    return out.T  # [B, n_steps]
+
+
+# jitted entry points cached per config object (TransformerConfig is a
+# mutable dataclass, so it rides the closure, not the jit key; jax's own
+# shape cache handles the (prompt_len, max_new) buckets)
+_JIT_CACHE: Dict[int, Tuple] = {}
+
+
+def _jitted(cfg: TransformerConfig):
+    key = id(cfg)
+    if key not in _JIT_CACHE:
+        prefill = jax.jit(
+            lambda params, tokens, pl, ml: _prefill_impl(params, cfg, tokens, pl, ml),
+            static_argnums=(2, 3),
+        )
+        decode = jax.jit(
+            lambda params, caches, tok, pos, n: _decode_impl(params, cfg, caches, tok, pos, n),
+            static_argnums=(4,),
+        )
+        _JIT_CACHE[key] = (prefill, decode)
+    return _JIT_CACHE[key]
+
+
+def greedy_generate(params, cfg: TransformerConfig, prompt_ids,
+                    max_new_tokens: int = 64, eos_id: int = -1):
+    """Prompt token list -> full id list (prompt + generated, trimmed at
+    eos). One prefill + one scan decode; static shapes throughout."""
+    import numpy as np
+
+    ids = [int(x) for x in prompt_ids]
+    prompt_len = len(ids)
+    max_len = prompt_len + max_new_tokens
+    tokens = jnp.zeros((1, max_len), jnp.int32).at[0, :prompt_len].set(
+        jnp.asarray(ids, jnp.int32)
+    )
+    prefill, decode = _jitted(cfg)
+    logits, caches = prefill(params, tokens, prompt_len, max_len)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    rest = (decode(params, caches, first, prompt_len, max_new_tokens - 1)
+            if max_new_tokens > 1 else None)
+    out = [int(first[0])]
+    if rest is not None:
+        out += [int(x) for x in np.asarray(rest[0])]
+    if eos_id >= 0 and eos_id in out:
+        out = out[: out.index(eos_id) + 1]
+    return ids + out
