@@ -225,7 +225,12 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     r = run_bench(
         seq_len, micro_bs, steps,
-        attention_impl=os.environ.get("BENCH_ATTN_IMPL") or None,
+        # default pinned to the measured-safe impl: on the r5 relay the
+        # registry auto-picks pallas_flash (the old axon platform-string
+        # gate no longer matches), but Pallas EXECUTION is silicon-unproven
+        # there (r1: hangs) — an auto-picked hang would watchdog-zero the
+        # round-end bench. scripts/pallas_probe.py decides the flip.
+        attention_impl=os.environ.get("BENCH_ATTN_IMPL", "xla_twopass") or None,
         remat_policy=os.environ.get("BENCH_REMAT", "ctx"),
         preset=preset,
         optimizer=os.environ.get("BENCH_OPT", "adamw"),

@@ -43,8 +43,15 @@ def supports_pallas() -> bool:
     import os
 
     dev = jax.devices()[0]
-    if getattr(dev, "platform", "") == "axon" or "axon" in str(
-        getattr(dev, "client", "")
+    try:  # r5 relay: the device reports kind "TPU v5 lite" but the BACKEND
+        # platform is still "axon" — check both surfaces
+        backend_platform = jax.extend.backend.get_backend().platform
+    except Exception:
+        backend_platform = ""
+    if (
+        getattr(dev, "platform", "") == "axon"
+        or backend_platform == "axon"
+        or "axon" in str(getattr(dev, "client", ""))
     ):
         return os.environ.get("VEOMNI_AXON_PALLAS") == "1"
     return True
