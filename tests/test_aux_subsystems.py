@@ -6,6 +6,7 @@ import json
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from veomni_tpu.arguments import VeOmniArguments
 
@@ -265,3 +266,55 @@ def test_merge_chrome_trace(tmp_path):
         ev = json.load(f)["traceEvents"]
     assert len(ev) == 4
     assert {e["pid"] for e in ev} == {1, 3}  # hosts offset apart
+
+
+def test_channel_loss_omni_family():
+    """Per-channel CE hooks the omni thinkers' merged-hidden preamble (was
+    a NotImplementedError scope guard through r4): channel sums must add up
+    to the total loss on a text-only batch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.models.auto import build_config
+    from veomni_tpu.train.channel_loss import (
+        make_channel_loss_fn,
+        supports_channel_loss,
+    )
+
+    cfg = build_config(
+        "qwen2_5_omni",
+        text=dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, head_dim=16,
+                  rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+                  dtype="float32", param_dtype="float32"),
+        vision=None, audio=None,
+        image_token_id=9, video_token_id=10, vision_start_token_id=8,
+        audio_token_id=11,
+    )
+    model = build_foundation_model(config=cfg)
+    assert supports_channel_loss(model)
+    model.init(jax.random.PRNGKey(0))
+    loss_fn = make_channel_loss_fn(model, num_channels=2)
+
+    rng = np.random.default_rng(0)
+    b, s = 2, 16
+    ids = rng.integers(12, 256, (b, s))
+    pos = np.broadcast_to(np.arange(s), (3, b, s)).transpose(1, 0, 2)
+    batch = {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "labels": jnp.asarray(ids, jnp.int32),
+        "position_ids": jnp.asarray(pos.copy(), jnp.int32),
+        "segment_ids": jnp.ones((b, s), jnp.int32),
+        "channel_ids": jnp.asarray(
+            np.where(np.arange(s)[None] < s // 2, 0, 1), jnp.int32
+        ).repeat(b, 0).reshape(b, s),
+    }
+    loss_sum, metrics = loss_fn(model.params, batch)
+    ch = np.asarray(metrics["channel_loss_sums"])
+    counts = np.asarray(metrics["channel_token_counts"])
+    assert ch.shape == (2,) and np.all(ch > 0)
+    assert counts.sum() == b * s
+    assert float(ch.sum()) == pytest.approx(float(loss_sum), rel=1e-5)

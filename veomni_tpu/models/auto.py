@@ -356,6 +356,22 @@ def build_config(model_type: str = "", **overrides):
             text.setdefault("expert_layout", "fused_chunked")
             kw["model_type"] = model_type
         return vl_cfg(text=text, **kw)
+    if model_type == "qwen2_5_omni":
+        from veomni_tpu.models.qwen2_5_omni import Qwen25OmniConfig
+
+        kw = {
+            k: overrides.pop(k)
+            for k in ("vision", "audio", "image_token_id", "video_token_id",
+                      "audio_token_id", "vision_start_token_id",
+                      "audio_start_token_id", "audio_end_token_id",
+                      "position_id_per_seconds", "freeze_vision",
+                      "freeze_audio")
+            if k in overrides
+        }
+        text = dict(overrides.pop("text", {}) or {})
+        text.update(overrides)
+        text.setdefault("model_type", "qwen2")
+        return Qwen25OmniConfig(text=text, **kw)
     if model_type == "qwen3_omni_moe":
         from veomni_tpu.models.qwen3_omni_moe import Qwen3OmniMoeConfig
 

@@ -249,10 +249,10 @@ def _merge_audio_features(embeds, input_ids, feats, audio_mask, audio_token_id):
     )
 
 
-def loss_fn(params, cfg: Qwen25OmniConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """qwen2_5_vl batch contract (mrope position_ids [B,3,S], packed window-
-    ordered pixel stream) plus ``audio_features [N_a, max_frames, mels]`` and
-    ``audio_mask [N_a]``."""
+def _omni_merged_hidden(params, cfg: Qwen25OmniConfig, batch):
+    """Tower-merged decoder preamble: (lm_params, hidden, moe_aux,
+    moe_dropped) — the per-channel CE hook point (same contract as the VL
+    families' ``_vision_merged_hidden``, ``train/channel_loss.py``)."""
     tcfg = cfg.text
     lm = params["language_model"]
     embeds = lm["embed_tokens"].astype(tcfg.dtype)[batch["input_ids"]]
@@ -287,8 +287,16 @@ def loss_fn(params, cfg: Qwen25OmniConfig, batch) -> Tuple[jax.Array, Dict[str, 
         lm, tcfg, batch["input_ids"], batch["position_ids"],
         batch.get("segment_ids"), inputs_embeds=embeds,
     )
+    return lm, hidden, moe_aux, moe_dropped
+
+
+def loss_fn(params, cfg: Qwen25OmniConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """qwen2_5_vl batch contract (mrope position_ids [B,3,S], packed window-
+    ordered pixel stream) plus ``audio_features [N_a, max_frames, mels]`` and
+    ``audio_mask [N_a]``."""
+    lm, hidden, moe_aux, moe_dropped = _omni_merged_hidden(params, cfg, batch)
     return transformer.head_loss(
-        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+        lm, cfg.text, hidden, batch["labels"], moe_aux, moe_dropped
     )
 
 

@@ -437,11 +437,10 @@ def omni_position_ids(
 # loss
 # ---------------------------------------------------------------------------
 
-def loss_fn(params, cfg: Qwen3OmniMoeConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """batch: text keys as qwen3_vl plus (all optional by shape):
-    ``pixel_values``/``vis_*`` (qwen3_vl contract) and ``audio_chunks``
-    [n_chunks, mel, chunk_len] + ``aud_frame_gather/aud_seg``
-    [n_frame_pad] + ``aud_frame_mask``."""
+def _omni_merged_hidden(params, cfg: Qwen3OmniMoeConfig, batch):
+    """Tower-merged decoder preamble: (lm_params, hidden, moe_aux,
+    moe_dropped) — the per-channel CE hook point (same contract as the VL
+    families' ``_vision_merged_hidden``, ``train/channel_loss.py``)."""
     from veomni_tpu.models.qwen2_5_vl import merge_vision_features
 
     tcfg = cfg.text
@@ -488,8 +487,17 @@ def loss_fn(params, cfg: Qwen3OmniMoeConfig, batch) -> Tuple[jax.Array, Dict[str
         batch.get("segment_ids"), inputs_embeds=embeds,
         post_layer_residuals=residuals,
     )
+    return lm, hidden, moe_aux, moe_dropped
+
+
+def loss_fn(params, cfg: Qwen3OmniMoeConfig, batch) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batch: text keys as qwen3_vl plus (all optional by shape):
+    ``pixel_values``/``vis_*`` (qwen3_vl contract) and ``audio_chunks``
+    [n_chunks, mel, chunk_len] + ``aud_frame_gather/aud_seg``
+    [n_frame_pad] + ``aud_frame_mask``."""
+    lm, hidden, moe_aux, moe_dropped = _omni_merged_hidden(params, cfg, batch)
     return transformer.head_loss(
-        lm, tcfg, hidden, batch["labels"], moe_aux, moe_dropped
+        lm, cfg.text, hidden, batch["labels"], moe_aux, moe_dropped
     )
 
 

@@ -22,10 +22,12 @@ from veomni_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
-def _find_vlm_module(model_type: str):
-    """The module owning this VL family's ``_vision_merged_hidden`` preamble
-    (probe, not a table — a new family supports channel loss the moment its
-    module grows the preamble). MoE variants share the dense module."""
+def _find_merged_hidden(model_type: str):
+    """The merged-hidden preamble of this VL/omni family (probe, not a
+    table — a new family supports channel loss the moment its module grows
+    the preamble). MoE variants share the dense module. Returns the bound
+    preamble fn ``(params, cfg, batch) -> (lm, hidden, moe_aux, dropped)``
+    or None."""
     import importlib
 
     candidates = [model_type]
@@ -36,27 +38,29 @@ def _find_vlm_module(model_type: str):
             mod = importlib.import_module(f"veomni_tpu.models.{name}")
         except ImportError:
             continue
-        if hasattr(mod, "_vision_merged_hidden"):
-            return mod
+        for attr in ("_vision_merged_hidden", "_omni_merged_hidden"):
+            if hasattr(mod, attr):
+                return getattr(mod, attr)
     return None
 
 
 def supports_channel_loss(model) -> bool:
-    """Text trees and any VL family exposing the merged-hidden preamble."""
+    """Text trees and any VL/omni family exposing the merged-hidden
+    preamble."""
     return (
         "embed_tokens" in model.abstract()
-        or _find_vlm_module(getattr(model.config, "model_type", "")) is not None
+        or _find_merged_hidden(getattr(model.config, "model_type", "")) is not None
     )
 
 
 def _hidden_fn(cfg):
     """(params, batch) -> (head params, text cfg, hidden, moe_aux) for text
-    AND VL-family models (the per-channel CE only needs the pre-head hidden
-    states; each VL family exposes its merged-hidden preamble)."""
-    mod = _find_vlm_module(getattr(cfg, "model_type", ""))
-    if mod is not None:
+    AND VL/omni-family models (the per-channel CE only needs the pre-head
+    hidden states; each family exposes its merged-hidden preamble)."""
+    preamble = _find_merged_hidden(getattr(cfg, "model_type", ""))
+    if preamble is not None:
         def fn(params, batch):
-            lm, hidden, moe_aux, _ = mod._vision_merged_hidden(params, cfg, batch)
+            lm, hidden, moe_aux, _ = preamble(params, cfg, batch)
             return lm, cfg.text, hidden, moe_aux
 
         return fn

@@ -408,8 +408,10 @@ class BaseTrainer:
 
             if not supports_channel_loss(model):
                 raise NotImplementedError(
-                    "data.channel_list is wired for text and VL families; "
-                    "omni composite param trees are unsupported"
+                    "data.channel_list needs a text param tree or a family "
+                    "exposing a merged-hidden preamble (all VL + omni "
+                    "thinkers do; seed-omni composites with generation "
+                    "heads do not)"
                 )
             return make_channel_loss_fn(model, len(self.args.data.channel_list))
         return lambda params, batch: model.loss_fn(params, batch)
