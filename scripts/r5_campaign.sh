@@ -1,0 +1,43 @@
+#!/bin/bash
+# r5 chip campaign: knock until the relay grants, then run the ladder in
+# subprocess mode (hang costs one config), then the pallas probe, then a
+# final validation run of bench.py's exact default config.
+# Single chip claimant by construction: every stage is sequential.
+set -u
+cd "$(dirname "$0")/.."
+LOG=bench_logs/r5_campaign.log
+echo "=== campaign start $(date -u +%H:%M:%S) ===" >> "$LOG"
+
+# 1. knock: in-process backend-init retry; exits 0 on the first grant
+#    (claim released at exit). Bounded by the caller's timeout.
+python - >> "$LOG" 2>&1 <<'EOF'
+import sys
+sys.path.insert(0, ".")
+from bench import _wait_for_backend
+n = _wait_for_backend(retry_s=120.0)
+print(f"KNOCK OK: {n} chip(s)", flush=True)
+EOF
+[ $? -ne 0 ] && { echo "knock failed, aborting" >> "$LOG"; exit 1; }
+
+# 2. the ladder (subprocess mode; pallas configs last)
+VEOMNI_XLA_PERF_FLAGS=0 SWEEP_SUBPROCESS=1 SWEEP_CONFIG_TIMEOUT_S=1500 \
+SWEEP_STEPS=8 SWEEP_CONFIGS='[
+  [4096,4,"xla_twopass","ctx"],
+  [2048,8,"xla_twopass","ctx"],
+  [4096,8,"xla_twopass","ctx"],
+  [2048,2,"xla_twopass","dots"],
+  [2048,4,"xla_twopass","ctx","qwen3_1p7b","muon"],
+  [4096,2,"xla_twopass","ctx","qwen3_1p7b","muon"],
+  [2048,8,"xla","ctx"],
+  [2048,8,"pallas_flash","ctx"],
+  [4096,4,"pallas_flash","ctx"]]' \
+  python scripts/mfu_sweep.py >> "$LOG" 2>&1
+
+# 3. pallas silicon probe (watchdogged stages)
+timeout 1800 python scripts/pallas_probe.py >> "$LOG" 2>&1
+echo "pallas_probe exit: $?" >> "$LOG"
+
+# 4. validate the round-end bench default end-to-end
+BENCH_WATCHDOG_S=1500 timeout 1800 python bench.py >> "$LOG" 2>&1
+echo "bench exit: $?" >> "$LOG"
+echo "=== campaign done $(date -u +%H:%M:%S) ===" >> "$LOG"
