@@ -37,3 +37,24 @@ def test_chunk_mbs_equivalence():
     chunked = _run(dataclasses.replace(cfg, chunk_mbs=16), batch)
     np.testing.assert_allclose(chunked[0], base[0], rtol=1e-6)
     np.testing.assert_allclose(chunked[1], base[1], rtol=1e-5)
+
+
+def test_ctx_remat_under_sequence_parallel():
+    """The ctx policy's checkpoint_name sits outside the Ulysses shard_map
+    body — saving the attention context must not change loss/grad-norm
+    under an sp layout (the bench default composes exactly this way)."""
+    from tests.test_parallel_equivalence import _batch, _loss_and_gnorm, _toy_cfg
+
+    cfg = _toy_cfg()
+    batch = _batch(bsz=2, seq=64)
+    layout = dict(ulysses_size=2, cp_size=2, dp_shard_size=1)
+    base = _loss_and_gnorm(
+        dataclasses.replace(cfg, remat=True, remat_policy="nothing"),
+        layout, batch,
+    )
+    ctx = _loss_and_gnorm(
+        dataclasses.replace(cfg, remat=True, remat_policy="ctx"),
+        layout, batch,
+    )
+    np.testing.assert_allclose(ctx[0], base[0], rtol=1e-6)
+    np.testing.assert_allclose(ctx[1], base[1], rtol=1e-5)
