@@ -31,12 +31,27 @@ from veomni_tpu.models.transformer import (
 )
 
 
-def supports_cached_decode(cfg: TransformerConfig) -> bool:
-    return not (
+def supports_cached_decode(cfg) -> bool:
+    """Fail-safe gate: True only for plain TransformerConfig dialects whose
+    every decode-relevant knob ``_layer`` implements. Composite configs
+    (VLM/omni/dit), MLA/DSA, hybrid linear attention, and mrope rope
+    scaling (decode builds 1-D positions) fall back to the caller's
+    rescoring path — which is always correct, just O(n^2)."""
+    if type(cfg) is not TransformerConfig:
+        return False
+    if (
         getattr(cfg, "use_mla", False)
         or getattr(cfg, "use_dsa", False)
         or cfg.model_type in ("qwen3_next",)
-    )
+        or getattr(cfg, "linear_attn_layers", None)
+    ):
+        return False
+    rs = getattr(cfg, "rope_scaling", None) or {}
+    if "mrope" in str(rs.get("type", rs.get("rope_type", ""))) or rs.get(
+        "mrope_section"
+    ):
+        return False
+    return True
 
 
 def _rope_tables(cfg: TransformerConfig, positions: jax.Array):
@@ -279,14 +294,17 @@ def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
     return out.T  # [B, n_steps]
 
 
-# jitted entry points cached per config object (TransformerConfig is a
-# mutable dataclass, so it rides the closure, not the jit key; jax's own
-# shape cache handles the (prompt_len, max_new) buckets)
-_JIT_CACHE: Dict[int, Tuple] = {}
+# jitted entry points cached per config CONTENT (TransformerConfig is a
+# mutable dataclass, so the key is (id, field-repr hash): mutating a config
+# in place retraces instead of silently reusing pre-mutation semantics;
+# jax's own shape cache handles the (prompt_len, max_new) buckets). Bounded:
+# oldest entry evicted past _JIT_CACHE_MAX configs.
+_JIT_CACHE: Dict[Tuple, Tuple] = {}
+_JIT_CACHE_MAX = 8
 
 
 def _jitted(cfg: TransformerConfig):
-    key = id(cfg)
+    key = (id(cfg), hash(repr(cfg)))
     if key not in _JIT_CACHE:
         prefill = jax.jit(
             lambda params, tokens, pl, ml: _prefill_impl(params, cfg, tokens, pl, ml),
@@ -296,6 +314,8 @@ def _jitted(cfg: TransformerConfig):
             lambda params, caches, tok, pos, n: _decode_impl(params, cfg, caches, tok, pos, n),
             static_argnums=(4,),
         )
+        while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
         _JIT_CACHE[key] = (prefill, decode)
     return _JIT_CACHE[key]
 
@@ -307,6 +327,8 @@ def greedy_generate(params, cfg: TransformerConfig, prompt_ids,
     import numpy as np
 
     ids = [int(x) for x in prompt_ids]
+    if max_new_tokens <= 0:
+        return ids
     prompt_len = len(ids)
     max_len = prompt_len + max_new_tokens
     tokens = jnp.zeros((1, max_len), jnp.int32).at[0, :prompt_len].set(

@@ -78,8 +78,9 @@ def _hidden_fn(cfg):
 def make_channel_loss_fn(model, num_channels: int) -> Callable:
     """Wrap the model loss to additionally emit per-channel sums.
     batch needs 'channel_ids' [B,S] (-1 on ignored/pad tokens). Works for
-    text and VL families (reference channel_loss_callback.py tracks every
-    trainer; omni composites remain out of scope)."""
+    text, VL, and omni-thinker families — any model exposing a
+    merged-hidden preamble (reference channel_loss_callback.py tracks every
+    trainer; seed-omni generation-head composites remain out of scope)."""
     hidden_fn = _hidden_fn(model.config)
 
     def loss_fn(params, batch):
