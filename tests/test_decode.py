@@ -77,3 +77,22 @@ def test_cached_decode_rejects_mla():
         v_head_dim=8,
     )
     assert not supports_cached_decode(cfg)
+
+
+def test_sampling_decode_valid_and_greedy_consistent():
+    """temperature=0 sampling path == greedy; temperature>0 with top_k
+    produces in-vocab tokens and is reproducible per seed."""
+    cfg = TransformerConfig(dtype=jnp.float32, **CONFIGS["qwen3"])
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = list(np.random.default_rng(1).integers(1, 128, 7))
+    greedy = greedy_generate(params, cfg, prompt, max_new_tokens=5)
+    greedy2 = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                              temperature=0.0)
+    assert greedy == greedy2
+    s1 = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                         temperature=0.8, top_k=10, seed=3)
+    s2 = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                         temperature=0.8, top_k=10, seed=3)
+    assert s1 == s2  # per-seed reproducible
+    assert all(0 <= t < 128 for t in s1[len(prompt):])

@@ -265,16 +265,30 @@ def _prefill_impl(params, cfg: TransformerConfig, tokens, prompt_len: int,
     return logits[:, 0], caches
 
 
+def _select_token(logits, rng, temperature: float, top_k: int):
+    """[B,V] f32 -> [B] int32. temperature<=0 means greedy; top_k>0 keeps
+    only the k highest logits before sampling (HF generate semantics)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
 def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
-                 start_pos, n_steps: int):
-    """Greedy scan: emit n_steps tokens starting from first_token at
-    start_pos (the prompt length)."""
+                 start_pos, rng, n_steps: int, temperature: float,
+                 top_k: int):
+    """Scan decode: emit n_steps tokens starting from first_token at
+    start_pos (the prompt length). Greedy when temperature<=0, else
+    temperature/top-k sampling with a PRNG carry."""
     compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
     max_len = caches[0].shape[2]
     kpos = jnp.arange(max_len)[None, None]
 
     def step(carry, _):
-        token, pos, caches = carry
+        token, pos, caches, rng = carry
         positions = jnp.full((token.shape[0], 1), pos, jnp.int32)
         cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions)
         hidden = compute["embed_tokens"][token[:, None]]
@@ -284,11 +298,12 @@ def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
         hidden, caches = _walk(compute, cfg, hidden, caches, pos,
                                cos_g, sin_g, cos_l, sin_l, valid)
         logits = _logits(params, compute, cfg, hidden)
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-        return (nxt, pos + 1, caches), nxt
+        rng, sub = jax.random.split(rng)
+        nxt = _select_token(logits[:, 0], sub, temperature, top_k)
+        return (nxt, pos + 1, caches, rng), nxt
 
-    (_, _, _), out = jax.lax.scan(
-        step, (first_token, jnp.int32(start_pos), caches), None,
+    (_, _, _, _), out = jax.lax.scan(
+        step, (first_token, jnp.int32(start_pos), caches, rng), None,
         length=n_steps,
     )
     return out.T  # [B, n_steps]
@@ -311,8 +326,10 @@ def _jitted(cfg: TransformerConfig):
             static_argnums=(2, 3),
         )
         decode = jax.jit(
-            lambda params, caches, tok, pos, n: _decode_impl(params, cfg, caches, tok, pos, n),
-            static_argnums=(4,),
+            lambda params, caches, tok, pos, rng, n, temp, tk: _decode_impl(
+                params, cfg, caches, tok, pos, rng, n, temp, tk
+            ),
+            static_argnums=(5, 6, 7),
         )
         while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
             _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
@@ -321,9 +338,12 @@ def _jitted(cfg: TransformerConfig):
 
 
 def greedy_generate(params, cfg: TransformerConfig, prompt_ids,
-                    max_new_tokens: int = 64, eos_id: int = -1):
+                    max_new_tokens: int = 64, eos_id: int = -1,
+                    temperature: float = 0.0, top_k: int = 0, seed: int = 0):
     """Prompt token list -> full id list (prompt + generated, trimmed at
-    eos). One prefill + one scan decode; static shapes throughout."""
+    eos). One prefill + one scan decode; static shapes throughout.
+    temperature<=0 (default) is greedy; otherwise temperature/top-k
+    sampling (HF generate's do_sample analogue)."""
     import numpy as np
 
     ids = [int(x) for x in prompt_ids]
@@ -336,8 +356,13 @@ def greedy_generate(params, cfg: TransformerConfig, prompt_ids,
     )
     prefill, decode = _jitted(cfg)
     logits, caches = prefill(params, tokens, prompt_len, max_len)
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    rest = (decode(params, caches, first, prompt_len, max_new_tokens - 1)
+    rng = jax.random.PRNGKey(seed)
+    rng, sub = jax.random.split(rng)
+    first = _select_token(
+        logits.astype(jnp.float32), sub, float(temperature), int(top_k)
+    )
+    rest = (decode(params, caches, first, prompt_len, rng,
+                   max_new_tokens - 1, float(temperature), int(top_k))
             if max_new_tokens > 1 else None)
     out = [int(first[0])]
     if rest is not None:
