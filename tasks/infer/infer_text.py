@@ -19,15 +19,20 @@ from veomni_tpu.models import build_foundation_model, build_tokenizer
 from veomni_tpu.models.transformer import forward_logits
 
 
-def generate(model, params, input_ids, max_new_tokens: int = 64, eos_id: int = -1):
-    """Greedy generation: KV-cache scan decode where the dialect supports it
-    (models/decode.py — the TPU equivalent of HF generate()'s cache), else
-    the fixed-window rescoring fallback (MLA/DSA/hybrid families)."""
+def generate(model, params, input_ids, max_new_tokens: int = 64,
+             eos_id: int = -1, temperature: float = 0.0, top_k: int = 0,
+             seed: int = 0):
+    """Generation: KV-cache scan decode where the dialect supports it
+    (models/decode.py — the TPU equivalent of HF generate()'s cache,
+    greedy or temperature/top-k sampling), else the fixed-window greedy
+    rescoring fallback (MLA/DSA/hybrid families)."""
     from veomni_tpu.models.decode import greedy_generate, supports_cached_decode
 
     if supports_cached_decode(model.config):
         return greedy_generate(params, model.config, input_ids,
-                               max_new_tokens=max_new_tokens, eos_id=eos_id)
+                               max_new_tokens=max_new_tokens, eos_id=eos_id,
+                               temperature=temperature, top_k=top_k,
+                               seed=seed)
     cfg = model.config
     ids = list(map(int, input_ids))
     total = len(ids) + max_new_tokens
@@ -75,8 +80,12 @@ def main():
         if not prompt:
             continue
         ids = tokenizer(prompt)["input_ids"] if tokenizer else [int(x) for x in prompt.split()]
-        out = generate(model, model.params, ids,
-                       eos_id=tokenizer.eos_token_id if tokenizer else -1)
+        out = generate(
+            model, model.params, ids,
+            eos_id=tokenizer.eos_token_id if tokenizer else -1,
+            temperature=float(os.environ.get("INFER_TEMPERATURE", 0)),
+            top_k=int(os.environ.get("INFER_TOP_K", 0)),
+        )
         print(tokenizer.decode(out) if tokenizer else out)
 
 
