@@ -119,6 +119,9 @@ def run_bench(
     donate: bool = True,
     preset: str = "qwen3_0p6b",
     optimizer: str = "adamw",
+    ulysses_size: int = 1,
+    ulysses_async: bool = False,
+    ulysses_async_chunks: int = 4,
 ) -> dict:
     """One full train-throughput measurement; returns {tok_s_chip, mfu, dt}."""
     import jax
@@ -126,7 +129,6 @@ def run_bench(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from veomni_tpu.models import build_foundation_model
-    from veomni_tpu.ops.kernel_registry import apply_ops_config
     from veomni_tpu.optim import build_lr_scheduler, build_optimizer
     from veomni_tpu.parallel import init_parallel_state, use_parallel_state
     from veomni_tpu.train import build_train_state, build_train_step
@@ -135,14 +137,23 @@ def run_bench(
     from veomni_tpu.utils.device import get_device_peak_flops
 
     os.environ["VEOMNI_DONATE_STATE"] = "1" if donate else "0"
-    apply_ops_config({"attention": attention_impl} if attention_impl else None)
+    pins = {}
+    if attention_impl:
+        pins["attention"] = attention_impl
+    if ulysses_async:
+        # chunked a2a/compute overlap pipeline (parallel/async_ulysses.py)
+        pins["ulysses"] = "ulysses_async"
+        os.environ["VEOMNI_ULYSSES_ASYNC_CHUNKS"] = str(ulysses_async_chunks)
 
     n_chips = _wait_for_backend()
-    ps = init_parallel_state()
+    ps = init_parallel_state(ulysses_size=ulysses_size)
 
     with use_parallel_state(ps):
         cfg = bench_config(remat_policy, preset)
-        model = build_foundation_model(config=cfg)
+        # pins ride through the builder: build_foundation_model runs
+        # apply_ops_config itself, and a bare call would WIPE pins applied
+        # beforehand (clear_pins precedes re-pinning)
+        model = build_foundation_model(config=cfg, ops_implementation=pins or None)
         plan = model.get_parallel_plan()
         opt = build_optimizer(
             model.abstract(), optimizer=optimizer,
@@ -203,7 +214,8 @@ def run_bench(
                 "seq_len": seq_len, "micro_bs": micro_bs, "steps": steps,
                 "attention": attention_impl or "auto",
                 "remat_policy": remat_policy, "preset": preset,
-                "optimizer": optimizer}
+                "optimizer": optimizer, "ulysses_size": ulysses_size,
+                "ulysses_async": ulysses_async}
 
 
 def main():
@@ -234,6 +246,11 @@ def main():
         remat_policy=os.environ.get("BENCH_REMAT", "ctx"),
         preset=preset,
         optimizer=os.environ.get("BENCH_OPT", "adamw"),
+        # BENCH_ULYSSES_ASYNC=1 selects the chunked async Ulysses pipeline
+        # (only meaningful with BENCH_ULYSSES_SIZE > 1 on a multi-chip claim)
+        ulysses_size=int(os.environ.get("BENCH_ULYSSES_SIZE", 1)),
+        ulysses_async=os.environ.get("BENCH_ULYSSES_ASYNC", "0") not in ("0", ""),
+        ulysses_async_chunks=int(os.environ.get("BENCH_ULYSSES_CHUNKS", 4)),
     )
     _done.set()  # before printing: the watchdog must never race the
     # real record out of a block-buffered stdout via os._exit

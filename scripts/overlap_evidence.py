@@ -1,16 +1,15 @@
 """Evidence artifact for the comm/compute-overlap story (VERDICT r3 #8).
 
-The reference hand-overlaps Ulysses a2a with compute
-(``veomni/distributed/sequence_parallel/async_ulysses.py:48-506``); our
-design delegates overlap to XLA's scheduler (utils/xla_flags.py). This
-script produces the checkable artifact:
+Thin CLI over ``veomni_tpu/utils/overlap_evidence.py`` (the census itself is
+a first-class API, regression-gated by ``tests/test_async_ulysses.py``).
+This script produces the human-readable artifact:
 
-1. jit a sharded train step on an 8-device CPU mesh with ``--xla_dump_to``,
-   parse the *scheduled* HLO, and report every async collective pair
-   (``*-start``/``*-done``) together with how many real compute ops the
-   scheduler placed between start and done — nonzero gaps = the compiler is
-   hiding collective latency behind compute (the capability async_ulysses
-   implements by hand);
+1. jit a sharded train step on an 8-device CPU mesh with ``--xla_dump_to``
+   and report (a) every async collective start/done pair in the *scheduled*
+   HLO with the compute placed inside the window (TPU dumps), (b) the
+   backend-neutral dependency census — overlappable collective/compute
+   pairs — for BOTH the monolithic and the chunked async Ulysses path, so
+   the pipeline's structural win is visible off-TPU too;
 2. measure the async trainer-loop win: wall-clock per step with a device
    fetch every step (log_steps=1) vs amortized fetch (log_steps=50).
 
@@ -19,7 +18,6 @@ Writes a summary to stdout — paste into BENCH_NOTES.md.
 """
 
 import os
-import re
 import sys
 import tempfile
 
@@ -29,8 +27,6 @@ DUMP = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(prefix="hlo_dump_"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + f" --xla_dump_to={DUMP} --xla_dump_hlo_pass_re=scheduling|latency"
-    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=600"
 )
 
 from veomni_tpu.utils.testing import force_cpu_devices  # noqa: E402
@@ -50,57 +46,27 @@ from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 from veomni_tpu.models import TransformerConfig, build_foundation_model  # noqa: E402
 from veomni_tpu.optim import build_lr_scheduler, build_optimizer  # noqa: E402
 from veomni_tpu.parallel import init_parallel_state, use_parallel_state  # noqa: E402
+from veomni_tpu.parallel.parallel_state import destroy_parallel_state  # noqa: E402
 from veomni_tpu.train import build_train_state, build_train_step  # noqa: E402
 from veomni_tpu.train.train_step import resolve_state_shardings  # noqa: E402
-
-COMPUTE_OPS = ("fusion", "dot", "convolution", "custom-call")
-
-
-def analyze_dump(dump_dir: str):
-    """Parse scheduled HLO: for each async collective start/done pair, count
-    compute ops scheduled between them."""
-    pairs = []
-    for fname in sorted(os.listdir(dump_dir)):
-        if "after_scheduling" not in fname and "latency" not in fname:
-            continue
-        if not fname.endswith(".txt"):
-            continue
-        with open(os.path.join(dump_dir, fname)) as f:
-            lines = f.readlines()
-        open_starts = {}
-        for i, line in enumerate(lines):
-            m = re.search(r"%(\S*?(all-gather|all-reduce|reduce-scatter|"
-                          r"all-to-all|collective-permute)\S*start\S*) =", line)
-            if m:
-                open_starts[m.group(1).rstrip(",")] = i
-                continue
-            m = re.search(r"(all-gather|all-reduce|reduce-scatter|all-to-all|"
-                          r"collective-permute)\S*done", line)
-            if m and open_starts:
-                # attribute to the most recent unmatched start of that type
-                key = next(
-                    (k for k in reversed(list(open_starts))
-                     if m.group(1) in k), None,
-                )
-                if key is None:
-                    continue
-                start_i = open_starts.pop(key)
-                gap_ops = sum(
-                    1 for ln in lines[start_i + 1: i]
-                    if any(f" {op}(" in ln or f"= {op}" in ln for op in COMPUTE_OPS)
-                )
-                pairs.append((key.split(".")[0], i - start_i, gap_ops))
-    return pairs
+from veomni_tpu.utils.overlap_evidence import (  # noqa: E402
+    analyze_scheduled_dump,
+    collective_census,
+    compiled_hlo_text,
+    overlap_report,
+)
 
 
-def main():
+def _build_step(ulysses_async_chunks: int):
+    destroy_parallel_state()
     ps = init_parallel_state(ulysses_size=2, dp_shard_size=4)
+    cfg = TransformerConfig(
+        model_type="qwen3", vocab_size=512, hidden_size=128,
+        intermediate_size=256, num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, head_dim=16, qk_norm=True, dtype=jnp.float32,
+        ulysses_async_chunks=ulysses_async_chunks,
+    )
     with use_parallel_state(ps):
-        cfg = TransformerConfig(
-            model_type="qwen3", vocab_size=512, hidden_size=128,
-            intermediate_size=256, num_hidden_layers=2, num_attention_heads=4,
-            num_key_value_heads=2, head_dim=32, qk_norm=True, dtype=jnp.float32,
-        )
         model = build_foundation_model(config=cfg)
         plan = model.get_parallel_plan()
         opt = build_optimizer(model.abstract(),
@@ -127,6 +93,15 @@ def main():
             "segment_ids": jnp.ones(ids.shape, jnp.int32),
         }
         batch = {k: jax.device_put(v, bsh[k]) for k, v in batch.items()}
+    return ps, step, state, batch
+
+
+def main():
+    # build + execute the MONOLITHIC step first: at this point the
+    # --xla_dump_to dir contains only its program, so the scheduled-dump
+    # census below can't conflate it with the chunked compile
+    ps, step, state, batch = _build_step(1)
+    with use_parallel_state(ps):
         state, metrics = step(state, batch)  # compile + dump
         _ = float(metrics["loss"])
 
@@ -144,13 +119,30 @@ def main():
         per_step_sync = run(50, 1)
         per_step_async = run(50, 50)
 
-    pairs = analyze_dump(DUMP)
-    overlapped = [p for p in pairs if p[2] > 0]
+    # scheduled-dump census BEFORE any other compile lands in DUMP: the
+    # pairs reported here are the monolithic step's and nothing else's
+    pairs = analyze_scheduled_dump(DUMP)
+
+    # dependency census for both Ulysses paths (backend-neutral evidence);
+    # the monolithic step above is reused, only the chunked one compiles.
+    # The toy head layout (hq=8, hkv=4, u=2) clamps the pipeline to K=2 —
+    # label what actually ran.
+    with use_parallel_state(ps):
+        rep = overlap_report(compiled_hlo_text(step, state, batch))
+    print(f"dependency census [monolithic]: {rep.describe()}")
+    ps2, step2, state2, batch2 = _build_step(2)
+    with use_parallel_state(ps2):
+        rep = overlap_report(compiled_hlo_text(step2, state2, batch2))
+    print(f"dependency census [async_chunked(K=2)]: {rep.describe()}")
+
+    overlapped = [p for p in pairs if p.overlapped]
     print(f"HLO dump: {DUMP}")
-    print(f"async collective pairs in scheduled HLO: {len(pairs)}; "
+    print(f"async collective pairs in scheduled HLO (monolithic step): "
+          f"{len(pairs)}; "
           f"with compute scheduled inside the start->done window: {len(overlapped)}")
-    for name, span, gap in pairs[:12]:
-        print(f"  {name:40s} window={span:4d} lines, compute ops inside={gap}")
+    for p in pairs[:12]:
+        print(f"  {p.name:40s} window={p.window_lines:4d} lines, "
+              f"compute ops inside={p.compute_inside}")
     if not pairs:
         # XLA:CPU lowers collectives synchronously — no start/done pairs
         # exist off-TPU (the latency-hiding scheduler is a TPU pass). Report
@@ -161,12 +153,8 @@ def main():
             if "step_fn" not in fname or "after_optimizations.txt" not in fname:
                 continue
             with open(os.path.join(DUMP, fname)) as f:
-                text = f.read()
-            for op in ("all-gather", "all-reduce", "reduce-scatter",
-                       "all-to-all", "collective-permute"):
-                census[op] = census.get(op, 0) + len(
-                    re.findall(rf"= \S* {op}\(|{op}\.", text)
-                )
+                for op, n in collective_census(f.read()).items():
+                    census[op] = census.get(op, 0) + n
         print("CPU backend lowers collectives synchronously; GSPMD-inserted "
               "collectives in the compiled train step (what the TPU "
               "latency-hiding scheduler overlaps):")

@@ -10,20 +10,24 @@ just env vars, before first backend use.
 import os
 
 os.environ.setdefault("VEOMNI_LOG_LEVEL", "WARNING")
-# This box exposes 1 physical core for the virtual devices: XLA:CPU
-# collective rendezvous can exceed its default 40s termination timeout under
-# load and SIGABRT the process. Give the rendezvous generous timeouts.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=600"
-    + " --xla_cpu_collective_timeout_seconds=600"
-)
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 4)
+from veomni_tpu.utils.jax_compat import (
+    apply_cpu_collective_timeout_flags,
+    set_virtual_cpu_devices,
+)
+
+# This box exposes 1 physical core for the virtual devices: XLA:CPU
+# collective rendezvous can exceed its default 40s termination timeout under
+# load and SIGABRT the process. Give the rendezvous generous timeouts
+# (version-gated: old jaxlib XLA aborts on unknown flags).
+apply_cpu_collective_timeout_flags(warn_s=120, terminate_s=600)
+set_virtual_cpu_devices(4)
 # With several virtual devices on a 1-core box, async dispatch lets several
 # executions be in flight; their collective rendezvous can starve each other
 # of pool threads and deadlock (observed SIGABRT in rendezvous.cc). Run CPU

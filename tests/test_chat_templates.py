@@ -188,3 +188,45 @@ def test_vlm_dpo_multi_image_row_respects_total_budget():
     assert total <= budget, f"{total} patches exceed the {budget} budget"
     # all three images survived (downscaled, not dropped)
     assert len(out["vis_grids"]) == 3
+
+
+def test_vlm_dpo_underflow_budget_drops_trailing_media():
+    """When media_count * merge_block exceeds the per-sample budget, the
+    per-item floor (one merge block each) would overflow it — the transform
+    must drop trailing media instead, and must do so via the per-call
+    budget (no shared template state mutated between rows)."""
+    from veomni_tpu.data.data_transform import build_data_transform
+
+    cfg = _small_vl_cfg()  # merge 2 -> min block = 4 patches
+    budget = 8             # fits 2 items at the 4-patch floor, not 3
+    transform = build_data_transform(
+        "vlm_dpo", tokenizer=FakeTok(), vlm_config=cfg, max_seq_len=256,
+        max_patches_per_sample=budget,
+    )
+    rng = np.random.default_rng(2)
+    row = {
+        "messages": [{"role": "user", "content": [
+            {"type": "text", "text": "compare"},
+            *({"type": "image", "image": rng.random((16, 16, 3))}
+              for _ in range(3)),
+        ]}],
+        "chosen": "first",
+        "rejected": "second",
+    }
+    out = transform(dict(row))
+    assert len(out["vis_grids"]) == 2  # trailing image dropped
+    total = sum(p.shape[0] for p in out["vis_patches"])
+    assert total <= budget, f"{total} patches exceed the {budget} budget"
+    # the input row's messages were not mutated
+    assert sum(1 for p in row["messages"][0]["content"]
+               if isinstance(p, dict) and p.get("type") == "image") == 3
+    # a following single-image row sees the full budget again (per-call
+    # budget, not leftover shared state from the 3-image row)
+    out2 = transform({
+        "messages": [{"role": "user", "content": [
+            {"type": "image", "image": rng.random((16, 16, 3))},
+        ]}],
+        "chosen": "a", "rejected": "b",
+    })
+    assert len(out2["vis_grids"]) == 1
+    assert sum(p.shape[0] for p in out2["vis_patches"]) <= budget

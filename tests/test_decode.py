@@ -54,6 +54,17 @@ CONFIGS = {
         num_key_value_heads=2, head_dim=16, qk_norm=True, num_experts=4,
         num_experts_per_tok=2, moe_intermediate_size=32,
     ),
+    # gpt_oss-style: learned attention sinks + alternating sliding windows —
+    # covers the sink softmax-denominator math duplicated between
+    # _cache_attend and the training attention impls
+    "gpt_oss_ish": dict(
+        model_type="gpt_oss", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, attention_sinks=True,
+        attention_bias=True, o_bias=True, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+        hidden_act="gpt_oss_glu",
+    ),
 }
 
 
@@ -96,3 +107,31 @@ def test_sampling_decode_valid_and_greedy_consistent():
                          temperature=0.8, top_k=10, seed=3)
     assert s1 == s2  # per-seed reproducible
     assert all(0 <= t < 128 for t in s1[len(prompt):])
+    # top_k > vocab clamps to the vocab (HF generate semantics) instead of
+    # raising inside lax.top_k
+    s3 = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                         temperature=0.8, top_k=10_000, seed=3)
+    assert all(0 <= t < 128 for t in s3[len(prompt):])
+
+
+def test_prompt_length_bucketing_keeps_compiles_flat():
+    """Distinct prompt lengths inside one power-of-two bucket must reuse the
+    SAME prefill/decode compilation (each retrace costs 20-40s on TPU) and
+    still match full-prefix rescoring exactly."""
+    from veomni_tpu.models import decode as decode_mod
+
+    cfg = TransformerConfig(dtype=jnp.float32, **CONFIGS["qwen3"])
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = list(np.random.default_rng(2).integers(1, 128, 9))
+
+    base = dict(decode_mod.TRACE_COUNTS)
+    outs = {}
+    # lengths 5/6/7 share the prompt bucket (16) AND the cache bucket
+    # (5+6..7+6 <= 16): zero extra compiles after the first
+    for n in (5, 6, 7):
+        outs[n] = greedy_generate(params, cfg, prompt[:n], max_new_tokens=6)
+    assert decode_mod.TRACE_COUNTS["prefill"] - base["prefill"] == 1
+    assert decode_mod.TRACE_COUNTS["decode"] - base["decode"] == 1
+    for n in (5, 6, 7):  # bucketing must not change the tokens
+        assert outs[n] == _rescoring_generate(params, cfg, prompt[:n], 6)

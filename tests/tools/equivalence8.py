@@ -13,8 +13,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from veomni_tpu.utils.jax_compat import set_virtual_cpu_devices
+
+set_virtual_cpu_devices(8)
 jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 import jax.numpy as jnp

@@ -76,6 +76,14 @@ class TrainingArguments:
     data_parallel_replicate_size: int = 1
     data_parallel_shard_size: int = -1
     ulysses_parallel_size: int = 1
+    # Async Ulysses (parallel/async_ulysses.py): pipeline the chunked head
+    # a2a against the previous chunk's attention compute instead of one
+    # monolithic a2a (the reference's async_ulysses engine, compiler-
+    # scheduled on TPU). Only meaningful with ulysses_parallel_size > 1.
+    ulysses_async: bool = False
+    # head-chunk count for the async pipeline (clamped to the model's
+    # feasible head layout; more chunks = finer overlap, more collectives)
+    ulysses_async_chunks: int = 4
     context_parallel_size: int = 1
     tensor_parallel_size: int = 1
     pipeline_parallel_size: int = 1

@@ -146,6 +146,9 @@ def _translate_train(train: Dict[str, Any], notes: List[str]) -> None:
             "ep_size": "expert_parallel_size",
             "ulysses_size": "ulysses_parallel_size",
             "cp_size": "context_parallel_size",
+            # reference async_ulysses engine -> the chunked a2a/compute
+            # overlap pipeline (parallel/async_ulysses.py)
+            "async_ulysses": "ulysses_async",
         }, "train.accelerator", notes)
         if isinstance(fsdp, dict):
             mode = fsdp.pop("fsdp_mode", None)

@@ -25,6 +25,8 @@ supervised so the model learns to stop.
 
 from __future__ import annotations
 
+import inspect
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -32,8 +34,31 @@ import numpy as np
 
 IGNORE_INDEX = -100
 
-# expander(item) -> (placeholder_ids, payload_dict_merged_into_sample)
+# expander(item, **kwargs) -> (placeholder_ids, payload_dict_merged_into_sample)
 MediaExpander = Callable[[Any], Tuple[List[int], Dict[str, Any]]]
+
+
+# weak-keyed so dropped templates' expander closures (and the vision config
+# state they capture) don't stay pinned by the cache for the process lifetime
+_EXPANDER_KWARG_FILTERS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _expander_kwarg_filter(expander):
+    """(accepts_var_kw, frozenset(named_kwargs)) for an expander — cached:
+    signatures are static and this sits on the per-sample data path."""
+    got = _EXPANDER_KWARG_FILTERS.get(expander)
+    if got is None:
+        try:
+            params = inspect.signature(expander).parameters
+        except (TypeError, ValueError):
+            got = (False, frozenset())
+        else:
+            got = (
+                any(p.kind == p.VAR_KEYWORD for p in params.values()),
+                frozenset(params),
+            )
+        _EXPANDER_KWARG_FILTERS[expander] = got
+    return got
 
 
 @dataclass
@@ -47,7 +72,8 @@ class MultimodalChatTemplate:
     def _tok(self, text: str) -> List[int]:
         return self.tokenizer(text, add_special_tokens=False)["input_ids"]
 
-    def _render_part(self, part, ids, labels, media, supervised):
+    def _render_part(self, part, ids, labels, media, supervised,
+                     expander_kwargs=None):
         if isinstance(part, str):
             t = self._tok(part)
             ids += t
@@ -55,20 +81,35 @@ class MultimodalChatTemplate:
             return
         kind = part.get("type", "text")
         if kind == "text":
-            self._render_part(part.get("text", ""), ids, labels, media, supervised)
+            self._render_part(part.get("text", ""), ids, labels, media,
+                              supervised, expander_kwargs)
             return
         if kind not in self.expanders:
             raise ValueError(f"no expander for media type {kind!r}")
         item = part.get(kind, part.get("url", part.get("path")))
-        placeholder_ids, payload = self.expanders[kind](item)
+        # per-call expander kwargs (e.g. patch_budget) only reach expanders
+        # that declare them; legacy single-arg expanders stay untouched
+        expander = self.expanders[kind]
+        accepted = {}
+        if expander_kwargs:
+            var_kw, named = _expander_kwarg_filter(expander)
+            accepted = {k: v for k, v in expander_kwargs.items()
+                        if var_kw or k in named}
+        placeholder_ids, payload = (
+            expander(item, **accepted) if accepted else expander(item)
+        )
         ids += placeholder_ids
         labels += [IGNORE_INDEX] * len(placeholder_ids)  # media never supervised
         for key, value in payload.items():
             media.setdefault(key, []).append(value)
 
     def encode_messages(
-        self, messages: Sequence[Dict[str, Any]]
+        self, messages: Sequence[Dict[str, Any]], **expander_kwargs
     ) -> Dict[str, Any]:
+        """``expander_kwargs`` are threaded to every media expander of this
+        call only (e.g. ``patch_budget=...`` for the qwen-vl expanders) —
+        the stateless alternative to mutating shared template state between
+        calls (``set_patch_budget``)."""
         ids: List[int] = []
         labels: List[int] = []
         media: Dict[str, List[Any]] = {}
@@ -84,7 +125,8 @@ class MultimodalChatTemplate:
             content = msg.get("content", "")
             parts = content if isinstance(content, list) else [content]
             for part in parts:
-                self._render_part(part, ids, labels, media, supervised)
+                self._render_part(part, ids, labels, media, supervised,
+                                  expander_kwargs)
             tail = self._tok(f"{self.im_end}\n")
             ids += tail
             # the closing tag of assistant turns is supervised (stop signal)
@@ -124,13 +166,18 @@ def qwen_vl_chat_template(
 
     # per-ITEM patch budget; a mutable cell so callers that know the row's
     # media count can split a per-SAMPLE total across items
-    # (``set_patch_budget``, used by the vlm_dpo transform — the reference
-    # enforces the same per-sample cap in its collator budget walk,
-    # ``data/data_collator.py:317-431``)
+    # (``set_patch_budget`` — the reference enforces the same per-sample cap
+    # in its collator budget walk, ``data/data_collator.py:317-431``).
+    # Prefer the stateless per-call form: pass ``patch_budget=`` through
+    # ``encode_messages`` (used by the vlm_dpo transform) so concurrent
+    # callers never race on shared template state.
     item_budget = [int(max_patches_per_sample)]
 
-    def _cap_resize(arr: np.ndarray) -> np.ndarray:
-        budget = item_budget[0]
+    def _norm_budget(n: int) -> int:
+        """Floor a nonzero budget at one merge block (m*m patches)."""
+        return max(m * m, int(n)) if n else 0
+
+    def _cap_resize(arr: np.ndarray, budget: int) -> np.ndarray:
         if not budget:
             return arr
         ps = vcfg.patch_size
@@ -149,11 +196,13 @@ def qwen_vl_chat_template(
         xs = np.linspace(0, w - 1, nw).astype(np.int64)
         return arr[ys][:, xs]
 
-    def expand_image(item) -> Tuple[List[int], Dict[str, Any]]:
+    def expand_image(item, patch_budget=None) -> Tuple[List[int], Dict[str, Any]]:
+        budget = (item_budget[0] if patch_budget is None
+                  else _norm_budget(patch_budget))
         arr = load_image(item, image_size=0) if isinstance(item, str) else np.asarray(item, np.float32)
         if arr.max() > 1.5:
             arr = arr / 255.0
-        arr = _cap_resize(arr)
+        arr = _cap_resize(arr, budget)
         patches, grid = image_to_qwen_patches(arr, vcfg)
         t, gh, gw = grid
         n_merged = t * (gh // m) * (gw // m)
@@ -161,17 +210,19 @@ def qwen_vl_chat_template(
             "vis_patches": patches, "vis_grids": grid,
         }
 
-    def expand_video(item) -> Tuple[List[int], Dict[str, Any]]:
+    def expand_video(item, patch_budget=None) -> Tuple[List[int], Dict[str, Any]]:
+        budget = (item_budget[0] if patch_budget is None
+                  else _norm_budget(patch_budget))
         frames, _fps = load_video(item, **(video_kwargs or {}))
         # temporal patching groups tp consecutive DISTINCT frames (HF
         # Qwen2VLImageProcessor contract — no frame duplication)
         from veomni_tpu.data.multimodal import frames_to_qwen_patches
 
         tp = vcfg.temporal_patch_size
-        if item_budget[0]:
+        if budget:
             # spatial cap first (one temporal unit must fit the budget),
             # then bound the temporal extent to the remaining ratio
-            small = _cap_resize(frames[0])
+            small = _cap_resize(frames[0], budget)
             if small.shape[:2] != frames.shape[1:3]:
                 h, w = frames.shape[1:3]
                 ys = np.linspace(0, h - 1, small.shape[0]).astype(np.int64)
@@ -181,7 +232,7 @@ def qwen_vl_chat_template(
             per_unit = max(
                 1, (frames.shape[1] // ps_) * (frames.shape[2] // ps_)
             )
-            max_t = max(1, item_budget[0] // per_unit)
+            max_t = max(1, budget // per_unit)
             frames = frames[: max_t * tp]
         usable = (len(frames) // tp) * tp
         if not usable:
@@ -200,10 +251,16 @@ def qwen_vl_chat_template(
 
     def set_patch_budget(n: int) -> None:
         """Override the per-item patch budget (e.g. per-sample total split
-        across the row's media count). Minimum: one merge block."""
-        item_budget[0] = max(m * m, int(n)) if n else 0
+        across the row's media count). Minimum: one merge block. NOTE this
+        mutates shared template state — prefer the stateless per-call form
+        ``encode_messages(msgs, patch_budget=n)``."""
+        item_budget[0] = _norm_budget(n)
 
     template.set_patch_budget = set_patch_budget
+    # smallest meaningful per-item budget: one merged vision block — callers
+    # splitting a per-sample budget across media use this to decide when the
+    # split underflows and trailing media must be dropped instead
+    template.min_patch_block = m * m
     return template
 
 

@@ -119,6 +119,11 @@ class TransformerConfig:
     # to [B, chunk_mbs, intermediate] by a lax.map over sequence chunks
     # (fwd AND the remat'd bwd recompute). 0 disables.
     chunk_mbs: int = 0
+    # Ulysses SP a2a/compute overlap (parallel/async_ulysses.py): head-chunk
+    # count for the chunked async pipeline. 0 = defer to the kernel-registry
+    # pin / VEOMNI_ULYSSES_ASYNC env; 1 = force monolithic; >= 2 = pipeline
+    # with that many chunks (clamped to the head layout's feasible maximum).
+    ulysses_async_chunks: int = 0
     initializer_range: float = 0.02
 
     def __post_init__(self):

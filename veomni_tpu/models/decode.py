@@ -238,10 +238,17 @@ def _logits(params, compute, cfg: TransformerConfig, hidden):
     return logits
 
 
-def _prefill_impl(params, cfg: TransformerConfig, tokens, prompt_len: int,
-                  max_len: int):
-    """tokens [B,max_len] (prompt in [:prompt_len]) -> (last-token logits,
-    caches)."""
+def _prefill_impl(params, cfg: TransformerConfig, tokens, prompt_len,
+                  prompt_bucket: int, max_len: int):
+    """tokens [B,max_len] (prompt in [:prompt_len], zero-padded through
+    [:prompt_bucket]) -> (last-prompt-token logits, caches).
+
+    ``prompt_bucket`` (static) is the power-of-two compile bucket;
+    ``prompt_len`` (traced) is the real length. The padded tail rows write
+    garbage k/v into the cache at [prompt_len, prompt_bucket) — harmless:
+    causal masking hides a cache row from every query at position < row, and
+    the decode loop overwrites row ``pos`` at step ``pos`` BEFORE attending
+    to it, so a garbage row is never visible to any real query."""
     compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
     b = tokens.shape[0]
     hd, hkv = cfg.head_dim, cfg.num_key_value_heads
@@ -249,28 +256,32 @@ def _prefill_impl(params, cfg: TransformerConfig, tokens, prompt_len: int,
     k_all = jnp.zeros((L, b, max_len, hkv, hd), cfg.dtype)
     v_all = jnp.zeros_like(k_all)
 
-    ids = tokens[:, :prompt_len]
+    ids = tokens[:, :prompt_bucket]
     hidden = compute["embed_tokens"][ids]
     if cfg.embed_scale:
         hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
-    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    positions = jnp.broadcast_to(jnp.arange(prompt_bucket), (b, prompt_bucket))
     cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions)
 
     kpos = jnp.arange(max_len)[None, None]
-    qpos = jnp.arange(prompt_len)[None, :, None]
+    qpos = jnp.arange(prompt_bucket)[None, :, None]
     valid = kpos <= qpos  # causal over the cache; future rows still zero
     hidden, caches = _walk(compute, cfg, hidden, (k_all, v_all), 0,
                            cos_g, sin_g, cos_l, sin_l, valid)
-    logits = _logits(params, compute, cfg, hidden[:, -1:])
+    last = jax.lax.dynamic_slice_in_dim(hidden, prompt_len - 1, 1, axis=1)
+    logits = _logits(params, compute, cfg, last)
     return logits[:, 0], caches
 
 
 def _select_token(logits, rng, temperature: float, top_k: int):
     """[B,V] f32 -> [B] int32. temperature<=0 means greedy; top_k>0 keeps
-    only the k highest logits before sampling (HF generate semantics)."""
+    only the k highest logits before sampling (HF generate semantics,
+    including the clamp: top_k > vocab means "keep everything" rather than
+    a lax.top_k error)."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
+    top_k = min(top_k, logits.shape[-1])
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits >= kth, logits, -jnp.inf)
@@ -312,21 +323,47 @@ def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
 # jitted entry points cached per config CONTENT (TransformerConfig is a
 # mutable dataclass, so the key is (id, field-repr hash): mutating a config
 # in place retraces instead of silently reusing pre-mutation semantics;
-# jax's own shape cache handles the (prompt_len, max_new) buckets). Bounded:
-# oldest entry evicted past _JIT_CACHE_MAX configs.
+# jax's own shape cache handles the (prompt_bucket, max_len) buckets).
+# Bounded: oldest entry evicted past _JIT_CACHE_MAX configs.
 _JIT_CACHE: Dict[Tuple, Tuple] = {}
 _JIT_CACHE_MAX = 8
+
+# trace-time counters (python side effects run once per compile, never on
+# cache hits): tests assert the bucket scheme keeps these flat across
+# distinct prompt lengths (each retrace on TPU costs 20-40s)
+TRACE_COUNTS = {"prefill": 0, "decode": 0}
+
+
+def _bucket_pow2(n: int, floor: int = 16) -> int:
+    """Smallest power of two >= n (>= floor): the compile bucket for
+    prompt/cache lengths, so nearby lengths share one jit specialization
+    (masking already hides the padded cache rows)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
 
 
 def _jitted(cfg: TransformerConfig):
     key = (id(cfg), hash(repr(cfg)))
     if key not in _JIT_CACHE:
+
+        def prefill_impl(params, cfg, *args):
+            TRACE_COUNTS["prefill"] += 1
+            return _prefill_impl(params, cfg, *args)
+
+        def decode_impl(params, cfg, *args):
+            TRACE_COUNTS["decode"] += 1
+            return _decode_impl(params, cfg, *args)
+
         prefill = jax.jit(
-            lambda params, tokens, pl, ml: _prefill_impl(params, cfg, tokens, pl, ml),
-            static_argnums=(2, 3),
+            lambda params, tokens, pl, pb, ml: prefill_impl(
+                params, cfg, tokens, pl, pb, ml
+            ),
+            static_argnums=(3, 4),
         )
         decode = jax.jit(
-            lambda params, caches, tok, pos, rng, n, temp, tk: _decode_impl(
+            lambda params, caches, tok, pos, rng, n, temp, tk: decode_impl(
                 params, cfg, caches, tok, pos, rng, n, temp, tk
             ),
             static_argnums=(5, 6, 7),
@@ -350,12 +387,17 @@ def greedy_generate(params, cfg: TransformerConfig, prompt_ids,
     if max_new_tokens <= 0:
         return ids
     prompt_len = len(ids)
-    max_len = prompt_len + max_new_tokens
+    # power-of-two compile buckets: every distinct prompt length would
+    # otherwise retrace prefill AND decode (20-40s each on TPU); the padded
+    # rows are invisible (see _prefill_impl)
+    prompt_bucket = _bucket_pow2(prompt_len)
+    max_len = _bucket_pow2(prompt_len + max_new_tokens)
     tokens = jnp.zeros((1, max_len), jnp.int32).at[0, :prompt_len].set(
         jnp.asarray(ids, jnp.int32)
     )
     prefill, decode = _jitted(cfg)
-    logits, caches = prefill(params, tokens, prompt_len, max_len)
+    logits, caches = prefill(params, tokens, jnp.int32(prompt_len),
+                             prompt_bucket, max_len)
     rng = jax.random.PRNGKey(seed)
     rng, sub = jax.random.split(rng)
     first = _select_token(

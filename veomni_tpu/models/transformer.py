@@ -401,6 +401,8 @@ def _standard_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, wi
     attn = ops.attention(
         q, kk, v, segment_ids=segment_ids, causal=True,
         softmax_scale=scale, sliding_window=window, sinks=sinks,
+        # 0 = defer to registry/env; >=1 forces a path (see models/config.py)
+        ulysses_async_chunks=cfg.ulysses_async_chunks or None,
     )
     attn = checkpoint_name(attn, "attn_ctx")
     out = jnp.dot(attn.reshape(b, s, cfg.q_dim), lp["o_proj"])
@@ -507,6 +509,7 @@ def _mla_attention(x, lp, cfg: TransformerConfig, cos, sin, segment_ids, window,
         attn = ops.attention(
             q, k, v, segment_ids=segment_ids, causal=True,
             softmax_scale=scale, sliding_window=window,
+            ulysses_async_chunks=cfg.ulysses_async_chunks or None,
         )
     attn = checkpoint_name(attn, "attn_ctx")
     return jnp.dot(attn.reshape(b, s, nh * dv), lp["o_proj"])

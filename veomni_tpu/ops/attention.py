@@ -394,15 +394,19 @@ def attention(
     sliding_window=None,
     sinks: Optional[jax.Array] = None,
     mask_mod=None,
+    ulysses_async_chunks: Optional[int] = None,
 ):
     """SP-aware facade (reference ``ops/kernels/attention/__init__.py:30-86``):
     under an ambient ParallelState with ulysses > 1, wraps the resolved
-    kernel in the Ulysses a2a shard_map. ``mask_mod`` pins the XLA impls
-    (the Pallas flash kernel doesn't take flex masks) and composes with
-    sequence parallelism too: the ulysses a2a gathers the full sequence
-    before the inner impl builds its position grids, and the ring-CP path
-    evaluates the predicate on global (chunk-offset) positions — so a
-    positional mask_mod sees GLOBAL q/k indices under every layout.
+    kernel in the Ulysses a2a shard_map — either the monolithic wrap or the
+    chunked async pipeline (``parallel/async_ulysses.py``), selected by the
+    ``ulysses`` kernel-registry entry / ``ulysses_async_chunks`` (model
+    config plumbing; None defers to registry pin + env knobs). ``mask_mod``
+    pins the XLA impls (the Pallas flash kernel doesn't take flex masks) and
+    composes with sequence parallelism too: the ulysses a2a gathers the full
+    sequence before the inner impl builds its position grids, and the
+    ring-CP path evaluates the predicate on global (chunk-offset) positions
+    — so a positional mask_mod sees GLOBAL q/k indices under every layout.
     Batch-dependent masks (a closure returning a per-batch [B,...] mask)
     do NOT compose with SP: shard_map would replicate the closed-over
     tensor against the local batch slice — rejected here with a clear
@@ -436,5 +440,6 @@ def attention(
                 )
         from veomni_tpu.parallel.sequence_parallel import sp_attention
 
-        return sp_attention(inner, q, k, v, segment_ids, pstate, **kwargs)
+        return sp_attention(inner, q, k, v, segment_ids, pstate,
+                            async_chunks=ulysses_async_chunks, **kwargs)
     return inner(q, k, v, segment_ids=segment_ids, **kwargs)

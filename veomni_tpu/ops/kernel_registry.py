@@ -72,6 +72,19 @@ class _KernelRegistry:
         self._pins.clear()
         self.resolve.cache_clear()
 
+    def pinned(self, op_name: str) -> Optional[str]:
+        """The impl name an op is pinned to (None = auto-select), validated
+        against the registered impls exactly like resolve() would — a typo'd
+        pin fails fast even on ops dispatched outside resolve()."""
+        pin = self._pins.get(op_name)
+        if pin is not None:
+            impls = self._ops.get(op_name, {})
+            if pin not in impls:
+                raise KeyError(
+                    f"op {op_name!r} has no impl {pin!r}: {sorted(impls)}"
+                )
+        return pin
+
     def impls(self, op_name: str) -> Dict[str, KernelSpec]:
         return dict(self._ops.get(op_name, {}))
 

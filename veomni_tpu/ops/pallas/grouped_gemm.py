@@ -26,6 +26,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY
+from veomni_tpu.utils.jax_compat import pallas_tpu_compiler_params
 
 
 def _interpret() -> bool:
@@ -92,7 +93,7 @@ def _gmm_raw(lhs, rhs, group_starts, bm: int, bn: int):
             scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((m, n), lhs.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -154,7 +155,7 @@ def _gmm_dlhs(g, rhs, group_starts, bm: int, bk: int):
             scratch_shapes=[pltpu.VMEM((bm, bk), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((m, k), g.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -207,7 +208,7 @@ def _gmm_transpose(lhs, g, group_starts, e: int, bm: int, bk: int, bn: int):
             scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((e, k, n), lhs.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),

@@ -12,4 +12,21 @@ __all__ = [
     "get_parallel_state",
     "init_parallel_state",
     "use_parallel_state",
+    "async_ulysses_attention",
+    "sp_attention",
 ]
+
+
+def __getattr__(name):
+    # lazy: sequence_parallel/async_ulysses import jax-heavy modules; keep
+    # `import veomni_tpu.parallel` light for entrypoints that only build a
+    # mesh
+    if name == "sp_attention":
+        from veomni_tpu.parallel.sequence_parallel import sp_attention
+
+        return sp_attention
+    if name == "async_ulysses_attention":
+        from veomni_tpu.parallel.async_ulysses import async_ulysses_attention
+
+        return async_ulysses_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

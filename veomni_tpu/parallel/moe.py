@@ -29,8 +29,9 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from veomni_tpu.utils.jax_compat import shard_map
 
 from veomni_tpu import ops
 from veomni_tpu.parallel.parallel_state import AXIS_EP, ParallelState

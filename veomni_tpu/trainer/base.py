@@ -115,7 +115,18 @@ class BaseTrainer:
             # JAX_PLATFORMS via jax.config, so env vars alone don't stick)
             updates = [("jax_platforms", t.platform)]
             if t.num_virtual_devices:
-                updates.append(("jax_num_cpu_devices", t.num_virtual_devices))
+                if t.platform == "cpu":
+                    from veomni_tpu.utils.jax_compat import set_virtual_cpu_devices
+
+                    try:
+                        set_virtual_cpu_devices(t.num_virtual_devices)
+                    except Exception as e:
+                        logger.warning_rank0(
+                            "could not apply %d virtual cpu devices (backends "
+                            "already initialized?): %s", t.num_virtual_devices, e,
+                        )
+                else:
+                    updates.append(("jax_num_cpu_devices", t.num_virtual_devices))
             if t.platform == "cpu":
                 # many virtual devices on few cores: in-flight executions can
                 # starve the collective rendezvous of pool threads (deadlock)
@@ -170,6 +181,12 @@ class BaseTrainer:
             ops_pins["attention"] = m.attn_implementation
         if m.moe_implementation not in ("auto", ""):
             ops_pins["group_gemm"] = m.moe_implementation
+        if self.args.train.ulysses_async:
+            # chunked a2a/compute overlap pipeline for the Ulysses SP wrap
+            ops_pins.setdefault("ulysses", "ulysses_async")
+            overrides.setdefault(
+                "ulysses_async_chunks", self.args.train.ulysses_async_chunks
+            )
         self.model = build_foundation_model(
             m.config_path or None,
             config=None if m.config_path else self._toy_config(overrides),

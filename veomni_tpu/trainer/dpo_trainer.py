@@ -212,17 +212,54 @@ def build_vlm_dpo_transform(tokenizer=None, vlm_config=None,
                     n += 1
         return n
 
+    def _keep_leading_media(messages, keep: int):
+        """Copy of ``messages`` with only the first ``keep`` image/video
+        parts; later media parts are dropped (their placeholder runs never
+        enter the sample, so the collator budget can't overflow)."""
+        out, seen = [], 0
+        for msg in messages:
+            content = msg.get("content", "")
+            if not isinstance(content, list):
+                out.append(msg)
+                continue
+            parts = []
+            for part in content:
+                if isinstance(part, dict) and part.get("type") in ("image", "video"):
+                    seen += 1
+                    if seen > keep:
+                        continue
+                parts.append(part)
+            out.append({**msg, "content": parts})
+        return out
+
     def transform(row: Dict[str, Any]) -> Dict[str, Any]:
         # split the per-sample budget across the row's media so multi-image
         # / video rows stay under the collator's static per-row budget (the
-        # per-item cap alone would let 3 images overflow it 3x)
+        # per-item cap alone would let 3 images overflow it 3x). The budget
+        # rides the encode call (stateless) instead of mutating shared
+        # template state, so concurrent transforms can't race.
+        messages = row["messages"]
+        enc_kwargs: Dict[str, Any] = {}
         if max_patches_per_sample:
-            # max(1, ...): a floor of 0 would mean "uncapped" to the
-            # template; set_patch_budget's merge-block minimum then applies
-            template.set_patch_budget(max(
-                1, max_patches_per_sample // max(1, _media_count(row["messages"]))
-            ))
-        enc = template.encode_messages(row["messages"])
+            n_media = _media_count(messages)
+            if n_media:
+                block = getattr(template, "min_patch_block", 1)
+                if n_media * block > max_patches_per_sample:
+                    # even one merge block per item overflows the per-sample
+                    # budget: drop trailing media instead of letting the
+                    # per-item floor multiply past max_patches_per_sample
+                    keep = max(1, max_patches_per_sample // block)
+                    logger.warning_once(
+                        "vlm_dpo: row has %d media but budget %d fits only "
+                        "%d at >= %d patches each; dropping trailing media",
+                        n_media, max_patches_per_sample, keep, block,
+                    )
+                    messages = _keep_leading_media(messages, keep)
+                    n_media = keep
+                enc_kwargs["patch_budget"] = max(
+                    1, max_patches_per_sample // n_media
+                )
+        enc = template.encode_messages(messages, **enc_kwargs)
         # open the assistant turn; each branch supplies its own body + close
         prompt_ids = enc["input_ids"] + template._tok(
             f"{template.im_start}assistant\n"

@@ -1,0 +1,104 @@
+"""Compatibility shims across the jax versions this repo meets.
+
+The codebase targets the current jax API surface (``jax.shard_map`` with
+``check_vma``, the ``jax_num_cpu_devices`` config); some container images
+pin an older jaxlib (0.4.x: ``jax.experimental.shard_map`` with
+``check_rep``, virtual CPU devices via
+``--xla_force_host_platform_device_count``). All version probing lives here
+so the rest of the tree can use one spelling.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+try:  # jax >= 0.6: top-level export, `check_vma` kwarg
+    from jax import shard_map as _shard_map_new  # type: ignore
+
+    _HAS_NEW_SHARD_MAP = True
+except ImportError:  # jax 0.4.x: experimental namespace, `check_rep` kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    _HAS_NEW_SHARD_MAP = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` with the replication/VMA check knob mapped to
+    whatever this jax spells it (``check_vma`` new / ``check_rep`` old)."""
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if _HAS_NEW_SHARD_MAP:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _shard_map_new(f, **kwargs)
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map_old(f, **kwargs)
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` (newer jax) with the classic ``psum(1, axis)``
+    idiom as the 0.4.x fallback — both return a static python int inside
+    shard_map, which callers rely on for loop bounds."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.lax.psum(1, axis_name)
+
+
+def pallas_tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across its rename (0.4.x:
+    ``TPUCompilerParams``)."""
+    import jax.experimental.pallas.tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def cpu_collective_timeout_flags(
+    warn_s: int = 120, terminate_s: int = 600
+) -> list:
+    """The XLA:CPU collective-rendezvous timeout flags, or ``[]`` on jaxlib
+    builds whose XLA predates them — parse_flags_from_env ABORTS the process
+    on unknown flags, so these must never reach an old backend's XLA_FLAGS.
+    (The flags landed alongside the jax 0.5 line; gate on that.)"""
+    if jax.__version_info__ < (0, 5, 0):
+        return []
+    return [
+        f"--xla_cpu_collective_call_warn_stuck_timeout_seconds={warn_s}",
+        f"--xla_cpu_collective_call_terminate_timeout_seconds={terminate_s}",
+        f"--xla_cpu_collective_timeout_seconds={terminate_s}",
+    ]
+
+
+def apply_cpu_collective_timeout_flags(
+    warn_s: int = 120, terminate_s: int = 600
+) -> None:
+    """Append the (version-gated) rendezvous timeout flags to XLA_FLAGS.
+    Must run before first backend init; idempotent."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    for f in cpu_collective_timeout_flags(warn_s, terminate_s):
+        if f.split("=")[0] not in flags:
+            flags += " " + f
+    os.environ["XLA_FLAGS"] = flags.strip()
+
+
+def set_virtual_cpu_devices(n: int) -> None:
+    """Force the CPU platform with ``n`` virtual devices. Must run before
+    the first JAX backend initialization (both mechanisms only apply then).
+    """
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # jax 0.4.x: the device count rides XLA_FLAGS instead of jax.config.
+        # Replace (not skip) an inherited value — subprocess harnesses pass
+        # the parent's XLA_FLAGS through the environment
+        tok = "--xla_force_host_platform_device_count"
+        kept = [t for t in os.environ.get("XLA_FLAGS", "").split()
+                if not t.startswith(tok)]
+        kept.append(f"{tok}={n}")
+        os.environ["XLA_FLAGS"] = " ".join(kept)

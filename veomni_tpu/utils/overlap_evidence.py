@@ -1,0 +1,237 @@
+"""HLO overlap-evidence census: is comm/compute overlap real, not hoped-for?
+
+The reference hand-overlaps the Ulysses a2a with attention GEMMs
+(``veomni/distributed/sequence_parallel/async_ulysses.py``); on TPU the
+chunked pipeline (``parallel/async_ulysses.py``) builds the overlap into the
+program *structure* and GSPMD's latency-hiding scheduler turns each
+collective into an async start/done pair spanning compute. This module
+makes that claim checkable (and regression-testable) from the emitted HLO,
+two ways:
+
+1. :func:`analyze_scheduled_dump` — parse an ``--xla_dump_to`` *scheduled*
+   HLO dump (TPU: the latency-hiding scheduler pass) and report every async
+   collective ``*-start``/``*-done`` pair with the number of real compute
+   ops the scheduler placed inside the window. Nonzero gaps = the compiler
+   is hiding that collective behind compute.
+
+2. :func:`overlap_report` — backend-neutral *dependency* census on any HLO
+   text (e.g. ``jit(f).lower(...).compile().as_text()`` on the CPU backend,
+   where collectives lower synchronously and no start/done pairs exist): a
+   collective/compute pair is *overlappable* iff neither transitively
+   depends on the other inside the same computation — the exact precondition
+   a latency-hiding scheduler needs to run them concurrently. The chunked
+   Ulysses pipeline exists to create such pairs (chunk *i*'s a2a is
+   independent of chunk *i-1*'s attention dots); the tier-1 gate in
+   ``tests/test_async_ulysses.py`` fails if the chunked program ever stops
+   exposing at least as many of them as the monolithic one.
+
+``scripts/overlap_evidence.py`` is the CLI wrapper that also measures the
+async-trainer-loop fetch-amortization win.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+COMPUTE_OPS = ("fusion", "dot", "convolution", "custom-call")
+#: collectives the TPU latency-hiding scheduler turns into async pairs; the
+#: Ulysses paths emit all-to-all, the ring-CP path collective-permute
+OVERLAP_COLLECTIVES = ("all-to-all", "collective-permute")
+ALL_COLLECTIVES = (
+    "all-gather", "all-reduce", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+
+# --------------------------------------------------------------------------
+# 1. scheduled-dump census (TPU async start/done pairs)
+# --------------------------------------------------------------------------
+@dataclass(frozen=True)
+class AsyncPair:
+    """One async collective start/done pair in a *scheduled* HLO module."""
+
+    name: str
+    window_lines: int      # schedule distance between start and done
+    compute_inside: int    # real compute ops scheduled inside the window
+
+    @property
+    def overlapped(self) -> bool:
+        return self.compute_inside > 0
+
+
+def analyze_scheduled_dump(dump_dir: str) -> List[AsyncPair]:
+    """Parse scheduled HLO files from an ``--xla_dump_to`` directory: for
+    each async collective start/done pair, count compute ops scheduled
+    between them. Empty off-TPU (XLA:CPU lowers collectives synchronously —
+    use :func:`overlap_report` there)."""
+    pairs: List[AsyncPair] = []
+    for fname in sorted(os.listdir(dump_dir)):
+        if "after_scheduling" not in fname and "latency" not in fname:
+            continue
+        if not fname.endswith(".txt"):
+            continue
+        with open(os.path.join(dump_dir, fname)) as f:
+            lines = f.readlines()
+        open_starts: Dict[str, int] = {}
+        for i, line in enumerate(lines):
+            m = re.search(r"%(\S*?(all-gather|all-reduce|reduce-scatter|"
+                          r"all-to-all|collective-permute)\S*start\S*) =", line)
+            if m:
+                open_starts[m.group(1).rstrip(",")] = i
+                continue
+            m = re.search(r"(all-gather|all-reduce|reduce-scatter|all-to-all|"
+                          r"collective-permute)\S*done", line)
+            if m and open_starts:
+                # attribute to the most recent unmatched start of that type
+                key = next(
+                    (k for k in reversed(list(open_starts))
+                     if m.group(1) in k), None,
+                )
+                if key is None:
+                    continue
+                start_i = open_starts.pop(key)
+                gap_ops = sum(
+                    1 for ln in lines[start_i + 1: i]
+                    if any(f" {op}(" in ln or f"= {op}" in ln
+                           for op in COMPUTE_OPS)
+                )
+                pairs.append(AsyncPair(key.split(".")[0], i - start_i, gap_ops))
+    return pairs
+
+
+def collective_census(hlo_text: str) -> Dict[str, int]:
+    """Count GSPMD-inserted collectives by op in one HLO module's text."""
+    census: Dict[str, int] = {}
+    for op in ALL_COLLECTIVES:
+        census[op] = len(re.findall(rf"= \S* {op}\(|{op}\.", hlo_text))
+    return census
+
+
+# --------------------------------------------------------------------------
+# 2. dependency census (backend-neutral overlappable pairs)
+# --------------------------------------------------------------------------
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*.*?\b([a-z][a-z0-9\-]*)\("
+)
+_OPERAND_RE = re.compile(r"%([\w.\-]+)")
+
+
+def hlo_computations(hlo_text: str) -> Iterator[Tuple[str, List[str]]]:
+    """Yield ``(computation_name, instruction_lines)`` per HLO computation
+    block (text format: an unindented header ending in ``{``, instructions
+    indented, closed by ``}`` at column 0)."""
+    name = None
+    body: List[str] = []
+    for line in hlo_text.splitlines():
+        if name is None:
+            if line and not line[0].isspace() and line.rstrip().endswith("{"):
+                m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)", line)
+                name = m.group(1) if m else "<anon>"
+                body = []
+            continue
+        if line.startswith("}"):
+            yield name, body
+            name = None
+            continue
+        if " = " in line:
+            body.append(line)
+
+
+@dataclass
+class OverlapReport:
+    """Dependency-census result over one HLO module."""
+
+    collectives: int = 0        # collectives of the tracked kinds
+    overlappable: int = 0       # ...with >= 1 independent compute op
+    pairs: int = 0              # total independent (collective, compute) pairs
+    per_computation: Dict[str, Tuple[int, int, int]] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        lines = [
+            f"collectives={self.collectives} overlappable={self.overlappable} "
+            f"independent collective/compute pairs={self.pairs}"
+        ]
+        for comp, (n_c, n_o, n_p) in sorted(self.per_computation.items()):
+            lines.append(f"  {comp:50s} collectives={n_c} overlappable={n_o} "
+                         f"pairs={n_p}")
+        return "\n".join(lines)
+
+
+def _parse_computation(body: List[str]):
+    """-> (ops: name->opcode, deps: name->[operand names in this comp])."""
+    ops: Dict[str, str] = {}
+    deps: Dict[str, List[str]] = {}
+    for line in body:
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, opcode = m.group(1), m.group(2)
+        rest = line[m.end():]
+        ops[name] = opcode
+        # %refs in the rest of the line: operands + control-predecessors
+        # (both are scheduling dependencies); refs to other computations
+        # (to_apply/calls) simply won't resolve in `ops` and drop out
+        deps[name] = [o for o in _OPERAND_RE.findall(rest) if o != name]
+    deps = {n: [o for o in ds if o in ops] for n, ds in deps.items()}
+    return ops, deps
+
+
+def _reach(start: str, edges: Dict[str, List[str]]) -> set:
+    seen = set()
+    stack = [start]
+    while stack:
+        n = stack.pop()
+        for o in edges.get(n, ()):
+            if o not in seen:
+                seen.add(o)
+                stack.append(o)
+    return seen
+
+
+def overlap_report(
+    hlo_text: str,
+    collective_ops: Sequence[str] = OVERLAP_COLLECTIVES,
+    compute_ops: Sequence[str] = COMPUTE_OPS,
+) -> OverlapReport:
+    """Count collective/compute instruction pairs with **no dependency in
+    either direction** inside the same computation — the pairs a
+    latency-hiding scheduler is free to overlap. Works on any HLO text
+    (optimized CPU modules included), no scheduling pass required."""
+    rep = OverlapReport()
+    for comp_name, body in hlo_computations(hlo_text):
+        ops, deps = _parse_computation(body)
+        colls = [n for n, op in ops.items()
+                 if any(op.startswith(c) for c in collective_ops)]
+        if not colls:
+            continue
+        users: Dict[str, List[str]] = {}
+        for n, ds in deps.items():
+            for o in ds:
+                users.setdefault(o, []).append(n)
+        computes = [n for n, op in ops.items() if op in compute_ops]
+        n_over = n_pairs = 0
+        for c in colls:
+            ancestors = _reach(c, deps)
+            descendants = _reach(c, users)
+            indep = [d for d in computes
+                     if d not in ancestors and d not in descendants]
+            n_pairs += len(indep)
+            n_over += bool(indep)
+        rep.collectives += len(colls)
+        rep.overlappable += n_over
+        rep.pairs += n_pairs
+        rep.per_computation[comp_name] = (len(colls), n_over, n_pairs)
+    return rep
+
+
+def compiled_hlo_text(jitted_fn, *args, **kwargs) -> str:
+    """Optimized HLO text of a jitted callable on the current backend
+    (`lower().compile()`, no execution)."""
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    texts = compiled.as_text()
+    if isinstance(texts, (list, tuple)):
+        return "\n".join(texts)
+    return texts

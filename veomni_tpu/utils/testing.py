@@ -15,18 +15,10 @@ def force_cpu_devices(n: int = 8) -> None:
     (observed: CollectivePermute AwaitAndLogIfStuck at seq 32k — the flags
     only apply at first backend init, hence here).
     """
-    import os
+    from veomni_tpu.utils.jax_compat import (
+        apply_cpu_collective_timeout_flags,
+        set_virtual_cpu_devices,
+    )
 
-    import jax
-
-    flags = os.environ.get("XLA_FLAGS", "")
-    for f in (
-        "--xla_cpu_collective_call_warn_stuck_timeout_seconds=300",
-        "--xla_cpu_collective_call_terminate_timeout_seconds=1800",
-        "--xla_cpu_collective_timeout_seconds=1800",
-    ):
-        if f.split("=")[0] not in flags:
-            flags += " " + f
-    os.environ["XLA_FLAGS"] = flags.strip()
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    apply_cpu_collective_timeout_flags(warn_s=300, terminate_s=1800)
+    set_virtual_cpu_devices(n)

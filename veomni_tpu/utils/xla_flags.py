@@ -48,17 +48,20 @@ def enable_compilation_cache() -> str | None:
     return cache_dir
 
 
-def _axon_plugin_registered() -> bool:
+def _axon_plugin_registered() -> bool | None:
     """Whether the axon relay PJRT plugin is registered (pre-init check —
     reading ``jax.devices()`` here would trigger the very parse abort we are
-    avoiding)."""
+    avoiding). Returns None when the probe itself fails (e.g. a JAX-internal
+    rename of ``_backend_factories``): callers must treat that as UNKNOWN
+    and fail closed — assuming "no plugin" on a probe error would re-enable
+    the perf flags on the very platform whose XLA build aborts on them."""
     try:
-        import jax
+        import jax  # noqa: F401
         from jax._src import xla_bridge
 
         return "axon" in xla_bridge._backend_factories
     except Exception:
-        return False
+        return None
 
 
 def apply_performance_flags() -> bool:
@@ -73,15 +76,17 @@ def apply_performance_flags() -> bool:
 
     if jax._src.xla_bridge._backends:  # backend already up: flags won't apply
         return False
-    if _axon_plugin_registered() and os.environ.get(
-        "VEOMNI_XLA_PERF_FLAGS"
-    ) != "force":
+    probe = _axon_plugin_registered()
+    if probe is not False and os.environ.get("VEOMNI_XLA_PERF_FLAGS") != "force":
         # The axon relay's plugin FATALS at XLA_FLAGS parse time on flags its
         # XLA build doesn't know (parse_flags_from_env.cc "Unknown flags"
         # abort, observed r5 with all three --xla_tpu_* scheduler flags).
         # Its remote-compile terminal also overrides client XLA_FLAGS with
         # its own compile env, so client-side flags would not reach the real
-        # compile anyway. Skip them; VEOMNI_XLA_PERF_FLAGS=force re-enables.
+        # compile anyway. Skip them when the plugin is present — AND when the
+        # probe errored (probe None: fail closed, a JAX-internal rename must
+        # not re-trigger the parse abort); VEOMNI_XLA_PERF_FLAGS=force
+        # re-enables either way.
         return False
     current = os.environ.get("XLA_FLAGS", "")
     present = {tok.split("=")[0] for tok in current.split()}
