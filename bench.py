@@ -23,18 +23,19 @@ import numpy as np
 _done = threading.Event()
 
 
-def _watchdog(timeout_s: float):
+def _watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_chip"):
     """The axon TPU tunnel can wedge its chip claim (a killed process leaves
     the grant held), after which backend init hangs indefinitely. If the
     bench can't produce a measurement in time, emit an honest zero-valued
     record pointing at the last measured numbers instead of hanging the
-    driver (see BENCH_NOTES.md)."""
+    driver (see BENCH_NOTES.md). ``metric`` keeps the zero record in the
+    right bench series (train vs serve)."""
     if _done.wait(timeout_s):
         return
     print(json.dumps({
-        "metric": "train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": 0,
-        "unit": f"tokens/s/chip — no measurement within {int(timeout_s)}s "
+        "unit": f"tokens/s — no measurement within {int(timeout_s)}s "
                 "(TPU init or run stalled); last good numbers in BENCH_NOTES.md",
         "vs_baseline": 0,
     }), flush=True)
@@ -218,13 +219,125 @@ def run_bench(
                 "ulysses_async": ulysses_async}
 
 
+def run_serve_bench(
+    *,
+    num_slots: int = 4,
+    block_size: int = 16,
+    n_requests: int = 16,
+    prompt_lens=(64, 128, 256),
+    max_new_tokens: int = 64,
+    preset: str = "qwen3_0p6b",
+    remat_policy: str = "dots",
+) -> dict:
+    """Continuous-batching inference throughput: N requests with a cycled
+    prompt-length mix through the serving engine. Returns decode tokens/s
+    (steady-state, measured after the first token of the last-admitted
+    request wherever possible — here simply total generated / wall) and
+    mean TTFT. Single-chip, random weights: measures the engine + kernels,
+    not checkpoint IO."""
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        Request,
+        SamplingParams,
+    )
+
+    _wait_for_backend()
+    cfg = bench_config(remat_policy, preset)
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+
+    max_len = max(prompt_lens) + max_new_tokens
+    eng = InferenceEngine(params, cfg, EngineConfig(
+        num_slots=num_slots, block_size=block_size, max_model_len=max_len,
+    ))
+    rng = np.random.default_rng(0)
+
+    def make_requests(n):
+        return [
+            Request(
+                prompt_ids=[int(t) for t in rng.integers(
+                    1, cfg.vocab_size, prompt_lens[i % len(prompt_lens)]
+                )],
+                sampling=SamplingParams(max_new_tokens=max_new_tokens),
+            )
+            for i in range(n)
+        ]
+
+    # warmup through the SAME engine (the decode-step jit cache is
+    # per-engine), one length class at a time: a solo run walks that class's
+    # whole block-allocation trajectory, so every power-of-two context
+    # bucket the timed run can hit (nbb is always pow2 of SOME running
+    # seq's allocation) is compiled before t0 — batch-mixed warmup would
+    # let the longest prompt mask the smaller buckets
+    for req in make_requests(len(prompt_lens)):
+        eng.run([req])
+    eng.metrics()  # reset the throughput window
+
+    timed = make_requests(n_requests)
+    t0 = time.perf_counter()
+    ids = [eng.submit(r) for r in timed]
+    outs = eng.run()
+    dt = time.perf_counter() - t0
+    total = sum(len(outs[rid].token_ids) for rid in ids)
+    ttfts = [outs[rid].ttft_s for rid in ids if outs[rid].ttft_s is not None]
+    return {
+        "decode_tok_s": total / dt,
+        "ttft_mean_s": sum(ttfts) / max(1, len(ttfts)),
+        "total_tokens": total,
+        "dt": dt,
+        "num_slots": num_slots,
+        "block_size": block_size,
+        "n_requests": n_requests,
+        "prompt_lens": list(prompt_lens),
+        "max_new_tokens": max_new_tokens,
+        "preset": preset,
+        "preemptions": eng.scheduler.preemption_count,
+    }
+
+
+def _serve_main(preset: str):
+    """BENCH_SERVE=1 entry: one JSON line for the serving trajectory."""
+    lens = tuple(
+        int(x) for x in
+        os.environ.get("BENCH_SERVE_PROMPT_LENS", "64,128,256").split(",")
+    )
+    r = run_serve_bench(
+        num_slots=int(os.environ.get("BENCH_SERVE_SLOTS", 4)),
+        block_size=int(os.environ.get("BENCH_SERVE_BLOCK", 16)),
+        n_requests=int(os.environ.get("BENCH_SERVE_REQUESTS", 16)),
+        prompt_lens=lens,
+        max_new_tokens=int(os.environ.get("BENCH_SERVE_NEW_TOKENS", 64)),
+        preset=preset,
+    )
+    _done.set()
+    print(json.dumps({
+        "metric": "serve_decode_tokens_per_sec",
+        "value": round(r["decode_tok_s"], 1),
+        "unit": f"decode tokens/s ({r['preset']} bf16, slots={r['num_slots']}, "
+                f"block={r['block_size']}, {r['n_requests']} reqs "
+                f"mix{r['prompt_lens']}, ttft={r['ttft_mean_s']*1e3:.0f}ms, "
+                f"preempt={r['preemptions']})",
+        # nominal serving north star: 1k decode tok/s on one chip (no
+        # published single-v5e continuous-batching baseline exists)
+        "vs_baseline": round(r["decode_tok_s"] / 1000.0, 4),
+    }), flush=True)
+
+
 def main():
     from veomni_tpu.utils.xla_flags import apply_performance_flags
 
     apply_performance_flags()
+    serve = os.environ.get("BENCH_SERVE", "0") not in ("0", "")
     threading.Thread(
         target=_watchdog,
-        args=(float(os.environ.get("BENCH_WATCHDOG_S", 900)),),
+        args=(float(os.environ.get("BENCH_WATCHDOG_S", 900)),
+              "serve_decode_tokens_per_sec" if serve
+              else "train_tokens_per_sec_per_chip"),
         daemon=True,
     ).start()
     preset = os.environ.get("BENCH_PRESET", "qwen3_0p6b")
@@ -232,6 +345,8 @@ def main():
         raise SystemExit(
             f"unknown BENCH_PRESET {preset!r}; choose from {sorted(BENCH_PRESETS)}"
         )
+    if serve:
+        return _serve_main(preset)
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))
     micro_bs = int(os.environ.get("BENCH_MICRO_BS", 4))
     steps = int(os.environ.get("BENCH_STEPS", 10))

@@ -1,0 +1,123 @@
+"""Minimal CLI driver for the continuous-batching inference engine.
+
+Operates on token ids (tokenization is out of scope for the driver): either
+a stream of synthetic random-prompt requests (``--synthetic N``) or explicit
+comma-separated prompts (``--prompt-ids 5,17,3`` repeatable). Streams every
+token event to stdout as it lands and prints the engine metrics at the end.
+
+By default builds a tiny random-weight qwen3-style model (engine plumbing
+demo / CPU smoke); ``--preset`` switches to a bench-scale model on the real
+accelerator.
+
+Run:
+  python scripts/serve.py --synthetic 8 --max-new 32
+  python scripts/serve.py --prompt-ids 1,2,3 --prompt-ids 4,5 \
+      --temperature 0.8 --top-p 0.9
+
+Env knobs (flags win): VEOMNI_SERVE_SLOTS, VEOMNI_SERVE_BLOCK,
+VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_model(args):
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.models import TransformerConfig, build_foundation_model
+
+    if args.preset:
+        from bench import bench_config
+
+        cfg = bench_config(preset=args.preset)
+    else:  # tiny random demo model
+        cfg = TransformerConfig(
+            model_type="qwen3", vocab_size=256, hidden_size=64,
+            intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            qk_norm=True, dtype=jnp.float32,
+        )
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(args.seed), cfg)
+    return params, cfg
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--prompt-ids", action="append", default=[],
+                    help="comma-separated token ids; repeatable")
+    ap.add_argument("--synthetic", type=int, default=0,
+                    help="also enqueue N random prompts")
+    ap.add_argument("--synthetic-len", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=1.0)
+    ap.add_argument("--eos-id", type=int, default=-1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--preset", default="",
+                    help="bench.py BENCH_PRESETS model instead of the tiny demo")
+    ap.add_argument("--slots", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_SLOTS", 4)))
+    ap.add_argument("--block-size", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_BLOCK", 16)))
+    ap.add_argument("--max-model-len", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_MAX_LEN", 2048)))
+    ap.add_argument("--log-steps", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_LOG_STEPS", 0)))
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from veomni_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        Request,
+        SamplingParams,
+    )
+
+    params, cfg = _build_model(args)
+    engine = InferenceEngine(params, cfg, EngineConfig(
+        num_slots=args.slots, block_size=args.block_size,
+        max_model_len=args.max_model_len, log_every_steps=args.log_steps,
+    ))
+
+    sampling = SamplingParams(
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        max_new_tokens=args.max_new, eos_id=args.eos_id, seed=args.seed,
+    )
+    prompts = [[int(t) for t in s.split(",")] for s in args.prompt_ids]
+    rng = np.random.default_rng(args.seed)
+    prompts += [
+        [int(t) for t in rng.integers(1, cfg.vocab_size, args.synthetic_len)]
+        for _ in range(args.synthetic)
+    ]
+    if not prompts:
+        ap.error("nothing to do: pass --prompt-ids and/or --synthetic N")
+
+    reqs = [Request(prompt_ids=p, sampling=sampling) for p in prompts]
+    for ev in engine.generate(reqs):
+        line = {"request_id": ev.request_id, "index": ev.index,
+                "token": ev.token}
+        if ev.finished:
+            line["finished"] = ev.finish_reason
+        print(json.dumps(line), flush=True)
+    outs = engine.run()  # no-op drain; collects final outputs
+    print(json.dumps({"metrics": engine.metrics()}), flush=True)
+    for rid in sorted(outs):
+        o = outs[rid]
+        print(json.dumps({
+            "request_id": rid, "tokens": o.token_ids,
+            "finish_reason": o.finish_reason,
+            "ttft_s": round(o.ttft_s, 4) if o.ttft_s is not None else None,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -114,6 +114,62 @@ def test_sampling_decode_valid_and_greedy_consistent():
     assert all(0 <= t < 128 for t in s3[len(prompt):])
 
 
+def test_nucleus_sampling():
+    """top_p semantics: a vanishing nucleus collapses to greedy (the top-1
+    token always survives the filter); top_p=1.0 keeps the full
+    distribution (token-identical to not passing top_p); sampled tokens
+    stay in-vocab and per-seed reproducible."""
+    cfg = TransformerConfig(dtype=jnp.float32, **CONFIGS["qwen3"])
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = list(np.random.default_rng(4).integers(1, 128, 8))
+    greedy = greedy_generate(params, cfg, prompt, max_new_tokens=5)
+    tiny_p = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                             temperature=0.8, top_p=1e-6, seed=3)
+    assert tiny_p == greedy
+    full_p = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                             temperature=0.8, top_k=10, top_p=1.0, seed=3)
+    no_p = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                           temperature=0.8, top_k=10, seed=3)
+    assert full_p == no_p
+    s1 = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                         temperature=0.9, top_p=0.7, seed=5)
+    s2 = greedy_generate(params, cfg, prompt, max_new_tokens=5,
+                         temperature=0.9, top_p=0.7, seed=5)
+    assert s1 == s2
+    assert all(0 <= t < 128 for t in s1[len(prompt):])
+
+
+def test_per_slot_sample_tokens_matches_scalar_semantics():
+    """The serving engine's vectorized sampler: greedy rows == argmax
+    regardless of batch-mates; per-row top_k<=0 / top_p>=1 keep everything;
+    a tiny top_p collapses a sampled row to its argmax."""
+    from veomni_tpu.models.decode import sample_tokens
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(4))
+    out = sample_tokens(
+        logits, keys,
+        jnp.asarray([0.0, 0.0, 0.8, 0.9], jnp.float32),
+        jnp.asarray([0, 5, 0, 3], jnp.int32),
+        jnp.asarray([1.0, 1.0, 1e-6, 0.9], jnp.float32),
+    )
+    out = np.asarray(out)
+    am = np.asarray(jnp.argmax(logits, axis=-1))
+    assert out[0] == am[0] and out[1] == am[1]  # temperature<=0 -> greedy
+    assert out[2] == am[2]  # vanishing nucleus -> argmax survives alone
+    assert 0 <= out[3] < 32
+    # per-row keys: the same row resamples identically under the same key
+    out2 = np.asarray(sample_tokens(
+        logits, keys,
+        jnp.asarray([0.0, 0.0, 0.8, 0.9], jnp.float32),
+        jnp.asarray([0, 5, 0, 3], jnp.int32),
+        jnp.asarray([1.0, 1.0, 1e-6, 0.9], jnp.float32),
+    ))
+    assert (out == out2).all()
+
+
 def test_prompt_length_bucketing_keeps_compiles_flat():
     """Distinct prompt lengths inside one power-of-two bucket must reuse the
     SAME prefill/decode compilation (each retrace costs 20-40s on TPU) and

@@ -29,7 +29,9 @@ xla_bridge.get_backend = _forbidden
 import veomni_tpu
 
 failures = []
+visited = []
 for m in pkgutil.walk_packages(veomni_tpu.__path__, "veomni_tpu."):
+    visited.append(m.name)
     try:
         importlib.import_module(m.name)
     except AssertionError:
@@ -39,6 +41,12 @@ for m in pkgutil.walk_packages(veomni_tpu.__path__, "veomni_tpu."):
 if failures:
     print("FAILURES:" + ",".join(failures))
     sys.exit(1)
+# the serving package must be part of the walk (a missing __init__.py would
+# silently drop the whole subtree from this gate)
+for required in ("veomni_tpu.serving", "veomni_tpu.serving.engine"):
+    if required not in visited:
+        print("MISSING:" + required)
+        sys.exit(1)
 print("CLEAN")
 """
 

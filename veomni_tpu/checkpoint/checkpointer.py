@@ -41,12 +41,13 @@ class Checkpointer:
         # startup is the only moment no save can be in flight anywhere, so
         # clear crashed-save debris here (never during save(): a lagging host
         # could rmtree a faster host's live tmp dir)
-        if jax.process_index() == 0:
-            self._clean_debris()
+        self._clean_debris()
 
     def _clean_debris(self):
         import shutil
 
+        if jax.process_index() != 0:  # same shared-fs race as _prune
+            return
         for d in os.listdir(self.ckpt_dir):
             if not _STEP_RE.match(d):
                 continue
@@ -97,6 +98,12 @@ class Checkpointer:
 
     def _prune(self):
         if not self.max_to_keep:
+            return
+        # single-rank deletion: every process calls save(), but on a shared
+        # filesystem N ranks racing rmtree over the same step dirs hit
+        # ENOENT on each other's half-deleted trees (ignore_errors hides the
+        # error but not a torn delete racing a concurrent lister)
+        if jax.process_index() != 0:
             return
         steps = sorted(self.list_steps())
         for s in steps[: -self.max_to_keep]:

@@ -92,38 +92,26 @@ def _qkv(x, lp, cfg: TransformerConfig, cos, sin):
     return q, k, v
 
 
-def _cache_attend(q, k_cache, v_cache, valid_mask, cfg: TransformerConfig,
-                  sinks=None):
-    """q [B,T,hq,d] against the full static cache [B,M,hkv,d]; valid_mask
-    [B,T,M] bool (causal+window+length). Dense math — decode T is 1 (or the
-    short prefill), the cache is the long axis."""
+def _attn_params(cfg: TransformerConfig) -> Tuple[int, float]:
+    """(GQA repeat factor, softmax scale) shared by the contiguous and paged
+    cache-attention paths."""
     nrep = cfg.num_attention_heads // cfg.num_key_value_heads
-    if nrep > 1:
-        b, m, hk, d = k_cache.shape
-        k_cache = jnp.broadcast_to(
-            k_cache[:, :, :, None, :], (b, m, hk, nrep, d)
-        ).reshape(b, m, hk * nrep, d)
-        v_cache = jnp.broadcast_to(
-            v_cache[:, :, :, None, :], (b, m, hk, nrep, d)
-        ).reshape(b, m, hk * nrep, d)
     scale = (
         cfg.query_pre_attn_scalar ** -0.5 if cfg.query_pre_attn_scalar
         else cfg.head_dim ** -0.5
     )
-    s = jnp.einsum("bthd,bmhd->bhtm", q, k_cache,
-                   preferred_element_type=jnp.float32) * scale
-    s = jnp.where(valid_mask[:, None], s, -jnp.inf)
-    m_ = jnp.max(s, axis=-1, keepdims=True)
-    if sinks is not None:
-        sink = sinks.astype(jnp.float32)[None, :, None, None]
-        m_ = jnp.maximum(m_, sink)
-    p = jnp.exp(s - m_)
-    l = p.sum(-1)
-    if sinks is not None:
-        l = l + jnp.exp(sink[..., 0] - m_[..., 0])
-    o = jnp.einsum("bhtm,bmhd->bthd", p.astype(q.dtype), v_cache,
-                   preferred_element_type=jnp.float32)
-    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    return nrep, scale
+
+
+def _cache_attend(q, k_cache, v_cache, valid_mask, cfg: TransformerConfig,
+                  sinks=None):
+    """q [B,T,hq,d] against the full static cache [B,M,hkv,d]; valid_mask
+    [B,T,M] bool (causal+window+length). The math lives in
+    ``ops.cache_attend`` so the paged (block-table) path shares it."""
+    nrep, scale = _attn_params(cfg)
+    return ops.cache_attend(
+        q, k_cache, v_cache, valid_mask, num_rep=nrep, scale=scale, sinks=sinks
+    )
 
 
 def _mlp(x, lp, cfg: TransformerConfig, is_moe: bool):
@@ -141,16 +129,9 @@ def _mlp(x, lp, cfg: TransformerConfig, is_moe: bool):
     return o
 
 
-def _layer(hidden, lp, cfg: TransformerConfig, cos, sin, k_cache, v_cache,
-           valid_mask, write_idx, is_moe):
-    """One decoder layer against the cache. Returns (hidden, k_cache,
-    v_cache) with this layer's new k/v written at ``write_idx``."""
-    x = _norm(hidden, lp["input_layernorm"], cfg)
-    q, k_new, v_new = _qkv(x, lp, cfg, cos, sin)
-    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_new, write_idx, 1)
-    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_new, write_idx, 1)
-    attn = _cache_attend(q, k_cache, v_cache, valid_mask, cfg,
-                         sinks=lp.get("sinks"))
+def _layer_tail(hidden, attn, lp, cfg: TransformerConfig, is_moe):
+    """Everything after attention (o_proj + residual + FFN), shared by the
+    contiguous and paged layer variants."""
     b, t, _, _ = attn.shape
     out = jnp.dot(attn.reshape(b, t, cfg.q_dim), lp["o_proj"])
     if "o_bias" in lp:
@@ -164,7 +145,41 @@ def _layer(hidden, lp, cfg: TransformerConfig, cos, sin, k_cache, v_cache,
     out = _mlp(x, lp, cfg, is_moe)
     if cfg.sandwich_norms:
         out = _norm(out, lp["post_feedforward_layernorm"], cfg)
-    return hidden + out, k_cache, v_cache
+    return hidden + out
+
+
+def _layer(hidden, lp, cfg: TransformerConfig, cos, sin, k_cache, v_cache,
+           valid_mask, write_idx, is_moe):
+    """One decoder layer against the cache. Returns (hidden, k_cache,
+    v_cache) with this layer's new k/v written at ``write_idx``."""
+    x = _norm(hidden, lp["input_layernorm"], cfg)
+    q, k_new, v_new = _qkv(x, lp, cfg, cos, sin)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_new, write_idx, 1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_new, write_idx, 1)
+    attn = _cache_attend(q, k_cache, v_cache, valid_mask, cfg,
+                         sinks=lp.get("sinks"))
+    return _layer_tail(hidden, attn, lp, cfg, is_moe), k_cache, v_cache
+
+
+def _paged_layer(hidden, lp, cfg: TransformerConfig, cos, sin, k_pool, v_pool,
+                 block_tables, write_block, write_off, valid_mask, is_moe):
+    """One decoder layer against the paged block pool: single-token decode
+    only (T==1). The new k/v row is scattered to each slot's
+    (write_block, write_off) BEFORE attending, preserving the contiguous
+    path's write-before-attend invariant. Inactive slots point at the
+    reserved null block 0 — duplicate scatter indices there leave garbage no
+    live query can see (the valid mask caps every slot at its own position).
+    """
+    x = _norm(hidden, lp["input_layernorm"], cfg)
+    q, k_new, v_new = _qkv(x, lp, cfg, cos, sin)
+    k_pool = k_pool.at[write_block, write_off].set(k_new[:, 0])
+    v_pool = v_pool.at[write_block, write_off].set(v_new[:, 0])
+    nrep, scale = _attn_params(cfg)
+    attn = ops.paged_attend(
+        q, k_pool, v_pool, block_tables, valid_mask,
+        num_rep=nrep, scale=scale, sinks=lp.get("sinks"),
+    )
+    return _layer_tail(hidden, attn, lp, cfg, is_moe), k_pool, v_pool
 
 
 def _layer_meta(cfg: TransformerConfig):
@@ -227,6 +242,102 @@ def _walk(compute, cfg: TransformerConfig, hidden, caches, write_idx,
     return hidden, (k_all, v_all)
 
 
+def _paged_walk(compute, cfg: TransformerConfig, hidden, pools, block_tables,
+                positions, cos_g, sin_g, cos_l, sin_l):
+    """Paged analogue of ``_walk``: scan all layers (dense segment then MoE
+    segment) threading the block pools.
+
+    pools: (k [L,NB,BS,hkv,d], v [L,NB,BS,hkv,d]); block_tables [S,nb];
+    positions [S] is each slot's write position (== its query position).
+    Block-table order is sequence order, so gathered context index j sits at
+    absolute position j and the causal/window masks are identical to the
+    contiguous path's."""
+    windows, local_flags = _layer_meta(cfg)
+    k_all, v_all = pools
+    bs = k_all.shape[2]  # [L, NB, BS, hkv, d]
+    ctx = block_tables.shape[1] * bs
+    kpos = jnp.arange(ctx)[None, None]  # [1,1,ctx]
+    qpos = positions[:, None, None]  # [S,1,1]
+    valid_base = kpos <= qpos
+    write_block = jnp.take_along_axis(
+        block_tables, (positions // bs)[:, None], axis=1
+    )[:, 0]
+    write_off = positions % bs
+
+    L = cfg.num_hidden_layers
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
+    segments = []
+    if k_dense:
+        segments.append(("dense_layers", 0, k_dense, False))
+    segments.append(("layers", k_dense, L - k_dense, cfg.is_moe))
+
+    for name, offset, count, is_moe_seg in segments:
+        tree = compute[name]
+
+        def body(carry, xs):
+            hidden, = carry
+            lp, k_p, v_p, win, loc = xs
+            cos = jnp.where(loc, cos_l, cos_g)
+            sin = jnp.where(loc, sin_l, sin_g)
+            in_window = jnp.where(win > 0, qpos - kpos < win, True)
+            mask = valid_base & in_window
+            hidden, k_p, v_p = _paged_layer(
+                hidden, lp, cfg, cos, sin, k_p, v_p, block_tables,
+                write_block, write_off, mask, is_moe_seg,
+            )
+            return (hidden,), (k_p, v_p)
+
+        sl = slice(offset, offset + count)
+        (hidden,), (k_seg, v_seg) = jax.lax.scan(
+            body, (hidden,),
+            (tree, k_all[sl], v_all[sl], windows[sl], local_flags[sl]),
+        )
+        k_all = k_all.at[sl].set(k_seg)
+        v_all = v_all.at[sl].set(v_seg)
+    return hidden, (k_all, v_all)
+
+
+def paged_decode_step(params, cfg: TransformerConfig, pools, block_tables,
+                      positions, tokens):
+    """One batched decode step over the slot batch.
+
+    tokens [S] (each slot's most recent token), positions [S] (where that
+    token is written and attends from), block_tables [S,nb] int32 padded
+    with the null block 0. Returns (logits [S,V] f32, pools). The serving
+    engine jits this with the pools donated; the gathered-context width
+    nb*BS is the compile bucket."""
+    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    positions_2d = positions[:, None]
+    cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions_2d)
+    hidden = compute["embed_tokens"][tokens[:, None]]
+    if cfg.embed_scale:
+        hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    hidden, pools = _paged_walk(compute, cfg, hidden, pools, block_tables,
+                                positions, cos_g, sin_g, cos_l, sin_l)
+    logits = _logits(params, compute, cfg, hidden)
+    return logits[:, 0].astype(jnp.float32), pools
+
+
+def scatter_prompt_cache(pools, prompt_caches, block_ids):
+    """Write a contiguous prefill cache into pool blocks.
+
+    prompt_caches: (k [L,1,PB,hkv,d], v) from ``_prefill_impl`` with
+    max_len == PB (the prompt bucket); block_ids [PB/BS] int32 — the
+    sequence's allocated blocks, padded with the null block 0 for the
+    all-garbage tail blocks past ceil(prompt_len/BS). The boundary block's
+    garbage rows in [prompt_len, PB) are harmless for the same reason as the
+    contiguous path: decode overwrites row ``pos`` at step ``pos`` before
+    attending to it."""
+    k_pool, v_pool = pools
+    k_c, v_c = prompt_caches
+    L, _, pb, hkv, d = k_c.shape
+    bs = k_pool.shape[2]
+    nb = pb // bs
+    k_pool = k_pool.at[:, block_ids].set(k_c[:, 0].reshape(L, nb, bs, hkv, d))
+    v_pool = v_pool.at[:, block_ids].set(v_c[:, 0].reshape(L, nb, bs, hkv, d))
+    return k_pool, v_pool
+
+
 def _logits(params, compute, cfg: TransformerConfig, hidden):
     hidden = _norm(hidden, compute["norm"], cfg)
     kernel = lm_head_kernel(params, cfg).astype(cfg.dtype)
@@ -273,11 +384,28 @@ def _prefill_impl(params, cfg: TransformerConfig, tokens, prompt_len,
     return logits[:, 0], caches
 
 
-def _select_token(logits, rng, temperature: float, top_k: int):
+def _nucleus_mask(logits, top_p):
+    """Mask logits outside the top-p nucleus to -inf. HF TopPLogitsWarper
+    semantics: sort descending, keep the smallest prefix whose cumulative
+    probability reaches top_p (the crossing token included; the top-1 token
+    always survives). top_p broadcasts [()] or [B]."""
+    sl = jnp.sort(logits, axis=-1)[..., ::-1]
+    p = jax.nn.softmax(sl, axis=-1)
+    cum = jnp.cumsum(p, axis=-1)
+    keep = (cum - p) < jnp.asarray(top_p, jnp.float32)[..., None]
+    nkeep = jnp.maximum(keep.sum(-1), 1)
+    thresh = jnp.take_along_axis(sl, (nkeep - 1)[..., None], axis=-1)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def _select_token(logits, rng, temperature: float, top_k: int,
+                  top_p: float = 1.0):
     """[B,V] f32 -> [B] int32. temperature<=0 means greedy; top_k>0 keeps
     only the k highest logits before sampling (HF generate semantics,
     including the clamp: top_k > vocab means "keep everything" rather than
-    a lax.top_k error)."""
+    a lax.top_k error); top_p<1 then keeps the nucleus whose cumulative
+    probability reaches top_p (HF warper order: temperature, top_k, top_p).
+    """
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
@@ -285,15 +413,52 @@ def _select_token(logits, rng, temperature: float, top_k: int):
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p < 1.0:
+        logits = _nucleus_mask(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_tokens(logits, keys, temperature, top_k, top_p):
+    """Per-slot sampling for the serving engine: every parameter is a traced
+    per-row array, so one compiled program honors any mix of per-request
+    sampling params. logits [S,V] f32; keys [S,2] uint32 (one PRNG key per
+    slot — sampling is reproducible per request regardless of what else is
+    in the batch); temperature/top_p [S] f32; top_k [S] int32.
+
+    Per-slot semantics match ``_select_token``: temperature<=0 is greedy,
+    top_k<=0 keeps everything (clamped to vocab), top_p>=1 keeps everything.
+    """
+    v = logits.shape[-1]
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    top_p = jnp.asarray(top_p, jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    l = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # ONE full-vocab sort serves both filters (this is the per-token decode
+    # hot path): top-k keeps a prefix of the sorted order and the nucleus
+    # keeps a prefix of THAT, so both reduce to one threshold from ``sl``.
+    sl = jnp.sort(l, axis=-1)[..., ::-1]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, v), 1, v) - 1
+    in_k = jnp.arange(v)[None] <= k_idx[:, None]
+    p = jax.nn.softmax(jnp.where(in_k, sl, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(p, axis=-1)
+    keep = in_k & ((cum - p) < top_p[:, None])
+    nkeep = jnp.maximum(keep.sum(-1), 1)
+    thresh = jnp.take_along_axis(sl, (nkeep - 1)[:, None], axis=-1)
+    l = jnp.where(l < thresh, -jnp.inf, l)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row)
+    )(keys, l).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
 def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
                  start_pos, rng, n_steps: int, temperature: float,
-                 top_k: int):
+                 top_k: int, top_p: float):
     """Scan decode: emit n_steps tokens starting from first_token at
     start_pos (the prompt length). Greedy when temperature<=0, else
-    temperature/top-k sampling with a PRNG carry."""
+    temperature/top-k/top-p sampling with a PRNG carry."""
     compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
     max_len = caches[0].shape[2]
     kpos = jnp.arange(max_len)[None, None]
@@ -310,7 +475,7 @@ def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
                                cos_g, sin_g, cos_l, sin_l, valid)
         logits = _logits(params, compute, cfg, hidden)
         rng, sub = jax.random.split(rng)
-        nxt = _select_token(logits[:, 0], sub, temperature, top_k)
+        nxt = _select_token(logits[:, 0], sub, temperature, top_k, top_p)
         return (nxt, pos + 1, caches, rng), nxt
 
     (_, _, _, _), out = jax.lax.scan(
@@ -331,7 +496,7 @@ _JIT_CACHE_MAX = 8
 # trace-time counters (python side effects run once per compile, never on
 # cache hits): tests assert the bucket scheme keeps these flat across
 # distinct prompt lengths (each retrace on TPU costs 20-40s)
-TRACE_COUNTS = {"prefill": 0, "decode": 0}
+TRACE_COUNTS = {"prefill": 0, "decode": 0, "paged_decode": 0}
 
 
 def _bucket_pow2(n: int, floor: int = 16) -> int:
@@ -363,10 +528,10 @@ def _jitted(cfg: TransformerConfig):
             static_argnums=(3, 4),
         )
         decode = jax.jit(
-            lambda params, caches, tok, pos, rng, n, temp, tk: decode_impl(
-                params, cfg, caches, tok, pos, rng, n, temp, tk
+            lambda params, caches, tok, pos, rng, n, temp, tk, tp: decode_impl(
+                params, cfg, caches, tok, pos, rng, n, temp, tk, tp
             ),
-            static_argnums=(5, 6, 7),
+            static_argnums=(5, 6, 7, 8),
         )
         while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
             _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
@@ -376,10 +541,11 @@ def _jitted(cfg: TransformerConfig):
 
 def greedy_generate(params, cfg: TransformerConfig, prompt_ids,
                     max_new_tokens: int = 64, eos_id: int = -1,
-                    temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+                    temperature: float = 0.0, top_k: int = 0,
+                    top_p: float = 1.0, seed: int = 0):
     """Prompt token list -> full id list (prompt + generated, trimmed at
     eos). One prefill + one scan decode; static shapes throughout.
-    temperature<=0 (default) is greedy; otherwise temperature/top-k
+    temperature<=0 (default) is greedy; otherwise temperature/top-k/top-p
     sampling (HF generate's do_sample analogue)."""
     import numpy as np
 
@@ -401,10 +567,12 @@ def greedy_generate(params, cfg: TransformerConfig, prompt_ids,
     rng = jax.random.PRNGKey(seed)
     rng, sub = jax.random.split(rng)
     first = _select_token(
-        logits.astype(jnp.float32), sub, float(temperature), int(top_k)
+        logits.astype(jnp.float32), sub, float(temperature), int(top_k),
+        float(top_p),
     )
     rest = (decode(params, caches, first, prompt_len, rng,
-                   max_new_tokens - 1, float(temperature), int(top_k))
+                   max_new_tokens - 1, float(temperature), int(top_k),
+                   float(top_p))
             if max_new_tokens > 1 else None)
     out = [int(first[0])]
     if rest is not None:

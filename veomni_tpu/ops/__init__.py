@@ -14,6 +14,7 @@ from veomni_tpu.ops import attention as _attention  # noqa: F401
 from veomni_tpu.ops import cross_entropy as _cross_entropy  # noqa: F401
 from veomni_tpu.ops import load_balancing as _load_balancing  # noqa: F401
 from veomni_tpu.ops import group_gemm as _group_gemm  # noqa: F401
+from veomni_tpu.ops import paged_attention as _paged_attention  # noqa: F401
 from veomni_tpu.ops import pallas as _pallas  # noqa: F401  (registers TPU kernels)
 
 rms_norm = _rms_norm.rms_norm
@@ -25,6 +26,9 @@ fused_linear_cross_entropy = _cross_entropy.fused_linear_cross_entropy
 fused_linear_topk_distill = _cross_entropy.fused_linear_topk_distill
 load_balancing_loss = _load_balancing.load_balancing_loss
 group_gemm = _group_gemm.group_gemm
+cache_attend = _paged_attention.cache_attend
+gather_block_kv = _paged_attention.gather_block_kv
+paged_attend = _paged_attention.paged_attend
 
 __all__ = [
     "KERNEL_REGISTRY",
@@ -39,4 +43,7 @@ __all__ = [
     "fused_linear_topk_distill",
     "load_balancing_loss",
     "group_gemm",
+    "cache_attend",
+    "gather_block_kv",
+    "paged_attend",
 ]

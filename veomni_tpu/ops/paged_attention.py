@@ -1,0 +1,112 @@
+"""Paged KV-cache attention: attend a decode query against gathered blocks.
+
+The serving engine (``veomni_tpu/serving/``) carves the KV cache into a
+global pool of fixed-size blocks ``[num_blocks, block_size, hkv, d]`` with
+per-sequence block tables — the vLLM PagedAttention layout translated to a
+static-shape XLA program. ``paged_attend`` gathers each slot's blocks into a
+contiguous context (block-table order IS sequence order, so gathered index
+``j`` sits at absolute position ``j``) and runs the same masked dense
+softmax the contiguous decode cache uses — decode T is 1, the context is
+the long axis, so the dense math is the right shape regime and the gather
+is the only paging-specific step.
+
+``cache_attend`` is that shared softmax: ``models/decode.py`` calls it for
+the contiguous cache and this module calls it for the gathered one, so the
+sink / GQA-repeat / masking semantics can never drift between the two
+decode paths. Registered as op ``paged_attention`` (impl ``xla_gather``) so
+an ops-config pin can swap in a fused Pallas kernel later without touching
+the serving engine.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+
+
+def cache_attend(
+    q,
+    k_cache,
+    v_cache,
+    valid_mask,
+    *,
+    num_rep: int = 1,
+    scale: float,
+    sinks: Optional[jax.Array] = None,
+):
+    """q [B,T,hq,d] against a cache [B,M,hkv,d]; valid_mask [B,T,M] bool
+    (causal+window+length, broadcastable over B/T). Dense math — decode T is
+    1 (or the short prefill), the cache is the long axis. ``sinks`` [hq] are
+    learned attention-sink logits folded into the softmax denominator
+    (gpt_oss family)."""
+    if num_rep > 1:
+        b, m, hk, d = k_cache.shape
+        k_cache = jnp.broadcast_to(
+            k_cache[:, :, :, None, :], (b, m, hk, num_rep, d)
+        ).reshape(b, m, hk * num_rep, d)
+        v_cache = jnp.broadcast_to(
+            v_cache[:, :, :, None, :], (b, m, hk, num_rep, d)
+        ).reshape(b, m, hk * num_rep, d)
+    s = jnp.einsum("bthd,bmhd->bhtm", q, k_cache,
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(valid_mask[:, None], s, -jnp.inf)
+    m_ = jnp.max(s, axis=-1, keepdims=True)
+    if sinks is not None:
+        sink = sinks.astype(jnp.float32)[None, :, None, None]
+        m_ = jnp.maximum(m_, sink)
+    p = jnp.exp(s - m_)
+    l = p.sum(-1)
+    if sinks is not None:
+        l = l + jnp.exp(sink[..., 0] - m_[..., 0])
+    o = jnp.einsum("bhtm,bmhd->bthd", p.astype(q.dtype), v_cache,
+                   preferred_element_type=jnp.float32)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def gather_block_kv(k_pool, v_pool, block_tables):
+    """Gather per-slot KV contexts from the block pool.
+
+    k_pool/v_pool [NB, BS, hkv, d]; block_tables [S, nb] int32 (padded with
+    the null block 0 past each sequence's allocation) ->
+    (k [S, nb*BS, hkv, d], v [S, nb*BS, hkv, d]). Rows gathered through
+    padding entries hold garbage; the caller's valid mask hides them
+    (their gathered index exceeds every live position)."""
+    nb_, bs, hkv, d = k_pool.shape
+    s, nb = block_tables.shape
+    k = k_pool[block_tables].reshape(s, nb * bs, hkv, d)
+    v = v_pool[block_tables].reshape(s, nb * bs, hkv, d)
+    return k, v
+
+
+@KERNEL_REGISTRY.register("paged_attention", "xla_gather")
+def _paged_attend_xla(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    valid_mask,
+    *,
+    num_rep: int = 1,
+    scale: float,
+    sinks: Optional[jax.Array] = None,
+):
+    k_ctx, v_ctx = gather_block_kv(k_pool, v_pool, block_tables)
+    return cache_attend(
+        q, k_ctx, v_ctx, valid_mask, num_rep=num_rep, scale=scale, sinks=sinks
+    )
+
+
+def paged_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
+                 num_rep: int = 1, scale: float,
+                 sinks: Optional[jax.Array] = None):
+    """q [S,1,hq,d] + pool [NB,BS,hkv,d] + block_tables [S,nb] ->
+    [S,1,hq,d]. valid_mask [S,1,nb*BS] in gathered (== absolute) positions."""
+    inner = resolve_op("paged_attention")
+    return inner(
+        q, k_pool, v_pool, block_tables, valid_mask,
+        num_rep=num_rep, scale=scale, sinks=sinks,
+    )

@@ -1,0 +1,29 @@
+"""Continuous-batching inference engine with a paged KV cache.
+
+Layering: ``api`` (request/response dataclasses) -> ``kv_block_manager``
+(host block accounting) -> ``scheduler`` (admission/preemption policy) ->
+``engine`` (jitted prefill-into-blocks + batched paged decode). See
+``docs/serving.md`` for the architecture and the compile-count story.
+"""
+
+from veomni_tpu.serving.api import (
+    Request,
+    RequestOutput,
+    SamplingParams,
+    StreamEvent,
+)
+from veomni_tpu.serving.engine import EngineConfig, InferenceEngine
+from veomni_tpu.serving.kv_block_manager import KVBlockManager
+from veomni_tpu.serving.scheduler import Scheduler, SequenceState
+
+__all__ = [
+    "EngineConfig",
+    "InferenceEngine",
+    "KVBlockManager",
+    "Request",
+    "RequestOutput",
+    "SamplingParams",
+    "Scheduler",
+    "SequenceState",
+    "StreamEvent",
+]

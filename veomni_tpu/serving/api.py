@@ -1,0 +1,56 @@
+"""Request/response surface of the continuous-batching inference engine.
+
+Plain dataclasses over token ids — tokenization is the caller's concern
+(scripts/serve.py shows the CLI wiring). Sampling semantics mirror
+``models/decode.py``: temperature<=0 is greedy; top_k<=0 and top_p>=1 keep
+the full distribution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs (HF generate analogue). ``eos_id < 0``
+    disables early stopping; ``seed`` makes a sampled request reproducible
+    independent of what else shares its decode batch (per-slot PRNG keys)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_new_tokens: int = 64
+    eos_id: int = -1
+    seed: int = 0
+
+
+@dataclass
+class Request:
+    prompt_ids: List[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    request_id: str = ""  # engine assigns "req-N" when empty
+
+
+@dataclass
+class StreamEvent:
+    """One generated token, emitted as soon as its decode (or prefill) step
+    lands. ``index`` is the token's position in the request's generated
+    stream (0 = first token after the prompt)."""
+
+    request_id: str
+    token: int
+    index: int
+    finished: bool = False
+    finish_reason: str = ""  # "eos" | "length" when finished
+
+
+@dataclass
+class RequestOutput:
+    request_id: str
+    prompt_ids: List[int]
+    token_ids: List[int] = field(default_factory=list)
+    finished: bool = False
+    finish_reason: str = ""
+    ttft_s: Optional[float] = None  # wall time submit -> first token

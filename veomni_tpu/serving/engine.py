@@ -1,0 +1,370 @@
+"""In-process continuous-batching inference engine over a paged KV cache.
+
+Execution model (one ``step()`` tick):
+
+1. **Admit + prefill**: free slots are filled FIFO from the waiting queue;
+   each admission runs the *existing* jitted prefill from
+   ``models/decode.py`` over the power-of-two prompt bucket, scatters the
+   resulting contiguous cache into this sequence's pool blocks
+   (``scatter_prompt_cache``), and samples the first token — so prefill of
+   new arrivals interleaves with decode of running ones.
+2. **Capacity**: every running sequence is grown to cover its next write
+   position; when blocks run out the scheduler preempts LIFO (recompute).
+3. **Batched decode**: one jitted ``paged_decode_step`` over the fixed slot
+   batch — per-slot positions, block tables, PRNG keys and sampling params.
+   The gathered-context width (``nbb * block_size``, ``nbb`` the
+   power-of-two bucket of the widest running block table) is the only shape
+   that varies, so the compile count is bounded by the bucket count — never
+   by request count or arrival pattern (``TRACE_COUNTS["paged_decode"]``).
+
+Shapes the XLA programs see: slot batch ``S`` (static per engine), prompt
+buckets (power-of-two), context buckets (power-of-two blocks). Everything
+else — arrivals, lengths, finishes, preemptions — is host bookkeeping.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veomni_tpu.models import decode as decode_mod
+from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.models.decode import supports_cached_decode
+from veomni_tpu.serving.api import (
+    Request,
+    RequestOutput,
+    SamplingParams,
+    StreamEvent,
+)
+from veomni_tpu.serving.kv_block_manager import KVBlockManager
+from veomni_tpu.serving.scheduler import Scheduler, SequenceState
+from veomni_tpu.utils.helper import host_floats
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class EngineConfig:
+    """Static engine shape knobs (all become compile-time constants)."""
+
+    num_slots: int = 4  # decode batch width
+    block_size: int = 16  # cache positions per KV block (power of two)
+    max_model_len: int = 2048  # prompt + generated ceiling per request
+    num_blocks: int = 0  # 0 -> 1 + num_slots * blocks(max_model_len)
+    log_every_steps: int = 0  # 0 disables periodic metric logging
+
+    def __post_init__(self):
+        if self.block_size < 1 or (self.block_size & (self.block_size - 1)):
+            raise ValueError("block_size must be a power of two")
+        if self.num_blocks <= 0:
+            per_seq = -(-self.max_model_len // self.block_size)
+            self.num_blocks = 1 + self.num_slots * per_seq
+
+
+class InferenceEngine:
+    """Continuous-batching generation over a fixed slot batch.
+
+    ``submit()`` enqueues, ``step()`` advances every in-flight request by
+    one token, ``generate()`` streams events, ``run()`` drains to
+    completion. Single-threaded by design: callers own the pump loop."""
+
+    def __init__(self, params, cfg: TransformerConfig,
+                 config: Optional[EngineConfig] = None):
+        if not supports_cached_decode(cfg):
+            raise ValueError(
+                f"config {cfg.model_type!r} has no cached-decode path; the "
+                "serving engine requires supports_cached_decode(cfg)"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.config = config or EngineConfig()
+        ec = self.config
+
+        L = cfg.num_hidden_layers
+        shape = (L, ec.num_blocks, ec.block_size, cfg.num_key_value_heads,
+                 cfg.head_dim)
+        self.k_pool = jnp.zeros(shape, cfg.dtype)
+        self.v_pool = jnp.zeros(shape, cfg.dtype)
+        self.blocks = KVBlockManager(ec.num_blocks, ec.block_size)
+        self.scheduler = Scheduler(ec.num_slots, self.blocks)
+
+        # prefill is the SAME jitted program greedy_generate uses (shared
+        # prompt buckets, shared TRACE_COUNTS["prefill"])
+        self._prefill, _ = decode_mod._jitted(cfg)
+        self._scatter = jax.jit(
+            decode_mod.scatter_prompt_cache, donate_argnums=(0,)
+        )
+        self._sample = jax.jit(decode_mod.sample_tokens)
+        self._decode_step = self._build_decode_step()
+
+        self._outputs: Dict[str, RequestOutput] = {}
+        self._req_counter = 0
+        self._step_counter = 0
+        # metrics: TTFT accumulators + a decode-throughput window
+        self._ttft_sum = 0.0
+        self._ttft_n = 0
+        self._total_generated = 0
+        self._window_tokens = 0
+        self._window_t0 = time.perf_counter()
+
+    # ------------------------------------------------------------ jit plumbing
+    def _build_decode_step(self):
+        cfg = self.cfg
+
+        def impl(params, k_pool, v_pool, tables, positions, tokens, keys,
+                 temps, top_ks, top_ps):
+            decode_mod.TRACE_COUNTS["paged_decode"] += 1  # trace-time only
+            logits, (k_pool, v_pool) = decode_mod.paged_decode_step(
+                params, cfg, (k_pool, v_pool), tables, positions, tokens
+            )
+            # per-slot key split mirrors the scan decode's (carry, sample)
+            split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            nxt = decode_mod.sample_tokens(
+                logits, split[:, 1], temps, top_ks, top_ps
+            )
+            return nxt, split[:, 0], k_pool, v_pool
+
+        return jax.jit(impl, donate_argnums=(1, 2))
+
+    # ----------------------------------------------------------------- intake
+    def submit(self, request: Union[Request, Iterable[int]],
+               sampling: Optional[SamplingParams] = None) -> str:
+        """Enqueue a request (a ``Request`` or a bare prompt-id iterable).
+        Returns the request id; tokens arrive via ``step()`` events."""
+        if not isinstance(request, Request):
+            request = Request(prompt_ids=[int(t) for t in request],
+                              sampling=sampling or SamplingParams())
+        if not request.request_id:
+            # skip over user-supplied ids that happen to look like ours
+            while f"req-{self._req_counter}" in self._outputs:
+                self._req_counter += 1
+            request.request_id = f"req-{self._req_counter}"
+            self._req_counter += 1
+        if request.request_id in self._outputs:
+            raise ValueError(f"duplicate request id {request.request_id!r}")
+        if not request.prompt_ids:
+            raise ValueError("empty prompt")
+        sp = request.sampling
+        if sp.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(request.prompt_ids) + sp.max_new_tokens
+        if total > self.config.max_model_len:
+            raise ValueError(
+                f"prompt+max_new_tokens={total} exceeds max_model_len="
+                f"{self.config.max_model_len}"
+            )
+        if self.blocks.blocks_for(total) > self.config.num_blocks - 1:
+            raise ValueError(
+                f"request needs {self.blocks.blocks_for(total)} blocks; pool "
+                f"has {self.config.num_blocks - 1}"
+            )
+        seq = SequenceState(
+            request=request,
+            rng=np.asarray(jax.random.PRNGKey(sp.seed)),
+        )
+        self.scheduler.add(seq)
+        self._outputs[request.request_id] = RequestOutput(
+            request_id=request.request_id,
+            prompt_ids=list(request.prompt_ids),
+        )
+        return request.request_id
+
+    # ------------------------------------------------------------------ drive
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def step(self) -> List[StreamEvent]:
+        """One engine tick: admit+prefill, secure blocks, batched decode.
+        Returns every token event produced this tick."""
+        events: List[StreamEvent] = []
+        for seq in self.scheduler.admit():
+            events.extend(self._prefill_seq(seq))
+        self.scheduler.ensure_decode_capacity()
+        if self.scheduler.num_running:
+            events.extend(self._decode_tick())
+        elif not events and self.scheduler.has_work:
+            raise RuntimeError(
+                "scheduler stalled: waiting requests but nothing running "
+                "and nothing admissible (pool misconfigured?)"
+            )
+        self._step_counter += 1
+        le = self.config.log_every_steps
+        if le and self._step_counter % le == 0:
+            # non-resetting read: periodic logging must not clobber the
+            # throughput window of an external metrics() consumer
+            m = self.metrics(reset_window=False)
+            logger.info(
+                "serve step %d | %s", self._step_counter,
+                " ".join(f"{k}={v:.4g}" for k, v in sorted(m.items())),
+            )
+        return events
+
+    def generate(self, requests: Optional[Iterable] = None
+                 ) -> Iterator[StreamEvent]:
+        """Streaming interface: submit ``requests`` (if given), then yield
+        token events until all in-flight work drains. More requests may be
+        ``submit()``-ed between yields."""
+        for r in requests or ():
+            self.submit(r)
+        while self.has_work:
+            yield from self.step()
+
+    def run(self, requests: Optional[Iterable] = None
+            ) -> Dict[str, RequestOutput]:
+        """Drain ``generate()`` and return {request_id: RequestOutput} for
+        every finished request, handing ownership to the caller — retained
+        outputs are released so a long-running pump loop doesn't accumulate
+        one token list per request ever served."""
+        for _ in self.generate(requests):
+            pass
+        done = {rid: o for rid, o in self._outputs.items() if o.finished}
+        for rid in done:
+            del self._outputs[rid]
+        return done
+
+    def pop_output(self, request_id: str) -> Optional[RequestOutput]:
+        """Release and return one finished request's output (streaming
+        callers pop after seeing its finished event). Refuses while the
+        request is in flight — the engine still appends tokens to it."""
+        out = self._outputs.get(request_id)
+        if out is not None and not out.finished:
+            raise ValueError(f"request {request_id!r} is still in flight")
+        return self._outputs.pop(request_id, None)
+
+    # --------------------------------------------------------------- internals
+    def _prefill_seq(self, seq: SequenceState) -> List[StreamEvent]:
+        bs = self.config.block_size
+        prompt = seq.recompute_prompt
+        pt = len(prompt)
+        pb = decode_mod._bucket_pow2(pt, floor=max(16, bs))
+        tokens = jnp.zeros((1, pb), jnp.int32).at[0, :pt].set(
+            jnp.asarray(prompt, jnp.int32)
+        )
+        logits, caches = self._prefill(
+            self.params, tokens, jnp.int32(pt), pb, pb
+        )
+        # scatter the contiguous prompt cache into this sequence's blocks;
+        # tail entries past the real allocation point at the null block
+        ids = self.blocks.table(seq.seq_id)
+        ids = ids + [KVBlockManager.NULL_BLOCK] * (pb // bs - len(ids))
+        self.k_pool, self.v_pool = self._scatter(
+            (self.k_pool, self.v_pool), caches,
+            jnp.asarray(ids, jnp.int32),
+        )
+        sp = seq.request.sampling
+        rng, sub = jax.random.split(seq.rng)
+        seq.rng = np.asarray(rng)
+        first = int(self._sample(
+            logits.astype(jnp.float32), sub[None],
+            jnp.full((1,), sp.temperature, jnp.float32),
+            jnp.full((1,), sp.top_k, jnp.int32),
+            jnp.full((1,), sp.top_p, jnp.float32),
+        )[0])
+        if seq.first_token_time is None:
+            seq.first_token_time = time.perf_counter()
+            ttft = seq.first_token_time - seq.submit_time
+            self._outputs[seq.seq_id].ttft_s = ttft
+            self._ttft_sum += ttft
+            self._ttft_n += 1
+        seq.prefill_len = pt
+        seq.pos = pt  # the pending token's write position
+        return [self._emit(seq, first)]
+
+    def _decode_tick(self) -> List[StreamEvent]:
+        ec = self.config
+        bs = ec.block_size
+        running = self.scheduler.running()
+        # power-of-two bucket of the widest block table: the decode step's
+        # only varying shape, so compile count is O(log2 blocks-per-seq)
+        nbb = decode_mod._bucket_pow2(
+            max(self.blocks.num_allocated(s.seq_id) for _, s in running),
+            floor=1,
+        )
+        S = ec.num_slots
+        tables = np.zeros((S, nbb), np.int32)  # null-block padded
+        positions = np.zeros(S, np.int32)
+        tokens = np.zeros(S, np.int32)
+        keys = np.zeros((S, 2), np.uint32)
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.ones(S, np.float32)
+        for slot, seq in running:
+            tbl = self.blocks.table(seq.seq_id)
+            tables[slot, : len(tbl)] = tbl
+            positions[slot] = seq.pos
+            tokens[slot] = seq.last_token
+            keys[slot] = seq.rng
+            sp = seq.request.sampling
+            temps[slot] = sp.temperature
+            top_ks[slot] = sp.top_k
+            top_ps[slot] = sp.top_p
+
+        nxt, new_keys, self.k_pool, self.v_pool = self._decode_step(
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(tables), jnp.asarray(positions), jnp.asarray(tokens),
+            jnp.asarray(keys), jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+        )
+        nxt = np.asarray(nxt)
+        new_keys = np.asarray(new_keys)
+
+        events = []
+        for slot, seq in running:
+            seq.rng = new_keys[slot]
+            seq.pos += 1  # the freshly sampled token's write position
+            events.append(self._emit(seq, int(nxt[slot])))
+        return events
+
+    def _emit(self, seq: SequenceState, token: int) -> StreamEvent:
+        """Record a sampled token, finishing the request on eos/length."""
+        seq.generated.append(token)
+        self._window_tokens += 1
+        self._total_generated += 1
+        sp = seq.request.sampling
+        out = self._outputs[seq.seq_id]
+        out.token_ids.append(token)
+        finished = False
+        reason = ""
+        if sp.eos_id >= 0 and token == sp.eos_id:
+            finished, reason = True, "eos"
+        elif len(seq.generated) >= sp.max_new_tokens:
+            finished, reason = True, "length"
+        if finished:
+            self.scheduler.finish(seq)
+            out.finished = True
+            out.finish_reason = reason
+        return StreamEvent(
+            request_id=seq.seq_id, token=token,
+            index=len(seq.generated) - 1, finished=finished,
+            finish_reason=reason,
+        )
+
+    # ---------------------------------------------------------------- metrics
+    def metrics(self, reset_window: bool = True) -> Dict[str, float]:
+        """Host-float engine metrics; feed them straight into any
+        logger/meter sink. ``decode_tokens_per_sec`` is measured over the
+        window since the last resetting call (pass ``reset_window=False``
+        for a peek that leaves another consumer's window intact)."""
+        now = time.perf_counter()
+        dt = max(now - self._window_t0, 1e-9)
+        m = {
+            "queue_depth": float(self.scheduler.queue_depth),
+            "num_running": float(self.scheduler.num_running),
+            "block_utilization": self.blocks.utilization(),
+            "preemptions": float(self.scheduler.preemption_count),
+            "generated_tokens": float(self._total_generated),
+            "decode_tokens_per_sec": self._window_tokens / dt,
+        }
+        if self._ttft_n:
+            m["ttft_avg_s"] = self._ttft_sum / self._ttft_n
+        if reset_window:
+            self._window_tokens = 0
+            self._window_t0 = now
+        return host_floats(m)

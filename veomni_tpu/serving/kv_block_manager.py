@@ -1,0 +1,86 @@
+"""Paged KV-cache block accounting (host side).
+
+The device arrays — ``[L, num_blocks, block_size, hkv, d]`` pools — live in
+the engine; this manager owns the free list and the per-sequence block
+tables that index into them (vLLM's BlockSpaceManager reduced to what a
+single-host, recompute-preemption engine needs: alloc/grow/free plus
+utilization accounting; no copy-on-write forking).
+
+Block 0 is reserved as the **null block**: block tables handed to the
+device are padded with it past each sequence's allocation, and inactive
+decode slots write their garbage row into it, so every table entry is
+always a valid pool index and no program ever branches on table length.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List
+
+
+class KVBlockManager:
+    NULL_BLOCK = 0
+
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is the reserved null block)")
+        if block_size < 1 or (block_size & (block_size - 1)):
+            raise ValueError(f"block_size must be a power of two, got {block_size}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        # deque: freed blocks are reused FIFO, keeping allocation deterministic
+        self._free = deque(range(1, num_blocks))
+        self._tables: Dict[str, List[int]] = {}
+
+    # ---------------------------------------------------------------- queries
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def blocks_for(self, n_positions: int) -> int:
+        """Blocks needed to hold ``n_positions`` cache rows (>= 1)."""
+        return max(1, -(-int(n_positions) // self.block_size))
+
+    def can_allocate(self, n_blocks: int) -> bool:
+        return len(self._free) >= n_blocks
+
+    def num_allocated(self, seq_id: str) -> int:
+        return len(self._tables.get(seq_id, ()))
+
+    def table(self, seq_id: str) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def utilization(self) -> float:
+        """Fraction of allocatable (non-null) blocks in use."""
+        return self.num_used / max(1, self.num_blocks - 1)
+
+    # ------------------------------------------------------------- transitions
+    def allocate(self, seq_id: str, n_blocks: int) -> List[int]:
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already has blocks")
+        if not self.can_allocate(n_blocks):
+            raise RuntimeError(
+                f"out of KV blocks: need {n_blocks}, free {self.num_free}"
+            )
+        self._tables[seq_id] = [self._free.popleft() for _ in range(n_blocks)]
+        return self.table(seq_id)
+
+    def grow(self, seq_id: str, n_blocks: int = 1) -> List[int]:
+        if not self.can_allocate(n_blocks):
+            raise RuntimeError(
+                f"out of KV blocks: need {n_blocks}, free {self.num_free}"
+            )
+        self._tables[seq_id].extend(
+            self._free.popleft() for _ in range(n_blocks)
+        )
+        return self.table(seq_id)
+
+    def free_seq(self, seq_id: str) -> int:
+        """Return a sequence's blocks to the free list; count returned."""
+        blocks = self._tables.pop(seq_id, [])
+        self._free.extend(blocks)
+        return len(blocks)

@@ -1,0 +1,153 @@
+"""Continuous-batching scheduler: admission, slot assignment, preemption.
+
+Policy (vLLM-style, recompute preemption):
+
+- **FIFO admission with head-of-line blocking**: waiting requests are
+  admitted in arrival order into free decode slots whenever the block pool
+  can hold their (re)compute prompt plus one block of headroom. The head is
+  never skipped — out-of-order admission would make greedy outputs depend
+  on pool pressure, which would break token-parity guarantees.
+- **LIFO recompute preemption**: when a running sequence needs a block and
+  the pool is dry, the most recently admitted running sequence is evicted —
+  its blocks are freed and it is requeued at the FRONT of the waiting queue
+  with ``prompt + generated-so-far`` as its recompute prompt. Greedy
+  decoding is deterministic, so recompute resumes the exact token stream;
+  already-emitted tokens are never re-emitted.
+
+The scheduler is pure host bookkeeping — it owns no device state and is
+unit-testable without building a model.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, List, Optional, Tuple
+
+from veomni_tpu.serving.api import Request
+from veomni_tpu.serving.kv_block_manager import KVBlockManager
+
+
+@dataclass
+class SequenceState:
+    """Host-side runtime state of one request (survives preemption)."""
+
+    request: Request
+    generated: List[int] = field(default_factory=list)  # ALL emitted tokens
+    rng: Any = None  # per-request PRNG key carry [2] uint32
+    slot: int = -1
+    pos: int = 0  # write position of the pending last token
+    prefill_len: int = 0  # positions covered by the latest prefill
+    admit_order: int = -1
+    preemptions: int = 0
+    submit_time: float = field(default_factory=time.perf_counter)
+    first_token_time: Optional[float] = None
+
+    @property
+    def seq_id(self) -> str:
+        return self.request.request_id
+
+    @property
+    def recompute_prompt(self) -> List[int]:
+        """What a (re)admission must prefill: the original prompt plus every
+        token generated before preemption."""
+        return list(self.request.prompt_ids) + list(self.generated)
+
+    @property
+    def last_token(self) -> int:
+        return self.generated[-1]
+
+
+class Scheduler:
+    def __init__(self, num_slots: int, block_manager: KVBlockManager):
+        if num_slots < 1:
+            raise ValueError("need at least one decode slot")
+        self.blocks = block_manager
+        self.waiting: Deque[SequenceState] = deque()
+        self.slots: List[Optional[SequenceState]] = [None] * num_slots
+        self.preemption_count = 0
+        self._admit_counter = 0
+
+    # ---------------------------------------------------------------- queries
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.num_running > 0
+
+    def running(self) -> List[Tuple[int, SequenceState]]:
+        """(slot, seq) pairs in slot order — the decode batch row order."""
+        return [(i, s) for i, s in enumerate(self.slots) if s is not None]
+
+    # ------------------------------------------------------------ transitions
+    def add(self, seq: SequenceState) -> None:
+        self.waiting.append(seq)
+
+    def admit(self) -> List[SequenceState]:
+        """Fill free slots from the waiting queue (FIFO, head-of-line).
+        Admission allocates the recompute prompt's blocks and requires one
+        extra free block of headroom so a fresh admission isn't preempted on
+        its very first decode step just to grow someone else."""
+        admitted = []
+        for slot in range(len(self.slots)):
+            if self.slots[slot] is not None or not self.waiting:
+                continue
+            head = self.waiting[0]
+            n_blocks = self.blocks.blocks_for(len(head.recompute_prompt))
+            # no headroom demanded when the engine is idle: an exact-fit
+            # request must admit (it can still grow — the engine validates
+            # blocks_for(prompt+max_new) <= pool size at submit)
+            headroom = 1 if self.num_running else 0
+            if not self.blocks.can_allocate(n_blocks + headroom):
+                break  # head-of-line: never admit around the queue head
+            self.waiting.popleft()
+            self.blocks.allocate(head.seq_id, n_blocks)
+            head.slot = slot
+            head.admit_order = self._admit_counter
+            self._admit_counter += 1
+            self.slots[slot] = head
+            admitted.append(head)
+        return admitted
+
+    def ensure_decode_capacity(self) -> List[SequenceState]:
+        """Grow each running sequence to cover its next write position,
+        preempting (LIFO) when the pool runs dry. Returns the preempted
+        sequences (already requeued at the front of the waiting queue)."""
+        preempted: List[SequenceState] = []
+        for _, seq in self.running():
+            if seq.slot < 0:  # already preempted within this pass
+                continue
+            need = seq.pos // self.blocks.block_size + 1
+            while self.blocks.num_allocated(seq.seq_id) < need:
+                if self.blocks.can_allocate(1):
+                    self.blocks.grow(seq.seq_id, 1)
+                    continue
+                victim = max(
+                    (s for _, s in self.running()), key=lambda s: s.admit_order
+                )
+                self._preempt(victim)
+                preempted.append(victim)
+                if victim is seq:
+                    break
+        return preempted
+
+    def _preempt(self, seq: SequenceState) -> None:
+        self.blocks.free_seq(seq.seq_id)
+        self.slots[seq.slot] = None
+        seq.slot = -1
+        seq.preemptions += 1
+        self.preemption_count += 1
+        self.waiting.appendleft(seq)
+
+    def finish(self, seq: SequenceState) -> None:
+        self.blocks.free_seq(seq.seq_id)
+        if seq.slot >= 0:
+            self.slots[seq.slot] = None
+        seq.slot = -1

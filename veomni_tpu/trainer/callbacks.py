@@ -294,9 +294,9 @@ class WandbCallback(Callback):
     @staticmethod
     def _host_floats(metrics):
         # host scalars only: a device future here would block the async loop
-        return {
-            k: v for k, v in metrics.items() if isinstance(v, (int, float))
-        }
+        from veomni_tpu.utils.helper import host_floats
+
+        return host_floats(metrics)
 
     def on_step_end(self, trainer, state):
         if self._run is None:

@@ -84,6 +84,13 @@ class EnvironMeter:
         self.consumed_tokens = int(state.get("consumed_tokens", 0))
 
 
+def host_floats(metrics: Dict[str, Any]) -> Dict[str, float]:
+    """Keep only host-scalar metric values (drop device futures: fetching
+    one would block an async loop). Shared by WandbCallback and the serving
+    engine's metric surface."""
+    return {k: v for k, v in metrics.items() if isinstance(v, (int, float))}
+
+
 def set_seed(seed: int) -> "jax.Array":
     """Returns the root PRNG key; also seeds numpy/python for data pipeline."""
     import random
