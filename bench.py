@@ -13,33 +13,39 @@ micro-batch size / attention impl / remat policy in one process.
 import json
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-_done = threading.Event()
 
-
-def _watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_chip"):
+def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_chip"):
     """The axon TPU tunnel can wedge its chip claim (a killed process leaves
     the grant held), after which backend init hangs indefinitely. If the
     bench can't produce a measurement in time, emit an honest zero-valued
-    record pointing at the last measured numbers instead of hanging the
-    driver (see BENCH_NOTES.md). ``metric`` keeps the zero record in the
-    right bench series (train vs serve)."""
-    if _done.wait(timeout_s):
-        return
-    print(json.dumps({
-        "metric": metric,
-        "value": 0,
-        "unit": f"tokens/s — no measurement within {int(timeout_s)}s "
-                "(TPU init or run stalled); last good numbers in BENCH_NOTES.md",
-        "vs_baseline": 0,
-    }), flush=True)
-    os._exit(3)
+    record — now including the thread-stack dump showing WHERE it wedged —
+    instead of hanging the driver (see BENCH_NOTES.md). ``metric`` keeps the
+    zero record in the right bench series (train vs serve). Uses the shared
+    ``utils.helper.Watchdog`` (same stall detector as the train-loop
+    supervisor); caller must ``.stop()`` it before printing the real record
+    so the dog never races a measurement out of a block-buffered stdout via
+    its os._exit."""
+    from veomni_tpu.utils.helper import Watchdog
+
+    def on_stall(stack_dump: str):
+        print(json.dumps({
+            "metric": metric,
+            "value": 0,
+            "unit": f"tokens/s — no measurement within {int(timeout_s)}s "
+                    "(TPU init or run stalled); last good numbers in BENCH_NOTES.md",
+            "vs_baseline": 0,
+            "watchdog_stack_dump": stack_dump,
+        }), flush=True)
+
+    return Watchdog(
+        timeout_s, on_stall=on_stall, exit_code=3, description=f"bench ({metric})"
+    ).start()
 
 
 BENCH_PRESETS = {
@@ -300,7 +306,7 @@ def run_serve_bench(
     }
 
 
-def _serve_main(preset: str):
+def _serve_main(preset: str, watchdog=None):
     """BENCH_SERVE=1 entry: one JSON line for the serving trajectory."""
     lens = tuple(
         int(x) for x in
@@ -314,7 +320,8 @@ def _serve_main(preset: str):
         max_new_tokens=int(os.environ.get("BENCH_SERVE_NEW_TOKENS", 64)),
         preset=preset,
     )
-    _done.set()
+    if watchdog is not None:
+        watchdog.stop()
     print(json.dumps({
         "metric": "serve_decode_tokens_per_sec",
         "value": round(r["decode_tok_s"], 1),
@@ -333,20 +340,18 @@ def main():
 
     apply_performance_flags()
     serve = os.environ.get("BENCH_SERVE", "0") not in ("0", "")
-    threading.Thread(
-        target=_watchdog,
-        args=(float(os.environ.get("BENCH_WATCHDOG_S", 900)),
-              "serve_decode_tokens_per_sec" if serve
-              else "train_tokens_per_sec_per_chip"),
-        daemon=True,
-    ).start()
+    watchdog = _start_watchdog(
+        float(os.environ.get("BENCH_WATCHDOG_S", 900)),
+        "serve_decode_tokens_per_sec" if serve
+        else "train_tokens_per_sec_per_chip",
+    )
     preset = os.environ.get("BENCH_PRESET", "qwen3_0p6b")
     if preset not in BENCH_PRESETS:  # fail fast, BEFORE the chip claim
         raise SystemExit(
             f"unknown BENCH_PRESET {preset!r}; choose from {sorted(BENCH_PRESETS)}"
         )
     if serve:
-        return _serve_main(preset)
+        return _serve_main(preset, watchdog)
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))
     micro_bs = int(os.environ.get("BENCH_MICRO_BS", 4))
     steps = int(os.environ.get("BENCH_STEPS", 10))
@@ -367,7 +372,7 @@ def main():
         ulysses_async=os.environ.get("BENCH_ULYSSES_ASYNC", "0") not in ("0", ""),
         ulysses_async_chunks=int(os.environ.get("BENCH_ULYSSES_CHUNKS", 4)),
     )
-    _done.set()  # before printing: the watchdog must never race the
+    watchdog.stop()  # before printing: the watchdog must never race the
     # real record out of a block-buffered stdout via os._exit
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",

@@ -41,9 +41,11 @@ for m in pkgutil.walk_packages(veomni_tpu.__path__, "veomni_tpu."):
 if failures:
     print("FAILURES:" + ",".join(failures))
     sys.exit(1)
-# the serving package must be part of the walk (a missing __init__.py would
-# silently drop the whole subtree from this gate)
-for required in ("veomni_tpu.serving", "veomni_tpu.serving.engine"):
+# these packages must be part of the walk (a missing __init__.py would
+# silently drop a whole subtree from this gate)
+for required in ("veomni_tpu.serving", "veomni_tpu.serving.engine",
+                 "veomni_tpu.resilience", "veomni_tpu.resilience.faults",
+                 "veomni_tpu.resilience.retry", "veomni_tpu.resilience.supervisor"):
     if required not in visited:
         print("MISSING:" + required)
         sys.exit(1)

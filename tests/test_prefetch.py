@@ -67,3 +67,58 @@ def test_prefetcher_exhaustion_and_error():
 
     with pytest.raises(ValueError):
         BackgroundPrefetcher(_StatefulLoader(), depth=0)
+
+
+def test_prefetcher_worker_traceback_reaches_consumer():
+    """The consumer must see the worker's ORIGINAL frames (where the data
+    pipeline actually failed), not a bare sentinel/bare re-raise."""
+    import traceback
+
+    from veomni_tpu.data.prefetch import BackgroundPrefetcher
+
+    def deep_failure():
+        raise RuntimeError("shard corrupted")
+
+    class _Boom:
+        def __iter__(self):
+            yield {"x": np.zeros(1)}
+            deep_failure()
+
+    pf = BackgroundPrefetcher(_Boom(), depth=2)
+    it = iter(pf)
+    next(it)
+    with pytest.raises(RuntimeError, match="shard corrupted") as excinfo:
+        next(it)
+    frames = [f.name for f in traceback.extract_tb(excinfo.value.__traceback__)]
+    assert "deep_failure" in frames and "_worker" in frames
+    pf.close()
+
+
+def test_prefetcher_close_idempotent_and_wakes_blocked_consumer():
+    """close() is safe to call repeatedly (incl. from a signal handler) and
+    wakes a consumer blocked on an empty queue promptly."""
+    import threading
+    import time
+
+    from veomni_tpu.data.prefetch import BackgroundPrefetcher, PrefetcherClosed
+
+    release = threading.Event()
+
+    class _Stuck:
+        def __iter__(self):
+            yield {"x": np.zeros(1)}
+            release.wait(30.0)  # bounded: never wedges the test on failure
+            return
+            yield  # pragma: no cover
+
+    pf = BackgroundPrefetcher(_Stuck(), depth=1)
+    it = iter(pf)
+    next(it)
+    threading.Timer(0.3, pf.close).start()
+    t0 = time.monotonic()
+    with pytest.raises(PrefetcherClosed):
+        next(it)  # blocked on the empty queue when close() lands
+    assert time.monotonic() - t0 < 5.0
+    pf.close()  # idempotent
+    pf.close()
+    release.set()

@@ -1,13 +1,43 @@
-"""Adversarial resilience tests (VERDICT r4 weak #8): capacity-overflow
-TRAINING behavior and dataloader resume across a topology change."""
+"""Resilience subsystem tests.
+
+Adversarial training behavior (capacity overflow, topology-change resume)
+plus the ``veomni_tpu/resilience`` recovery paths, each driven by the
+deterministic fault-injection plan (``VEOMNI_FAULT_PLAN`` /
+``configure_faults``) under ``JAX_PLATFORMS=cpu``:
+
+* fault-plan grammar + hit-window arming;
+* device-side non-finite skip inside the jitted train step;
+* NaN-skip accounting, checkpoint rollback + bit-exact replay, abort budget;
+* checkpoint save/restore I/O faults survived within the retry budget, and
+  retry-exhaustion aborting the run;
+* async-save error surfacing/eviction at step boundaries;
+* streaming data-fetch faults absorbed by the retry layer;
+* hang watchdog firing on a stalled loop (bounded — no unbounded hang);
+* SIGTERM graceful final checkpoint + exit 0 + exact resume (subprocess);
+* SIGKILL mid-async-save crash consistency: resumed loss trajectory is
+  bit-exact vs an uninterrupted run (subprocess).
+"""
 
 import json
 import os
+import signal
+import subprocess
+import sys
+import time
 
 import numpy as np
 import pytest
 
 from veomni_tpu.arguments import VeOmniArguments
+
+
+@pytest.fixture(autouse=True)
+def _disarm_fault_plan():
+    yield
+    from veomni_tpu.resilience.faults import disarm_faults
+
+    disarm_faults()
+    os.environ.pop("VEOMNI_FAULT_PLAN", None)
 
 
 def _write_data(path, n=96, vocab=256, seed=0):
@@ -109,3 +139,598 @@ def test_resume_after_topology_change_warns_and_continues(tmp_path):
     assert ctl.global_step == 6
     assert np.isfinite(ctl.metrics["loss"])
     trainer2.checkpointer.close()
+
+
+# ---------------------------------------------------------------------------
+# shared helpers for the resilience-path tests (tiny DENSE model: these tests
+# run several full trains; the MoE toy above stays with its capacity test)
+# ---------------------------------------------------------------------------
+
+DENSE_TOY = {
+    "model_type": "qwen3", "vocab_size": 256, "hidden_size": 32,
+    "intermediate_size": 64, "num_hidden_layers": 2,
+    "num_attention_heads": 2, "num_key_value_heads": 2, "head_dim": 16,
+    "qk_norm": True,
+}
+
+
+def _dense_args(tmp_path, out_name="out", **train_overrides):
+    args = VeOmniArguments()
+    args.model.config_overrides = dict(DENSE_TOY)
+    args.data.train_path = str(tmp_path / "data.jsonl")
+    args.data.data_type = "pretokenized"
+    args.data.max_seq_len = 64
+    args.train.output_dir = str(tmp_path / out_name)
+    args.train.micro_batch_size = 2
+    args.train.train_steps = 4
+    args.train.lr = 1e-3
+    args.train.bf16 = False
+    args.train.async_save = False
+    args.train.save_hf_weights = False
+    args.train.log_steps = 1
+    args.train.resilience_retry_base_s = 0.001
+    for k, v in train_overrides.items():
+        setattr(args.train, k, v)
+    return args
+
+
+def _train_with_loss_log(args, data_path_writer=None):
+    """Run a TextTrainer recording the bit pattern of every synced loss;
+    returns (ctl, {step: loss_hex}, trainer)."""
+    from veomni_tpu.trainer import TextTrainer
+    from veomni_tpu.trainer.callbacks import Callback
+
+    trainer = TextTrainer(args)
+    losses = {}
+
+    class Rec(Callback):
+        def on_step_end(self, t, state):
+            if state.synced:
+                # replayed (post-rollback) steps overwrite: last wins
+                losses[state.global_step] = float(state.metrics["loss"]).hex()
+
+    trainer.callbacks.append(Rec())
+    ctl = trainer.train()
+    return ctl, losses, trainer
+
+
+def _tree_bits_equal(a, b):
+    import jax
+
+    la, ta = jax.tree.flatten(a)
+    lb, tb = jax.tree.flatten(b)
+    assert ta == tb
+    return all(np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+# ---------------------------------------------------------------------------
+# fault plan grammar + retry unit behavior
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_grammar_env_and_file(tmp_path):
+    from veomni_tpu.resilience import faults
+
+    os.environ["VEOMNI_FAULT_PLAN"] = json.dumps(
+        [{"point": "ckpt.save", "mode": "exception", "hit": 2, "times": 2,
+          "message": "boom"}]
+    )
+    assert faults.arm_from_env()
+    assert faults.fault_point("ckpt.save") is None          # hit 1: unarmed
+    for _ in range(2):                                       # hits 2-3 fire
+        with pytest.raises(faults.InjectedFault, match="boom"):
+            faults.fault_point("ckpt.save")
+    assert faults.fault_point("ckpt.save") is None           # hit 4: window past
+    assert [a.hit for a in faults.fired_faults()] == [2, 3]
+    # injected faults are OSErrors: the retry layer's default classification
+    assert issubclass(faults.InjectedFault, OSError)
+
+    # @file indirection + nan mode returns an action instead of raising
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps([{"point": "step.loss", "mode": "nan"}]))
+    os.environ["VEOMNI_FAULT_PLAN"] = "@" + str(plan_file)
+    assert faults.arm_from_env()
+    act = faults.fault_point("step.loss")
+    assert act is not None and act.mode == "nan" and act.hit == 1
+    assert faults.fault_point("step.loss") is None
+
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        faults.configure_faults([{"point": "x", "mode": "explode"}])
+    with pytest.raises(ValueError, match="missing 'point'"):
+        faults.configure_faults([{"mode": "nan"}])
+    faults.disarm_faults()
+    assert faults.fault_point("ckpt.save") is None
+    assert faults.fired_faults() == []
+
+
+def test_retry_deterministic_backoff_and_exhaustion():
+    from veomni_tpu.resilience.retry import RetryPolicy, retry_call
+
+    delays, calls = [], []
+
+    def flaky(fail_times):
+        calls.append(1)
+        if len(calls) <= fail_times:
+            raise OSError(f"transient {len(calls)}")
+        return "ok"
+
+    policy = RetryPolicy(retries=3, base_delay_s=0.5, max_delay_s=1.5)
+    assert retry_call(flaky, 2, policy=policy, sleep=delays.append) == "ok"
+    assert delays == [0.5, 1.0]  # deterministic: base * 2**attempt, no jitter
+    assert policy.delay(5) == 1.5  # capped
+
+    calls.clear()
+    with pytest.raises(OSError, match="transient 4"):  # original, not laundered
+        retry_call(flaky, 99, policy=policy, sleep=lambda _: None)
+    assert len(calls) == 4  # 1 + 3 retries
+
+    # non-I/O errors are NOT retried
+    def bug():
+        calls.append(1)
+        raise ValueError("schema mismatch")
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        retry_call(bug, policy=policy, sleep=lambda _: None)
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# device-side non-finite skip in the jitted train step
+# ---------------------------------------------------------------------------
+
+def test_train_step_device_side_skip(monkeypatch):
+    import jax.numpy as jnp
+    import optax
+
+    from veomni_tpu.train import build_train_state, build_train_step
+
+    # the test re-steps from the SAME state object; donation would delete it
+    monkeypatch.setenv("VEOMNI_DONATE_STATE", "0")
+
+    def loss_fn(params, micro):
+        loss = (params["w"] * micro["x"]).sum() * micro["scale"][0]
+        return loss, {"ntokens": jnp.int32(micro["x"].size)}
+
+    opt = optax.adam(0.1)
+    state0 = build_train_state({"w": jnp.ones((4,), jnp.float32)}, opt)
+    step = build_train_step(loss_fn, opt, None, skip_nonfinite=True)
+
+    def batch(scale):
+        return {"x": jnp.ones((1, 4), jnp.float32),
+                "scale": jnp.full((1, 1), scale, jnp.float32)}
+
+    bad_state, bad_metrics = step(state0, batch(float("nan")))
+    assert not bool(bad_metrics["step_ok"])
+    assert not np.isfinite(float(bad_metrics["loss"]))
+    # params AND optimizer state untouched by the non-finite update
+    assert _tree_bits_equal(bad_state.params, state0.params)
+    assert _tree_bits_equal(bad_state.opt_state, state0.opt_state)
+
+    good_state, good_metrics = step(state0, batch(1.0))
+    assert bool(good_metrics["step_ok"])
+    assert not _tree_bits_equal(good_state.params, state0.params)
+
+    # ungated build: the same bad batch poisons params (documents the knob)
+    step_raw = build_train_step(loss_fn, opt, None, skip_nonfinite=False)
+    raw_state, raw_metrics = step_raw(state0, batch(float("nan")))
+    assert not bool(raw_metrics["step_ok"])  # flag still reported
+    assert not np.isfinite(np.asarray(raw_state.params["w"])).all()
+
+
+# ---------------------------------------------------------------------------
+# supervisor escalation: NaN-skip, rollback + bit-exact replay, abort
+# ---------------------------------------------------------------------------
+
+def test_nan_skip_counts_anomaly_and_completes(tmp_path):
+    from veomni_tpu.resilience.faults import configure_faults
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _dense_args(tmp_path, resilience_rollback_after=10)
+    configure_faults([{"point": "step.loss", "mode": "nan", "hit": 2}])
+    ctl, losses, trainer = _train_with_loss_log(args)
+    trainer.checkpointer.close()
+    assert ctl.global_step == 4
+    assert ctl.resilience["anomalies"] == 1
+    assert ctl.resilience["anomaly_steps"] == [2]
+    assert ctl.resilience["rollbacks"] == 0
+    assert all(np.isfinite(float.fromhex(h)) for h in losses.values())
+
+
+def test_rollback_replays_bit_exact(tmp_path):
+    """Two consecutive anomalies at steps 4-5 -> rollback to the step-4
+    checkpoint, cursor-exact iterator replay; the final params and the
+    replayed per-step losses must be BIT-identical to an uninterrupted run."""
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+    from veomni_tpu.resilience.faults import configure_faults
+
+    _write_data(tmp_path / "data.jsonl")
+
+    ctl_a, losses_a, trainer_a = _train_with_loss_log(
+        _dense_args(tmp_path, "clean", train_steps=6, save_steps=2)
+    )
+    import jax
+
+    ref_params = jax.tree.map(np.asarray, trainer_a.train_state.params)
+    trainer_a.checkpointer.close()
+    destroy_parallel_state()
+
+    configure_faults([{"point": "step.loss", "mode": "nan", "hit": 4, "times": 2}])
+    ctl_b, losses_b, trainer_b = _train_with_loss_log(
+        _dense_args(tmp_path, "faulty", train_steps=6, save_steps=2,
+                    resilience_rollback_after=2)
+    )
+    assert ctl_b.global_step == 6
+    assert ctl_b.resilience["rollbacks"] == 1
+    assert ctl_b.resilience["anomalies"] == 2
+    assert ctl_b.resilience["anomaly_steps"] == [4, 5]
+    assert _tree_bits_equal(
+        ref_params, jax.tree.map(np.asarray, trainer_b.train_state.params)
+    )
+    assert losses_a == losses_b  # incl. replayed steps 5-6 (last-wins)
+    trainer_b.checkpointer.close()
+
+
+def test_rollback_without_checkpoint_is_impossible(tmp_path):
+    from veomni_tpu.resilience import RollbackImpossible
+    from veomni_tpu.resilience.faults import configure_faults
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _dense_args(tmp_path, save_steps=0, resilience_rollback_after=2)
+    configure_faults([{"point": "step.loss", "mode": "nan", "hit": 2, "times": 2}])
+    with pytest.raises(RollbackImpossible):
+        _train_with_loss_log(args)
+
+
+def test_anomaly_budget_aborts(tmp_path):
+    from veomni_tpu.resilience import AnomalyBudgetExceeded
+    from veomni_tpu.resilience.faults import configure_faults
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _dense_args(tmp_path, train_steps=8, resilience_anomaly_budget=2,
+                       resilience_rollback_after=10)
+    configure_faults([{"point": "step.loss", "mode": "nan", "hit": 2, "times": 6}])
+    with pytest.raises(AnomalyBudgetExceeded):
+        _train_with_loss_log(args)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint I/O faults: retried saves/restores, exhaustion, async eviction
+# ---------------------------------------------------------------------------
+
+def test_ckpt_save_fault_survived_within_retry_budget(tmp_path):
+    from veomni_tpu.resilience.faults import configure_faults, fired_faults
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _dense_args(tmp_path, save_steps=2, resilience_io_retries=3)
+    configure_faults([{"point": "ckpt.save", "mode": "exception", "hit": 1,
+                       "times": 2}])
+    ctl, losses, trainer = _train_with_loss_log(args)
+    trainer.checkpointer.close()
+    assert ctl.global_step == 4
+    assert len(fired_faults()) == 2  # two failed attempts, third succeeded
+    ckpts = trainer.checkpointer.list_steps()
+    assert ckpts == [2, 4]
+
+
+def test_ckpt_save_retry_exhaustion_aborts_run(tmp_path):
+    from veomni_tpu.resilience.faults import InjectedFault, configure_faults
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _dense_args(tmp_path, save_steps=2, resilience_io_retries=1)
+    configure_faults([{"point": "ckpt.save", "mode": "exception", "times": 20}])
+    with pytest.raises(InjectedFault):
+        _train_with_loss_log(args)
+
+
+def test_ckpt_restore_fault_survived_within_retry_budget(tmp_path):
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+    from veomni_tpu.resilience.faults import configure_faults, fired_faults
+    from veomni_tpu.trainer import TextTrainer
+
+    _write_data(tmp_path / "data.jsonl")
+    ctl, _, trainer = _train_with_loss_log(_dense_args(tmp_path, save_steps=2))
+    trainer.checkpointer.close()
+    destroy_parallel_state()
+
+    configure_faults([{"point": "ckpt.restore", "mode": "exception", "hit": 1}])
+    trainer2 = TextTrainer(_dense_args(tmp_path))
+    restored, extra = trainer2.try_resume()
+    assert restored and int(extra["global_step"]) == 4
+    assert len(fired_faults()) == 1
+    trainer2.checkpointer.close()
+
+
+def test_async_save_error_surfaced_and_evicted(tmp_path):
+    """check_for_errors-style probe at the step boundary: a failed async
+    commit raises at wait(), and the step leaves the dedupe set so a later
+    save() re-dispatches instead of silently skipping."""
+    import jax.numpy as jnp
+
+    from veomni_tpu.checkpoint import build_checkpointer
+
+    ck = build_checkpointer(str(tmp_path / "ck"), async_save=True)
+    state = {"w": jnp.arange(4, dtype=jnp.float32)}
+    ck.save(1, state, extra_state={"global_step": 1})
+    ck.wait()
+    assert ck.list_steps() == [1]
+
+    # simulate an async commit failure of a dispatched step 2
+    ck._saved_steps.add(2)
+    ck._inflight_step = 2
+    ck._ckptr.check_for_errors = lambda: (_ for _ in ()).throw(IOError("commit failed"))
+    with pytest.raises(IOError, match="commit failed"):
+        ck.wait()
+    assert 2 not in ck._saved_steps  # evicted: not silently lost
+
+    del ck._ckptr.check_for_errors  # commit thread healthy again
+    ck.save(2, state, extra_state={"global_step": 2})  # NOT dedupe-skipped
+    ck.wait()
+    assert ck.list_steps() == [1, 2]
+    ck.close()
+
+
+def test_extra_state_precedes_payload_commit(tmp_path):
+    """The train_state dir rename is the commit marker; the JSON sidecars a
+    committed checkpoint needs must already be on disk when it appears —
+    a crash can never yield a committed step missing its cursor metadata."""
+    import jax.numpy as jnp
+
+    from veomni_tpu.checkpoint import Checkpointer
+    from veomni_tpu.resilience.faults import InjectedFault, configure_faults
+
+    ck = Checkpointer(str(tmp_path / "ck"), async_save=False, io_retries=0)
+    configure_faults([{"point": "ckpt.save", "mode": "exception"}])
+    with pytest.raises(InjectedFault):
+        ck.save(3, {"w": jnp.zeros(2)}, extra_state={"global_step": 3},
+                rank_state={"dataloader": {"cursor": 7}})
+    step_dir = tmp_path / "ck" / "global_step_3"
+    assert (step_dir / "extra_state.json").exists()
+    assert (step_dir / "extra_state_rank0.json").exists()
+    assert not (step_dir / "train_state").exists()
+    assert ck.list_steps() == []  # uncommitted: invisible to resume
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# data-fetch faults: streaming retry + watchdog on a stalled loop
+# ---------------------------------------------------------------------------
+
+def test_streaming_fetch_fault_survived_by_retry(tmp_path):
+    from veomni_tpu.data.streaming import StreamingShardDataset
+    from veomni_tpu.resilience.faults import configure_faults, fired_faults
+
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    rows = [{"i": i} for i in range(10)]
+    with open(shard_dir / "00.jsonl", "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+    configure_faults([{"point": "data.fetch", "mode": "exception", "hit": 3,
+                       "times": 2}])
+    ds = StreamingShardDataset(str(shard_dir), shuffle=False, retry_base_s=0.001)
+    got = [r["i"] for r in ds]
+    assert got == list(range(10))  # nothing dropped, order preserved
+    assert len(fired_faults()) == 2
+
+
+def test_watchdog_fires_on_stalled_loop_and_run_completes(tmp_path):
+    """A bounded hang at data.fetch stalls the loop past the watchdog
+    deadline: stacks are dumped (stall counted) but the run still finishes —
+    no unbounded hang, no spurious kill."""
+    from veomni_tpu.resilience.faults import configure_faults
+
+    _write_data(tmp_path / "data.jsonl")
+    args = _dense_args(tmp_path, resilience_watchdog_s=0.25, prefetch_depth=1)
+    # the LAST fetch of the run: nothing queued behind it hides the stall
+    configure_faults([{"point": "data.fetch", "mode": "hang", "hit": 4,
+                       "seconds": 1.5}])
+    ctl, losses, trainer = _train_with_loss_log(args)
+    trainer.checkpointer.close()
+    assert ctl.global_step == 4
+    assert ctl.resilience["watchdog_stalls"] >= 1
+
+
+def test_watchdog_unit_dump_names_threads():
+    from veomni_tpu.utils.helper import Watchdog
+
+    dumps = []
+    wd = Watchdog(0.1, on_stall=dumps.append, description="unit").start()
+    try:
+        time.sleep(0.35)
+    finally:
+        wd.stop()
+    assert wd.stall_count >= 1 and dumps
+    assert "MainThread" in dumps[0] and "test_watchdog_unit" in dumps[0]
+    # petting resets the deadline
+    wd2 = Watchdog(0.25, on_stall=dumps.append).start()
+    try:
+        for _ in range(4):
+            time.sleep(0.1)
+            wd2.pet()
+        assert wd2.stall_count == 0
+    finally:
+        wd2.stop()
+
+
+# ---------------------------------------------------------------------------
+# real-process preemption/crash tests (subprocess: signals need a process)
+# ---------------------------------------------------------------------------
+
+_DRIVER = """\
+import json, os, sys, time
+
+cfg = json.load(open(sys.argv[1]))
+sys.path.insert(0, cfg["repo"])
+
+from veomni_tpu.arguments import VeOmniArguments
+from veomni_tpu.trainer import TextTrainer
+from veomni_tpu.trainer.callbacks import Callback
+
+args = VeOmniArguments()
+args.model.config_overrides = cfg["toy"]
+args.data.train_path = cfg["data"]
+args.data.data_type = "pretokenized"
+args.data.max_seq_len = 64
+t = args.train
+t.output_dir = cfg["out"]
+t.micro_batch_size = 2
+t.train_steps = cfg["train_steps"]
+t.save_steps = cfg.get("save_steps", 0)
+t.async_save = cfg.get("async_save", False)
+t.lr = 1e-3
+t.bf16 = False
+t.save_hf_weights = False
+t.log_steps = 1
+
+trainer = TextTrainer(args)
+
+
+class Rec(Callback):
+    def on_step_end(self, tr, state):
+        if state.synced:
+            with open(cfg["loss_log"], "a") as f:
+                f.write(json.dumps({
+                    "step": state.global_step,
+                    "loss_hex": float(state.metrics["loss"]).hex(),
+                }) + "\\n")
+        # AFTER CheckpointCallback in the list: by marker time the step's
+        # save has been dispatched
+        if cfg.get("marker_at") and state.global_step == cfg["marker_at"]:
+            with open(cfg["marker"], "w") as f:
+                f.write(str(state.global_step))
+        if cfg.get("step_sleep"):
+            time.sleep(cfg["step_sleep"])
+
+
+trainer.callbacks.append(Rec())
+ctl = trainer.train()
+trainer.checkpointer.close()
+with open(cfg["result"], "w") as f:
+    json.dump({"global_step": ctl.global_step, "preempted": ctl.preempted,
+               "resilience": ctl.resilience}, f)
+"""
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_driver(tmp_path, cfg):
+    driver = tmp_path / "driver.py"
+    driver.write_text(_DRIVER)
+    cfg_path = tmp_path / f"cfg_{os.path.basename(cfg['loss_log'])}.json"
+    cfg_path.write_text(json.dumps(cfg))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", VEOMNI_LOG_LEVEL="WARNING")
+    env.pop("VEOMNI_FAULT_PLAN", None)
+    return subprocess.Popen(
+        [sys.executable, str(driver), str(cfg_path)],
+        env=env, cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _base_cfg(tmp_path, out_name, loss_log, **over):
+    cfg = {
+        "repo": _REPO,
+        "toy": DENSE_TOY,
+        "data": str(tmp_path / "data.jsonl"),
+        "out": str(tmp_path / out_name),
+        "loss_log": str(tmp_path / loss_log),
+        "result": str(tmp_path / (loss_log + ".result.json")),
+        "marker": str(tmp_path / (loss_log + ".marker")),
+        "train_steps": 8,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _wait_for(path, proc, timeout=180.0):
+    t0 = time.monotonic()
+    while not os.path.exists(path):
+        if proc.poll() is not None:
+            out, err = proc.communicate()
+            raise AssertionError(
+                f"driver exited rc={proc.returncode} before {path}:\n{err[-2000:]}"
+            )
+        if time.monotonic() - t0 > timeout:
+            proc.kill()
+            raise AssertionError(f"timed out waiting for {path}")
+        time.sleep(0.05)
+
+
+def _read_losses(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            out[rec["step"]] = rec["loss_hex"]  # replayed steps: last wins
+    return out
+
+
+def test_sigterm_graceful_checkpoint_exit0_and_resume(tmp_path):
+    """SIGTERM mid-run: the loop finishes the in-flight step, takes one
+    final synchronous checkpoint, and exits 0; a restart resumes from
+    exactly that step."""
+    _write_data(tmp_path / "data.jsonl")
+    cfg = _base_cfg(tmp_path, "out", "leg1.jsonl",
+                    train_steps=60, step_sleep=0.15, marker_at=2)
+    proc = _spawn_driver(tmp_path, cfg)
+    _wait_for(cfg["marker"], proc)
+    proc.send_signal(signal.SIGTERM)
+    out, err = proc.communicate(timeout=180)
+    assert proc.returncode == 0, f"expected clean exit, rc={proc.returncode}:\n{err[-2000:]}"
+
+    result = json.load(open(cfg["result"]))
+    stopped_at = result["global_step"]
+    assert result["preempted"] and 2 <= stopped_at < 60
+    step_dir = os.path.join(cfg["out"], "checkpoints", f"global_step_{stopped_at}")
+    assert os.path.isdir(os.path.join(step_dir, "train_state"))  # committed
+    assert os.path.exists(os.path.join(step_dir, "extra_state.json"))
+
+    # restart: auto-resume picks up at stopped_at and continues
+    cfg2 = _base_cfg(tmp_path, "out", "leg2.jsonl", train_steps=stopped_at + 2)
+    proc2 = _spawn_driver(tmp_path, cfg2)
+    out, err = proc2.communicate(timeout=300)
+    assert proc2.returncode == 0, err[-2000:]
+    result2 = json.load(open(cfg2["result"]))
+    assert not result2["preempted"] and result2["global_step"] == stopped_at + 2
+    leg2 = _read_losses(cfg2["loss_log"])
+    assert min(leg2) == stopped_at + 1  # no step re-run, none skipped
+
+
+def test_sigkill_mid_async_save_resume_bit_exact(tmp_path):
+    """Crash consistency: SIGKILL the trainer right as the step-4 async save
+    is in flight, restart, and the resumed loss trajectory must be BIT-exact
+    vs an uninterrupted run — whether the kill landed before or after the
+    async commit (uncommitted debris is cleaned, committed state resumes)."""
+    _write_data(tmp_path / "data.jsonl")
+
+    ref_cfg = _base_cfg(tmp_path, "ref_out", "ref.jsonl",
+                        save_steps=4, async_save=True)
+    proc = _spawn_driver(tmp_path, ref_cfg)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+    ref = _read_losses(ref_cfg["loss_log"])
+    assert sorted(ref) == list(range(1, 9))
+
+    kill_cfg = _base_cfg(tmp_path, "kill_out", "kill1.jsonl",
+                         save_steps=4, async_save=True, marker_at=4)
+    proc = _spawn_driver(tmp_path, kill_cfg)
+    _wait_for(kill_cfg["marker"], proc)
+    proc.kill()  # SIGKILL: no handlers, no cleanup — a real crash
+    proc.communicate(timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+    assert not os.path.exists(kill_cfg["result"])
+
+    resume_cfg = _base_cfg(tmp_path, "kill_out", "kill2.jsonl",
+                           save_steps=4, async_save=True)
+    proc = _spawn_driver(tmp_path, resume_cfg)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+    result = json.load(open(resume_cfg["result"]))
+    assert result["global_step"] == 8
+    leg2 = _read_losses(resume_cfg["loss_log"])
+    assert max(leg2) == 8
+    for step, hexloss in leg2.items():
+        assert ref[step] == hexloss, (
+            f"step {step}: resumed loss {hexloss} != uninterrupted {ref[step]}"
+        )

@@ -139,6 +139,24 @@ class TrainingArguments:
     # input pipeline: host batches assembled this many steps ahead on a
     # worker thread (reference BackgroundPrefetcher); 0 = synchronous
     prefetch_depth: int = 2
+    # resilience (veomni_tpu/resilience/): anomaly supervision + recovery.
+    # Device-side gate: a step with non-finite loss/grad leaves params and
+    # optimizer state untouched (exact no-op for finite steps)
+    resilience_skip_nonfinite: bool = True
+    # total anomalous steps tolerated before the run aborts loudly
+    resilience_anomaly_budget: int = 8
+    # consecutive anomalies that trigger rollback to the latest committed
+    # checkpoint (restoring the rank-local data cursor + replaying)
+    resilience_rollback_after: int = 3
+    # rollbacks tolerated before escalating to abort
+    resilience_max_rollbacks: int = 2
+    # retry budget for checkpoint save/restore I/O (extra attempts after the
+    # first; deterministic exponential backoff, no jitter)
+    resilience_io_retries: int = 3
+    resilience_retry_base_s: float = 0.05
+    # train-loop stall watchdog: dump all thread stacks if no step completes
+    # within this many seconds (0 = disabled)
+    resilience_watchdog_s: float = 0.0
     # observability. log_steps is also the host<->device sync cadence: the
     # loop only fetches metrics (blocking on the device) every log_steps —
     # default 10 so the async loop's lazy sync is ON out of the box (a

@@ -22,6 +22,8 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from veomni_tpu.resilience.faults import fault_point
+from veomni_tpu.resilience.retry import RetryPolicy, retry_call
 from veomni_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -30,13 +32,26 @@ _STEP_RE = re.compile(r"^global_step_(\d+)$")
 
 
 class Checkpointer:
-    """save/load of {train_state, extra_state} under ckpt_dir/global_step_N."""
+    """save/load of {train_state, extra_state} under ckpt_dir/global_step_N.
 
-    def __init__(self, ckpt_dir: str, *, async_save: bool = True, max_to_keep: int = 0):
+    I/O resilience: every save/restore dispatch runs under a bounded
+    deterministic-backoff retry (``io_retries``/``retry_base_s``), with
+    ``ckpt.save``/``ckpt.restore`` fault points inside each attempt so the
+    whole path is exercisable from a ``VEOMNI_FAULT_PLAN``. Async-save
+    commit errors are probed at the next step boundary (``save()``/``wait()``)
+    and the failed step is EVICTED from the dedupe set, so a later save of
+    that step re-dispatches instead of being silently lost.
+    """
+
+    def __init__(self, ckpt_dir: str, *, async_save: bool = True, max_to_keep: int = 0,
+                 io_retries: int = 3, retry_base_s: float = 0.05):
         self.ckpt_dir = os.path.abspath(ckpt_dir)
         os.makedirs(self.ckpt_dir, exist_ok=True)
         self.async_save = async_save
         self.max_to_keep = max_to_keep
+        self._retry_policy = RetryPolicy(retries=io_retries, base_delay_s=retry_base_s)
+        self._saved_steps: set = set()
+        self._inflight_step: Optional[int] = None
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         # startup is the only moment no save can be in flight anywhere, so
         # clear crashed-save debris here (never during save(): a lagging host
@@ -62,23 +77,43 @@ class Checkpointer:
                         shutil.rmtree(os.path.join(step_dir, sub), ignore_errors=True)
 
     # ------------------------------------------------------------------ save
-    def save(self, step: int, train_state, extra_state: Optional[Dict[str, Any]] = None,
-             rank_state: Optional[Dict[str, Any]] = None):
-        path = os.path.join(self.ckpt_dir, f"global_step_{step}", "train_state")
-        # in-memory dedupe: async saves only materialize the dir at commit, so
-        # isdir alone would race an in-flight save of the same step
-        if step in getattr(self, "_saved_steps", set()):
-            logger.info_rank0("checkpoint for step %d already dispatched; skipping", step)
-            return
-        if os.path.isdir(path):
-            logger.info_rank0("checkpoint for step %d already exists; skipping", step)
-            return
-        self._saved_steps = getattr(self, "_saved_steps", set()) | {step}
-        self._ckptr.wait_until_finished()  # serialize with any in-flight save
-        self._ckptr.save(path, args=ocp.args.StandardSave(train_state))
-        if not self.async_save:
-            self._ckptr.wait_until_finished()
-        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
+    def check_for_errors(self) -> Optional[BaseException]:
+        """Step-boundary probe of the async commit thread. On failure the
+        in-flight step is evicted from the dedupe set (so a later ``save()``
+        of that step re-dispatches instead of silently skipping) and the
+        error is returned for the caller to surface or absorb."""
+        probe = getattr(self._ckptr, "check_for_errors", None)
+        if probe is None:
+            return None
+        try:
+            probe()
+        except Exception as e:
+            self._evict_inflight(e)
+            return e
+        return None
+
+    def _evict_inflight(self, err: BaseException) -> None:
+        if self._inflight_step is not None:
+            self._saved_steps.discard(self._inflight_step)
+            logger.error(
+                "async checkpoint save of step %d FAILED: %s; step evicted — "
+                "the next save() of it will retry", self._inflight_step, err,
+            )
+            self._inflight_step = None
+
+    def _dispatch_save(self, path: str, train_state, step_dir: str,
+                       extra_state, rank_state) -> None:
+        """One save attempt (the retried unit): sidecar JSONs, then the
+        payload dispatch. The JSON writes sit INSIDE the unit so a transient
+        shared-fs error there is retried like any other I/O (re-writing them
+        is idempotent), and BEFORE the payload so the atomic ``train_state``
+        rename can never commit a checkpoint missing its cursor metadata.
+        The serialization wait on the PREVIOUS async save lives in save(),
+        outside this unit: a previous commit's failure must evict ITS step,
+        not be retried away as a transient fault of this one. The sync-mode
+        wait stays inside — that failure IS this step's, and re-dispatching
+        is the right retry."""
+        os.makedirs(step_dir, exist_ok=True)
         if extra_state is not None and jax.process_index() == 0:
             with open(os.path.join(step_dir, "extra_state.json"), "w") as f:
                 json.dump(extra_state, f)
@@ -86,15 +121,61 @@ class Checkpointer:
             # per-process state (dataloader cursor + packing carry-over is
             # rank-local data!): every process writes its own file — restoring
             # rank 0's buffer everywhere would feed all ranks rank-0's samples
-            os.makedirs(step_dir, exist_ok=True)
             fname = f"extra_state_rank{jax.process_index()}.json"
             with open(os.path.join(step_dir, fname), "w") as f:
                 json.dump(rank_state, f)
+        fault_point("ckpt.save")
+        self._ckptr.save(path, args=ocp.args.StandardSave(train_state))
+        if not self.async_save:
+            self._ckptr.wait_until_finished()
+
+    def save(self, step: int, train_state, extra_state: Optional[Dict[str, Any]] = None,
+             rank_state: Optional[Dict[str, Any]] = None):
+        # surface a failed PREVIOUS async save now (and evict its step) —
+        # never inside the jitted loop, only at this step boundary
+        self.check_for_errors()
+        path = os.path.join(self.ckpt_dir, f"global_step_{step}", "train_state")
+        # in-memory dedupe: async saves only materialize the dir at commit, so
+        # isdir alone would race an in-flight save of the same step
+        if step in self._saved_steps:
+            logger.info_rank0("checkpoint for step %d already dispatched; skipping", step)
+            return
+        if os.path.isdir(path):
+            logger.info_rank0("checkpoint for step %d already exists; skipping", step)
+            return
+        # serialize with any in-flight save BEFORE the retried dispatch: if
+        # the previous async commit failed, the error raises here, belongs to
+        # the previous step, and must evict that step — not be swallowed by
+        # this step's retry loop
+        try:
+            self._ckptr.wait_until_finished()
+        except Exception as e:
+            self._evict_inflight(e)
+        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
+        retry_call(
+            self._dispatch_save, path, train_state, step_dir,
+            extra_state, rank_state,
+            policy=self._retry_policy,
+            description=f"checkpoint save (step {step})",
+        )
+        # dedupe only records a SUCCESSFUL dispatch (on failure the raise
+        # above leaves the set untouched, so a later attempt of this step —
+        # e.g. the train-end final save — isn't silently skipped)
+        self._saved_steps.add(step)
+        self._inflight_step = step if self.async_save else None
         logger.info_rank0("checkpoint save dispatched: step %d -> %s", step, path)
         self._prune()
 
     def wait(self):
-        self._ckptr.wait_until_finished()
+        try:
+            self._ckptr.wait_until_finished()
+        except Exception as e:
+            self._evict_inflight(e)
+            raise
+        err = self.check_for_errors()
+        if err is not None:
+            raise err
+        self._inflight_step = None
 
     def _prune(self):
         if not self.max_to_keep:
@@ -112,6 +193,13 @@ class Checkpointer:
             shutil.rmtree(os.path.join(self.ckpt_dir, f"global_step_{s}"), ignore_errors=True)
 
     # ------------------------------------------------------------------ load
+    def _dispatch_restore(self, path: str, abstract_state):
+        """One restore attempt (the retried unit). Transient shared-fs
+        failures retry here; a CORRUPT checkpoint keeps failing and falls
+        through to ``load()``'s walk-back over earlier committed steps."""
+        fault_point("ckpt.restore")
+        return self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract_state))
+
     def _is_committed(self, step: int) -> bool:
         """True iff the step's train_state payload finished committing.
 
@@ -160,7 +248,11 @@ class Checkpointer:
         self.wait()
         step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
         path = os.path.join(step_dir, "train_state")
-        restored = self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract_state))
+        restored = retry_call(
+            self._dispatch_restore, path, abstract_state,
+            policy=self._retry_policy,
+            description=f"checkpoint restore (step {step})",
+        )
         extra = None
         extra_path = os.path.join(step_dir, "extra_state.json")
         if os.path.exists(extra_path):

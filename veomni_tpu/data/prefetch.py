@@ -13,6 +13,12 @@ the last batch the *trainer consumed*, not the last one the thread pulled
 those batches on resume). ``state_dict()`` therefore returns the snapshot
 captured right after the consumed batch was pulled from the underlying
 loader.
+
+Failure contract (resilience subsystem): a worker-thread exception is
+re-raised to the consumer WITH the worker's original traceback (the frames
+that actually failed — not a bare sentinel ending iteration); ``close()`` is
+idempotent and signal-handler-safe, and a consumer blocked on the queue wakes
+with :class:`PrefetcherClosed` instead of absorbing a preemption deadline.
 """
 
 from __future__ import annotations
@@ -21,7 +27,15 @@ import queue
 import threading
 from typing import Any, Dict, Iterator, Optional
 
+from veomni_tpu.resilience.faults import fault_point
+
 _SENTINEL = object()
+
+
+class PrefetcherClosed(RuntimeError):
+    """Raised to a consumer blocked on / arriving after ``close()`` (the
+    graceful-shutdown signal handler closes the prefetcher to unblock the
+    train loop)."""
 
 
 class BackgroundPrefetcher:
@@ -38,11 +52,14 @@ class BackgroundPrefetcher:
         self.dataloader = dataloader
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._closed = False
         self._consumed_state: Optional[Dict[str, Any]] = (
             dataloader.state_dict() if hasattr(dataloader, "state_dict") else None
         )
         self._finished: Optional[BaseException | type] = None
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(
+            target=self._worker, name="veomni-prefetch", daemon=True
+        )
         self._thread.start()
 
     def _put(self, item) -> bool:
@@ -57,7 +74,15 @@ class BackgroundPrefetcher:
 
     def _worker(self):
         try:
-            for batch in self.dataloader:
+            it = iter(self.dataloader)
+            while True:
+                # deterministic injection site for the whole host data path
+                # (any dataloader type, not just streaming shards)
+                fault_point("data.fetch")
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    break
                 snap = (
                     self.dataloader.state_dict()
                     if hasattr(self.dataloader, "state_dict")
@@ -77,10 +102,23 @@ class BackgroundPrefetcher:
             if self._finished is not StopIteration:
                 raise self._finished
             raise StopIteration
-        batch, snap, err = self._queue.get()
+        while True:
+            if self._closed:
+                raise PrefetcherClosed("prefetcher closed while awaiting a batch")
+            try:
+                # bounded wait, NOT a bare get(): a signal handler that runs
+                # while the main thread is blocked here can only set flags —
+                # the timeout is what turns the flag into a wakeup
+                batch, snap, err = self._queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                continue
         if batch is _SENTINEL:
             self._finished = err if err is not None else StopIteration
             if err is not None:
+                # re-raising the worker's exception object keeps its
+                # __traceback__ — the consumer sees the worker-side frames
+                # where the data pipeline actually failed
                 raise err
             raise StopIteration
         self._consumed_state = snap
@@ -96,6 +134,11 @@ class BackgroundPrefetcher:
         )
 
     def close(self):
+        """Idempotent; safe to call from a signal handler (flag sets + a
+        non-blocking drain; the join is bounded)."""
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         # unblock a worker stuck on put() by draining one slot
         try:

@@ -33,6 +33,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from veomni_tpu.data.dataset import DATASET_REGISTRY
+from veomni_tpu.resilience.faults import fault_point
+from veomni_tpu.resilience.retry import RetryPolicy, retry_call
 from veomni_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -141,6 +143,12 @@ class _TarShard:
         return sample
 
 
+def _read_record(reader, rec: int) -> Dict[str, Any]:
+    """One fetch attempt (the retried unit; exceptions carry reader.path)."""
+    fault_point("data.fetch")
+    return reader.read(rec)
+
+
 def _open_shard(path: str):
     if path.endswith(".jsonl"):
         return _JsonlShard(path)
@@ -168,8 +176,14 @@ class StreamingShardDataset:
         shuffle: bool = True,
         dp_rank: int = 0,
         dp_size: int = 1,
+        io_retries: int = 3,
+        retry_base_s: float = 0.05,
         **_,
     ):
+        # streaming corpora live on shared/remote filesystems where reads
+        # fail transiently; shard opens + record fetches retry with
+        # deterministic backoff (and carry the data.fetch fault point)
+        self._retry_policy = RetryPolicy(retries=io_retries, base_delay_s=retry_base_s)
         if os.path.isdir(path):
             shards = sorted(
                 os.path.join(path, f) for f in os.listdir(path)
@@ -200,9 +214,20 @@ class StreamingShardDataset:
     def _reader(self, shard: str):
         r = self._readers.get(shard)
         if r is None:
-            r = self._readers[shard] = _open_shard(shard)
+            r = self._readers[shard] = retry_call(
+                _open_shard, shard, policy=self._retry_policy,
+                description=f"open shard {os.path.basename(shard)}",
+            )
             self._lens[shard] = len(r)
         return r
+
+    def _fetch(self, reader, rec: int) -> Dict[str, Any]:
+        """One record fetch: fault-injectable, retried. No per-call closure
+        or eager description string — this is the innermost loader loop, and
+        retry_call's qualname fallback only materializes on failure."""
+        return retry_call(
+            _read_record, reader, rec, policy=self._retry_policy,
+        )
 
     def _shard_len(self, shard: str) -> int:
         if shard not in self._lens:
@@ -237,7 +262,7 @@ class StreamingShardDataset:
             order = self._rec_order(shard, self._epoch)
             reader = self._reader(shard)
             while self._rec_pos < len(order):
-                row = reader.read(int(order[self._rec_pos]))
+                row = self._fetch(reader, int(order[self._rec_pos]))
                 self._rec_pos += 1
                 yield self.transform(row) if self.transform else row
             self._rec_pos = 0
@@ -278,5 +303,5 @@ class StreamingShardDataset:
         if idx < 0 or idx >= b[-1]:
             raise IndexError(idx)
         si = int(np.searchsorted(b, idx, side="right") - 1)
-        row = self._reader(self.shards[si]).read(idx - int(b[si]))
+        row = self._fetch(self._reader(self.shards[si]), idx - int(b[si]))
         return self.transform(row) if self.transform else row

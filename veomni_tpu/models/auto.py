@@ -9,6 +9,8 @@ checkpoint converters.
 
 from __future__ import annotations
 
+import os
+
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
@@ -529,7 +531,21 @@ def build_foundation_model(
 
 
 def build_tokenizer(path: str):
-    """HF tokenizer passthrough (reference models/auto.py:41)."""
+    """HF tokenizer passthrough (reference models/auto.py:41).
+
+    Local checkpoint dirs live on shared filesystems whose reads fail
+    transiently — those retry with the same bounded deterministic backoff as
+    the other I/O edges (resilience/retry.py). Hub-id loads do NOT retry:
+    transformers raises plain OSError for PERMANENT errors too (unknown
+    model id, gated repo), and retrying those burns round-trips while
+    masking the real message."""
     from transformers import AutoTokenizer
 
+    if os.path.isdir(path):
+        from veomni_tpu.resilience.retry import retry_call
+
+        return retry_call(
+            AutoTokenizer.from_pretrained, path, trust_remote_code=True,
+            description=f"tokenizer load {path}",
+        )
     return AutoTokenizer.from_pretrained(path, trust_remote_code=True)

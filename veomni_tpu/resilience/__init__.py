@@ -1,0 +1,49 @@
+"""Resilience subsystem: survive-and-resume as a first-class, *testable* layer.
+
+Large multi-host TPU runs treat preemption, flaky shared filesystems, and
+loss blow-ups as routine (cf. "Scalable Training of Language Models using
+JAX pjit and TPUv4", PAPERS.md). This package supplies the three legs:
+
+* ``faults``     — deterministic fault injection (``VEOMNI_FAULT_PLAN``) so
+                   every recovery path below is exercisable on CPU in tier-1;
+* ``retry``      — bounded deterministic-backoff retry for checkpoint and
+                   data-fetch I/O;
+* ``supervisor`` — train-loop anomaly supervision (device-side finite-loss
+                   flag -> skip-step -> checkpoint rollback -> abort), a hang
+                   watchdog, and SIGTERM/preemption-safe graceful shutdown.
+"""
+
+from veomni_tpu.resilience.faults import (
+    FaultAction,
+    InjectedFault,
+    arm_from_env,
+    configure_faults,
+    disarm_faults,
+    fault_point,
+    fired_faults,
+)
+from veomni_tpu.resilience.retry import RetryPolicy, retry_call
+from veomni_tpu.resilience.supervisor import (
+    AnomalyBudgetExceeded,
+    GracefulShutdown,
+    RollbackImpossible,
+    SupervisorPolicy,
+    TrainSupervisor,
+)
+
+__all__ = [
+    "AnomalyBudgetExceeded",
+    "FaultAction",
+    "GracefulShutdown",
+    "InjectedFault",
+    "RetryPolicy",
+    "RollbackImpossible",
+    "SupervisorPolicy",
+    "TrainSupervisor",
+    "arm_from_env",
+    "configure_faults",
+    "disarm_faults",
+    "fault_point",
+    "fired_faults",
+    "retry_call",
+]

@@ -1,0 +1,211 @@
+"""Deterministic fault injection: named fault points armed from a JSON plan.
+
+The recovery machinery (retry, rollback, watchdog, graceful shutdown) is only
+trustworthy if every path can be *driven* deterministically on CPU in tier-1 —
+real preemptions and flaky filesystems don't show up on demand. A fault plan
+names a point, a 1-based hit index, and an action; the instrumented sites call
+``fault_point(name)`` which is a no-op (one ``is None`` check) when unarmed.
+
+Fault points wired through the stack:
+
+==============  ==============================================================
+``ckpt.save``   inside the checkpointer's per-attempt save dispatch (retried)
+``ckpt.restore``inside the checkpointer's per-attempt restore (retried)
+``data.fetch``  streaming shard record reads (retried, fires per attempt)
+                AND the prefetch worker's per-batch pull (NOT retried: an
+                exception there exercises the worker->consumer error
+                transport and fails the run fast). With streaming+prefetch
+                both active the two sites share one hit counter.
+``step.loss``   host-side observation of the train step's finite-loss flag
+==============  ==============================================================
+
+Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
+
+.. code-block:: json
+
+    [{"point": "ckpt.save", "mode": "exception", "hit": 2, "times": 3},
+     {"point": "step.loss", "mode": "nan", "hit": 4},
+     {"point": "data.fetch", "mode": "hang", "hit": 1, "seconds": 2.0}]
+
+* ``point``   (required) fault-point name;
+* ``mode``    ``exception`` (default; raises :class:`InjectedFault`, an
+  ``OSError`` so the retry layer treats it as I/O), ``nan`` (returns a
+  :class:`FaultAction` the site applies — poisons the observed loss signal),
+  ``hang`` (sleeps ``seconds`` — bounded, so a watchdog test can't wedge CI);
+* ``hit``     1-based hit index at which the fault starts firing (default 1);
+* ``times``   consecutive hits that fire from ``hit`` on (default 1);
+* ``seconds`` hang duration (default 30);
+* ``message`` exception text override.
+
+Hit counters are per point and shared across specs targeting the same point,
+so "fail hits 2-4" composes with "hang hit 7" on one point deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+ENV_PLAN = "VEOMNI_FAULT_PLAN"
+
+KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "data.fetch", "step.loss")
+
+_MODES = ("exception", "nan", "hang")
+
+
+class InjectedFault(OSError):
+    """Raised by an armed ``exception``-mode fault point.
+
+    Subclasses ``OSError`` so the retry layer's default I/O classification
+    covers it — the injected failure exercises exactly the real-I/O path.
+    """
+
+
+@dataclass
+class FaultAction:
+    """What an armed fault point decided for this hit (returned for modes the
+    call site must apply itself, i.e. ``nan``)."""
+
+    point: str
+    mode: str
+    hit: int
+
+
+@dataclass
+class _FaultSpec:
+    point: str
+    mode: str = "exception"
+    hit: int = 1
+    times: int = 1
+    seconds: float = 30.0
+    message: str = ""
+
+    def covers(self, hit: int) -> bool:
+        return self.hit <= hit < self.hit + self.times
+
+
+@dataclass
+class _FaultPlan:
+    specs: List[_FaultSpec]
+    hits: Dict[str, int] = field(default_factory=dict)
+    fired: List[FaultAction] = field(default_factory=list)
+
+
+_PLAN: Optional[_FaultPlan] = None
+
+
+def _parse_specs(raw: Any) -> List[_FaultSpec]:
+    if isinstance(raw, dict):  # {"plan": [...]} wrapper tolerated
+        raw = raw.get("plan", [])
+    if not isinstance(raw, list):
+        raise ValueError(f"fault plan must be a JSON list, got {type(raw).__name__}")
+    specs = []
+    for entry in raw:
+        if not isinstance(entry, dict):
+            raise ValueError(f"fault-plan entry must be an object: {entry!r}")
+        point = entry.get("point")
+        if not point:
+            raise ValueError(f"fault-plan entry missing 'point': {entry!r}")
+        mode = entry.get("mode", "exception")
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; choose from {_MODES}")
+        if mode == "nan" and point != "step.loss":
+            # only the supervisor's step.loss observation interprets "nan";
+            # anywhere else the returned action is ignored, yet it would log
+            # "fault injected" — a drill that believes it tested something
+            raise ValueError(
+                f"mode 'nan' only applies to point 'step.loss', not {point!r}"
+            )
+        if point not in KNOWN_POINTS:
+            # warn, don't reject (plans may target points added later) — but
+            # a typo'd name would otherwise arm a drill that tests nothing
+            logger.warning_rank0(
+                "fault plan targets unknown point %r (known: %s) — it will "
+                "never fire unless code calls fault_point(%r)",
+                point, ", ".join(KNOWN_POINTS), point,
+            )
+        specs.append(_FaultSpec(
+            point=point, mode=mode,
+            hit=int(entry.get("hit", 1)),
+            times=int(entry.get("times", 1)),
+            seconds=float(entry.get("seconds", 30.0)),
+            message=str(entry.get("message", "")),
+        ))
+    return specs
+
+
+def configure_faults(plan: Any) -> None:
+    """Arm a plan programmatically (tests); ``plan`` is the parsed-JSON list
+    (or ``{"plan": [...]}``), or a JSON string."""
+    global _PLAN
+    if isinstance(plan, str):
+        plan = json.loads(plan)
+    specs = _parse_specs(plan)
+    _PLAN = _FaultPlan(specs=specs) if specs else None
+    if _PLAN is not None:
+        logger.warning_rank0(
+            "FAULT INJECTION ARMED: %d spec(s) across points %s",
+            len(specs), sorted({s.point for s in specs}),
+        )
+
+
+def arm_from_env() -> bool:
+    """Arm from ``VEOMNI_FAULT_PLAN`` (JSON text or ``@file``). Returns True
+    if a plan was armed. Called by the trainer at train start and by the
+    checkpointer/data layers lazily via :func:`fault_point` staying unarmed."""
+    raw = os.environ.get(ENV_PLAN, "")
+    if not raw:
+        return False
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    configure_faults(json.loads(raw))
+    return _PLAN is not None
+
+
+def disarm_faults() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def fired_faults() -> List[FaultAction]:
+    """History of fired actions (telemetry/assertions); empty when unarmed."""
+    return list(_PLAN.fired) if _PLAN is not None else []
+
+
+def fault_point(name: str) -> Optional[FaultAction]:
+    """Instrumentation hook. Unarmed: one None-check, zero overhead.
+
+    Armed: bumps the point's hit counter; if a spec covers this hit, applies
+    the action — ``exception`` raises :class:`InjectedFault`, ``hang`` sleeps
+    (bounded) then returns the action, ``nan`` returns the action for the
+    call site to apply. Returns None when nothing fired.
+    """
+    plan = _PLAN
+    if plan is None:
+        return None
+    hit = plan.hits.get(name, 0) + 1
+    plan.hits[name] = hit
+    for spec in plan.specs:
+        if spec.point != name or not spec.covers(hit):
+            continue
+        action = FaultAction(point=name, mode=spec.mode, hit=hit)
+        plan.fired.append(action)
+        logger.warning_rank0(
+            "fault injected: point=%s mode=%s hit=%d", name, spec.mode, hit
+        )
+        if spec.mode == "exception":
+            raise InjectedFault(
+                spec.message or f"injected fault at {name} (hit {hit})"
+            )
+        if spec.mode == "hang":
+            time.sleep(spec.seconds)
+        return action
+    return None

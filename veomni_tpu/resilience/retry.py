@@ -1,0 +1,72 @@
+"""Bounded retry with deterministic exponential backoff.
+
+Shared-filesystem checkpoint I/O and streaming/HF data fetches fail
+transiently at multi-host scale; the policy here is deliberately boring —
+``base * 2**attempt`` capped, NO jitter — so a fault-plan test can predict
+exactly how many attempts a budget buys and the whole recovery path stays
+reproducible. On exhaustion the ORIGINAL exception is re-raised (callers'
+except-clauses keep working; the retry layer never launders error types).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Tuple, Type
+
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# OSError covers shared-fs hiccups, TensorStore I/O wrappers that subclass
+# it, network timeouts (socket.timeout = TimeoutError = an OSError), and the
+# fault layer's InjectedFault. ValueError/TypeError etc. are NOT retried:
+# a schema mismatch won't fix itself and retrying masks the real bug.
+DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError, IOError)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """``retries`` = extra attempts after the first (total = retries + 1)."""
+
+    retries: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON
+
+    def delay(self, attempt: int) -> float:
+        return min(self.base_delay_s * (2.0 ** attempt), self.max_delay_s)
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    *args: Any,
+    policy: RetryPolicy = RetryPolicy(),
+    description: str = "",
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs: Any,
+) -> Any:
+    """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
+
+    Each failed attempt logs at warning with the remaining budget; exhaustion
+    logs at error and re-raises the last exception unchanged.
+    """
+    what = description or getattr(fn, "__qualname__", repr(fn))
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            if attempt >= policy.retries:
+                logger.error(
+                    "%s: retry budget exhausted after %d attempt(s): %s",
+                    what, attempt + 1, e,
+                )
+                raise
+            delay = policy.delay(attempt)
+            attempt += 1
+            logger.warning(
+                "%s failed (attempt %d/%d): %s; retrying in %.3gs",
+                what, attempt, policy.retries + 1, e, delay,
+            )
+            sleep(delay)

@@ -1,0 +1,230 @@
+"""Train-loop supervision: anomaly escalation + preemption-safe shutdown.
+
+The loop in ``trainer/base.py`` dispatches steps asynchronously and only
+syncs with the device on the log cadence; a loss blow-up must be caught
+WITHOUT adding host syncs. The train step therefore computes a device-side
+``step_ok`` flag (finite loss AND finite grad norm — see
+``train/train_step.py``) and, when ``resilience_skip_nonfinite`` is on,
+already refuses to apply a non-finite update on device. The supervisor rides
+the loop's existing in-flight drain (the dispatch-depth bound): each step's
+``(loss, step_ok)`` futures are queued, and only entries popped beyond the
+depth — or on a sync step, where the host blocks anyway — are fetched.
+
+Escalation policy per observed anomaly:
+
+1. **skip**     — the device already skipped the update; count and log.
+2. **rollback** — after ``rollback_after`` CONSECUTIVE anomalies, restore the
+   latest committed checkpoint (params + optimizer + rank-local dataloader
+   cursor) and replay the iterator from there.
+3. **abort**    — when total anomalies exceed ``anomaly_budget`` or rollbacks
+   exceed ``max_rollbacks``, raise :class:`AnomalyBudgetExceeded`: the blow-up
+   is systemic (deterministic replay will reproduce a data-driven NaN), and
+   burning cluster time is worse than dying loudly.
+
+:class:`GracefulShutdown` handles SIGTERM preemption: the handler only sets a
+flag (and unblocks a prefetch-blocked consumer); the loop notices at the next
+step boundary, takes one final synchronous checkpoint via the normal
+``on_train_end`` path, and returns so the process exits 0 — the cluster
+restart then resumes bit-exactly.
+"""
+
+from __future__ import annotations
+
+import signal
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veomni_tpu.resilience.faults import fault_point
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class AnomalyBudgetExceeded(RuntimeError):
+    """Training aborted: anomalous steps exceeded the configured budget."""
+
+
+class RollbackImpossible(RuntimeError):
+    """Rollback was requested but no committed checkpoint exists."""
+
+
+_SEVERITY = {"ok": 0, "skip": 1, "rollback": 2, "abort": 3}
+
+
+def worse_verdict(a: str, b: str) -> str:
+    return a if _SEVERITY[a] >= _SEVERITY[b] else b
+
+
+@dataclass(frozen=True)
+class SupervisorPolicy:
+    skip_nonfinite: bool = True
+    anomaly_budget: int = 8
+    rollback_after: int = 3
+    max_rollbacks: int = 2
+    # matches the loop's historical dispatch-depth bound: at most this many
+    # un-inspected steps in flight before the oldest loss is fetched
+    inflight_depth: int = 4
+    watchdog_s: float = 0.0
+
+    @classmethod
+    def from_train_args(cls, t) -> "SupervisorPolicy":
+        return cls(
+            skip_nonfinite=t.resilience_skip_nonfinite,
+            anomaly_budget=t.resilience_anomaly_budget,
+            rollback_after=t.resilience_rollback_after,
+            max_rollbacks=t.resilience_max_rollbacks,
+            watchdog_s=t.resilience_watchdog_s,
+        )
+
+
+class TrainSupervisor:
+    """Observes per-step metrics futures and returns an escalation verdict:
+    ``"ok" | "skip" | "rollback" | "abort"`` (the trainer acts on the last
+    two). Fetches a host value only where the loop already would."""
+
+    def __init__(self, policy: SupervisorPolicy):
+        self.policy = policy
+        # (global_step, loss_future, ok_future, injected)
+        self._inflight: Deque[Tuple[int, Any, Any, bool]] = deque()
+        self.anomalies = 0
+        self.consecutive = 0
+        # first global_step of the CURRENT consecutive anomaly run: the
+        # rollback target must be a checkpoint committed BEFORE it, or the
+        # "restore and replay" contract degenerates to a no-op rewind
+        self.consec_start: Optional[int] = None
+        self.rollbacks = 0
+        self.stalls = 0
+        self.anomaly_steps: List[int] = []
+
+    # ---------------------------------------------------------- observation
+    def observe(self, step: int, metrics: Dict[str, Any]) -> str:
+        """Queue this step's signals; inspect whatever the dispatch-depth
+        bound pops. ``step.loss`` fault injection poisons the OBSERVED flag
+        here (host-side, deterministic) — the device-side skip path has its
+        own unit coverage with a genuinely non-finite loss."""
+        act = fault_point("step.loss")
+        injected = act is not None and act.mode == "nan"
+        self._inflight.append(
+            (step, metrics.get("loss"), metrics.get("step_ok"), injected)
+        )
+        verdict = "ok"
+        while len(self._inflight) > self.policy.inflight_depth:
+            verdict = worse_verdict(verdict, self._check(self._inflight.popleft()))
+            if _SEVERITY[verdict] >= _SEVERITY["rollback"]:
+                break  # the rest of the queue belongs to a doomed trajectory
+        return verdict
+
+    def drain(self) -> str:
+        """Inspect every queued entry (sync steps — the host is blocked on
+        the device anyway — and end of train)."""
+        verdict = "ok"
+        while self._inflight:
+            verdict = worse_verdict(verdict, self._check(self._inflight.popleft()))
+            if _SEVERITY[verdict] >= _SEVERITY["rollback"]:
+                break
+        return verdict
+
+    def _check(self, entry: Tuple[int, Any, Any, bool]) -> str:
+        step, loss, ok, injected = entry
+        anomalous = injected
+        if not anomalous and ok is not None:
+            anomalous = not bool(np.asarray(ok))
+        if not anomalous and loss is not None:
+            anomalous = not np.isfinite(float(np.asarray(loss)))
+        if not anomalous:
+            self.consecutive = 0
+            self.consec_start = None
+            return "ok"
+        self.anomalies += 1
+        self.consecutive += 1
+        if self.consecutive == 1:
+            self.consec_start = step
+        self.anomaly_steps.append(step)
+        logger.warning_rank0(
+            "anomalous step %d (non-finite loss/grad%s): %d consecutive, "
+            "%d/%d total",
+            step, " [injected]" if injected else "",
+            self.consecutive, self.anomalies, self.policy.anomaly_budget,
+        )
+        if self.anomalies > self.policy.anomaly_budget:
+            return "abort"
+        if self.consecutive >= self.policy.rollback_after:
+            if self.rollbacks >= self.policy.max_rollbacks:
+                return "abort"
+            return "rollback"
+        return "skip"
+
+    # ------------------------------------------------------------ lifecycle
+    def note_rollback(self, to_step: int) -> None:
+        self.rollbacks += 1
+        self.consecutive = 0
+        self.consec_start = None
+        self._inflight.clear()  # futures from the abandoned trajectory
+        logger.warning_rank0(
+            "rolled back to checkpoint step %d (rollback %d/%d)",
+            to_step, self.rollbacks, self.policy.max_rollbacks,
+        )
+
+    def note_stall(self, stack_dump: str) -> None:
+        self.stalls += 1
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "anomalies": self.anomalies,
+            "anomaly_steps": list(self.anomaly_steps),
+            "rollbacks": self.rollbacks,
+            "watchdog_stalls": self.stalls,
+        }
+
+
+class GracefulShutdown:
+    """Context manager installing SIGTERM (by default) handlers that request
+    a graceful stop instead of dying mid-step.
+
+    The handler body is signal-safe-minimal: set a flag, log, and invoke
+    ``on_request`` (the trainer passes an idempotent prefetcher close, so a
+    consumer blocked on the prefetch queue wakes up instead of absorbing the
+    preemption deadline). Handler installation is a no-op off the main
+    thread (Python restriction) — nested/threaded test trainers still work,
+    they just don't get signal coverage.
+    """
+
+    def __init__(self, signals=None,
+                 on_request: Optional[Callable[[], None]] = None):
+        self.signals = tuple(signals) if signals else (signal.SIGTERM,)
+        self.on_request = on_request
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+
+    def _handler(self, signum, frame):
+        self.requested = True
+        self.signum = signum
+        logger.warning_rank0(
+            "received signal %d: requesting graceful stop (final checkpoint "
+            "at the next step boundary)", signum,
+        )
+        if self.on_request is not None:
+            try:
+                self.on_request()
+            except Exception:
+                pass
+
+    def __enter__(self) -> "GracefulShutdown":
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handler)
+            except ValueError:  # not the main thread
+                break
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev = {}

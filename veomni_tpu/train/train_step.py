@@ -69,6 +69,7 @@ def build_train_step(
     batch_shardings: Optional[Any] = None,
     max_grad_norm: float = 1.0,
     grad_mask: Optional[Any] = None,
+    skip_nonfinite: bool = False,
 ) -> Callable:
     """Returns jitted ``train_step(state, batch) -> (state, metrics)``.
 
@@ -80,6 +81,13 @@ def build_train_step(
     grads are zeroed BEFORE the global-norm clip, so they neither shrink the
     trainable params' clip budget nor pollute the grad_norm metric
     (reference freeze semantics exclude params from optimization entirely).
+
+    Metrics always include ``step_ok`` — a device-side finite-loss/finite-
+    grad flag the resilience supervisor fetches with the loop's existing
+    in-flight drain (no extra host syncs). With ``skip_nonfinite`` the
+    update itself is gated on that flag ON DEVICE: a blown-up step leaves
+    params/opt_state untouched (the ``where`` select is exact, so finite
+    steps are bitwise-identical to the ungated program).
     """
 
     def grads_one_micro(params, micro):
@@ -131,11 +139,22 @@ def build_train_step(
             grads = jax.tree.map(lambda g: g * scale, grads)
         updates, new_opt = optimizer.update(grads, state.opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        # grad_norm is NaN/Inf whenever ANY grad leaf is (sqrt-of-sum-of-
+        # squares propagates), so loss+grad_norm finiteness covers the tree
+        step_ok = jnp.isfinite(loss_sum) & jnp.isfinite(grad_norm)
+        if skip_nonfinite:
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(step_ok, n, o), new_params, params
+            )
+            new_opt = jax.tree.map(
+                lambda n, o: jnp.where(step_ok, n, o), new_opt, state.opt_state
+            )
         new_state = TrainState(params=new_params, opt_state=new_opt, step=state.step + 1)
         metrics = {
             "loss": loss_sum / denom,
             "grad_norm": grad_norm,
             "ntokens": ntokens,
+            "step_ok": step_ok,
             # auxiliary scalar metrics from the loss fn (e.g. dpo_acc),
             # averaged over micro-steps
             **extras,

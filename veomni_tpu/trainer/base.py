@@ -402,6 +402,7 @@ class BaseTrainer:
             batch_shardings=self.batch_shardings,
             max_grad_norm=t.max_grad_norm,
             grad_mask=grad_mask,
+            skip_nonfinite=t.resilience_skip_nonfinite,
         )
         self._loss_fn = loss_fn  # forward-only reuse (evaluate)
         self.meter = EnvironMeter(
@@ -413,6 +414,8 @@ class BaseTrainer:
             ckpt_manager=t.ckpt_manager,
             async_save=t.async_save,
             max_to_keep=t.max_ckpt_to_keep,
+            io_retries=t.resilience_io_retries,
+            retry_base_s=t.resilience_retry_base_s,
         )
 
     def _inner_loss_fn(self, model):
@@ -472,12 +475,16 @@ class BaseTrainer:
         return {k: P(None, ps.dp_axes, ps.sp_axes) for k in keys}
 
     # ----------------------------------------------------------------- resume
-    def try_resume(self):
+    def try_resume(self, step: Optional[int] = None):
+        """``step=None`` walks back from the latest committed checkpoint;
+        an explicit step pins the restore (supervisor rollback targets a
+        checkpoint from BEFORE the anomalous window)."""
         restored, extra = self.checkpointer.load(
             jax.tree.map(
                 lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
                 self.abstract_state, self.state_shardings,
-            )
+            ),
+            step=step,
         )
         if restored is not None:
             # normalize on-device layouts to what a fresh jit would produce:
@@ -491,6 +498,24 @@ class BaseTrainer:
             self.train_state = restored
             logger.info_rank0("resumed from checkpoint")
         return restored is not None, extra
+
+    def apply_restored_extra(self, state, extra: Dict[str, Any]) -> None:
+        """Apply a checkpoint's extra_state (global step, epoch, rank-local
+        dataloader cursor, meter, stateful callbacks) to the live run. Shared
+        by auto-resume (CheckpointCallback.on_train_begin) and the anomaly
+        supervisor's rollback path."""
+        if not extra:
+            return
+        state.global_step = int(extra.get("global_step", 0))
+        state.epoch = int(extra.get("epoch", 0))
+        if extra.get("dataloader") and hasattr(self.dataloader, "load_state_dict"):
+            self.dataloader.load_state_dict(extra["dataloader"])
+        if extra.get("meter") and self.meter:
+            self.meter.load_state_dict(extra["meter"])
+        for cb in self.callbacks:
+            cb_state = extra.get("callbacks", {}).get(type(cb).__name__)
+            if cb_state and hasattr(cb, "load_state_dict"):
+                cb.load_state_dict(cb_state)
 
     # ------------------------------------------------------------- evaluation
     def _build_eval_dataloader(self):
@@ -555,68 +580,206 @@ class BaseTrainer:
         for cb in self.callbacks:
             getattr(cb, hook)(self, state)
 
+    def _start_data_iter(self):
+        """(Re)build the prefetcher + iterator — at train start and after a
+        supervisor rollback restored the dataloader cursor (the prefetch
+        thread starts pulling at construction, so the cursor must already be
+        in place)."""
+        t = self.args.train
+        self._prefetcher = None
+        if t.prefetch_depth > 0:
+            from veomni_tpu.data.prefetch import BackgroundPrefetcher
+
+            self._prefetcher = BackgroundPrefetcher(
+                self.dataloader, depth=t.prefetch_depth
+            )
+        return iter(self._prefetcher or self.dataloader)
+
+    def _close_prefetcher(self):
+        """Idempotent; also invoked from the SIGTERM handler to wake a
+        consumer blocked on the prefetch queue."""
+        pf = getattr(self, "_prefetcher", None)
+        if pf is not None:
+            pf.close()
+
+    def _rollback(self, ctl, sup):
+        """Supervisor escalation: restore the latest committed checkpoint
+        (params + optimizer + rank-local data cursor) and replay the
+        iterator from there. Returns the fresh data iterator."""
+        from veomni_tpu.resilience.supervisor import RollbackImpossible
+
+        logger.warning_rank0(
+            "anomaly escalation: rolling back from step %d to the latest "
+            "committed checkpoint", ctl.global_step,
+        )
+        self._close_prefetcher()
+        try:
+            self.checkpointer.wait()  # an in-flight save may be the target
+        except Exception as e:
+            logger.warning_rank0("in-flight save failed during rollback: %s", e)
+        # target a checkpoint committed BEFORE the anomalous run began: a
+        # save that landed inside the window (detection lags by the
+        # in-flight depth) would make the rewind a no-op — the cursor must
+        # back up past the anomalous batches so the replay re-runs them
+        target = None
+        first_bad = sup.consec_start
+        committed = self.checkpointer.list_steps()
+        if first_bad is not None:
+            before = [s for s in committed if s < first_bad]
+            if before:
+                target = before[-1]
+            elif committed:
+                logger.warning_rank0(
+                    "no committed checkpoint precedes anomalous step %d; "
+                    "restoring the latest (cursor will NOT re-run the "
+                    "anomalous batches)", first_bad,
+                )
+        restored, extra = self.try_resume(step=target)
+        if not restored:
+            raise RollbackImpossible(
+                "rollback requested but no committed checkpoint exists "
+                "(set train.save_steps to create mid-run rollback targets)"
+            )
+        self.apply_restored_extra(ctl, extra)
+        sup.note_rollback(to_step=ctl.global_step)
+        return self._start_data_iter()
+
     def train(self):
         t = self.args.train
+        from veomni_tpu.resilience import (
+            GracefulShutdown,
+            SupervisorPolicy,
+            TrainSupervisor,
+        )
+        from veomni_tpu.resilience.faults import arm_from_env
+        from veomni_tpu.resilience.supervisor import AnomalyBudgetExceeded, worse_verdict
+        from veomni_tpu.utils.helper import Watchdog
+
+        arm_from_env()  # VEOMNI_FAULT_PLAN (tests/chaos drills); no-op else
         ctl = TrainerControlState(train_steps=self.train_steps)
+        sup = TrainSupervisor(SupervisorPolicy.from_train_args(t))
         with use_parallel_state(self.parallel_state):
             self._fire("on_train_begin", ctl)
             # prefetcher construction AFTER on_train_begin: auto-resume
             # restores the dataloader cursor there, and the thread starts
             # pulling at construction
-            self._prefetcher = None
-            if t.prefetch_depth > 0:
-                from veomni_tpu.data.prefetch import BackgroundPrefetcher
-
-                self._prefetcher = BackgroundPrefetcher(
-                    self.dataloader, depth=t.prefetch_depth
-                )
-            data_iter = iter(self._prefetcher or self.dataloader)
-            # dispatch-depth bound, independent of log cadence: with a large
-            # log_steps the host could otherwise run arbitrarily far ahead,
-            # keeping every shipped batch + queued execution live in HBM
-            # (and on the axon TPU hung work can't be timeout-killed). A
-            # scalar fetch on the oldest in-flight loss is the only sync
-            # guaranteed through the relay.
-            from collections import deque
-
-            inflight: deque = deque()
+            data_iter = self._start_data_iter()
+            # SIGTERM = cluster preemption notice: finish the current step,
+            # take one final synchronous checkpoint, return (exit 0) so the
+            # restarted job resumes bit-exactly
+            shutdown = GracefulShutdown(on_request=self._close_prefetcher)
+            watchdog = Watchdog(
+                t.resilience_watchdog_s, on_stall=sup.note_stall,
+                description="train loop",
+            )
             try:
-                while ctl.global_step < self.train_steps and not ctl.should_stop:
-                    batch_np = next(data_iter)
-                    self.current_batch = batch_np
-                    self._fire("on_step_begin", ctl)
-                    # each process holds [A, B_local, S]; stitch into the
-                    # globally-sharded array (single-controller semantics)
-                    batch = self._ship_batch(batch_np)
-                    self.train_state, metrics = self.train_step(self.train_state, batch)
-                    ctl.global_step += 1
-                    if "loss" in metrics:
-                        inflight.append(metrics["loss"])
-                        if len(inflight) > 4:
-                            np.asarray(jax.device_get(inflight.popleft()))
-                    # the step dispatches asynchronously; materializing a
-                    # metric would block the host on device completion and
-                    # serialize batch assembly with compute. Fetch only on
-                    # log steps; in between, callbacks receive device futures.
-                    ctl.synced = (
-                        ctl.global_step % t.log_steps == 0
-                        or ctl.global_step >= self.train_steps
-                    )
-                    if ctl.synced:
-                        metrics = {
-                            k: (float(v) if np.ndim(v) == 0 else np.asarray(v))
-                            for k, v in metrics.items()
-                        }
-                    ctl.metrics = dict(metrics)
-                    if ctl.synced:
-                        # optax evaluated the schedule at count == step-1 for
-                        # the update just applied; log that value, not the
-                        # next step's. Schedules are jnp programs, so this
-                        # float() is itself a device fetch — sync steps only.
-                        ctl.metrics["lr"] = float(self.lr_schedule(ctl.global_step - 1))
-                    self._fire("on_step_end", ctl)
+                with shutdown, watchdog:
+                    while True:
+                        # The supervisor's observe() preserves the loop's
+                        # dispatch-depth bound, independent of log cadence:
+                        # with a large log_steps the host could otherwise run
+                        # arbitrarily far ahead, keeping every shipped batch +
+                        # queued execution live in HBM (and on the axon TPU
+                        # hung work can't be timeout-killed). A scalar fetch
+                        # on the oldest in-flight loss is the only sync
+                        # guaranteed through the relay.
+                        while ctl.global_step < self.train_steps and not ctl.should_stop:
+                            if shutdown.requested:
+                                break
+                            try:
+                                batch_np = next(data_iter)
+                            except Exception:
+                                if shutdown.requested:
+                                    break  # prefetcher closed by the handler
+                                raise
+                            self.current_batch = batch_np
+                            self._fire("on_step_begin", ctl)
+                            # each process holds [A, B_local, S]; stitch into
+                            # the globally-sharded array (single-controller)
+                            batch = self._ship_batch(batch_np)
+                            self.train_state, metrics = self.train_step(
+                                self.train_state, batch
+                            )
+                            ctl.global_step += 1
+                            verdict = sup.observe(ctl.global_step, metrics)
+                            watchdog.pet()
+                            # the step dispatches asynchronously; materializing
+                            # a metric would block the host on device completion
+                            # and serialize batch assembly with compute. Fetch
+                            # only on log steps; in between, callbacks receive
+                            # device futures.
+                            ctl.synced = (
+                                ctl.global_step % t.log_steps == 0
+                                or ctl.global_step >= self.train_steps
+                            )
+                            if ctl.synced:
+                                metrics = {
+                                    k: (float(v) if np.ndim(v) == 0 else np.asarray(v))
+                                    for k, v in metrics.items()
+                                }
+                            ctl.metrics = dict(metrics)
+                            if ctl.synced:
+                                # optax evaluated the schedule at count ==
+                                # step-1 for the update just applied; log that
+                                # value, not the next step's. Schedules are jnp
+                                # programs, so this float() is itself a device
+                                # fetch — sync steps only.
+                                ctl.metrics["lr"] = float(
+                                    self.lr_schedule(ctl.global_step - 1)
+                                )
+                                # the host just blocked on the device anyway:
+                                # inspect every queued verdict for free —
+                                # unless escalation is already decided: a
+                                # later OK entry would reset the supervisor's
+                                # consec_start before _rollback reads it to
+                                # pick a pre-anomaly target (note_rollback
+                                # clears the queue regardless)
+                                if verdict in ("ok", "skip"):
+                                    verdict = worse_verdict(verdict, sup.drain())
+                                ctl.resilience = sup.stats()
+                            self._fire("on_step_end", ctl)
+                            if verdict == "rollback":
+                                data_iter = self._rollback(ctl, sup)
+                            elif verdict == "abort":
+                                raise AnomalyBudgetExceeded(
+                                    f"anomaly budget exceeded at step "
+                                    f"{ctl.global_step}: {sup.stats()}"
+                                )
+                        if shutdown.requested and ctl.global_step < self.train_steps:
+                            ctl.preempted = True
+                            ctl.should_stop = True
+                            sup.drain()  # late anomalies still count in stats
+                            logger.warning_rank0(
+                                "preemption stop at step %d: taking the final "
+                                "checkpoint, then exiting cleanly",
+                                ctl.global_step,
+                            )
+                            break
+                        if ctl.should_stop:
+                            # stopping anyway: no rollback/abort, but the last
+                            # inflight_depth steps' anomalies must still be
+                            # counted and logged, not silently dropped
+                            sup.drain()
+                            break
+                        # step budget exhausted, but up to inflight_depth
+                        # verdicts may still be queued — a blow-up in the last
+                        # few steps must not slip out silently
+                        verdict = sup.drain()
+                        if verdict == "abort":
+                            raise AnomalyBudgetExceeded(
+                                f"anomaly budget exceeded in the final steps: "
+                                f"{sup.stats()}"
+                            )
+                        if verdict == "rollback":
+                            data_iter = self._rollback(ctl, sup)
+                            continue  # re-run the rolled-back steps
+                        break
+                    # STILL inside the signal scope: schedulers often re-send
+                    # SIGTERM during the grace period — the final synchronous
+                    # checkpoint (on_train_end) must not die to the default
+                    # handler mid-save. A repeated TERM just re-sets the flag.
+                    ctl.resilience = sup.stats()
+                    self._fire("on_train_end", ctl)
             finally:
-                if self._prefetcher is not None:
-                    self._prefetcher.close()
-            self._fire("on_train_end", ctl)
+                self._close_prefetcher()
         return ctl

@@ -32,6 +32,11 @@ class TrainerControlState:
     # cadence + final step). On other steps metrics hold device futures;
     # callbacks that read values must gate on this to keep the loop async.
     synced: bool = True
+    # set when a SIGTERM/preemption request stopped the loop early (the
+    # final checkpoint was still taken; the process should exit 0)
+    preempted: bool = False
+    # resilience supervisor rollup (anomalies, rollbacks, watchdog stalls)
+    resilience: Dict[str, Any] = field(default_factory=dict)
 
 
 class Callback:
@@ -203,16 +208,8 @@ class CheckpointCallback(Callback):
             return
         restored, extra = trainer.try_resume()
         if restored and extra:
-            state.global_step = int(extra.get("global_step", 0))
-            state.epoch = int(extra.get("epoch", 0))
-            if extra.get("dataloader") and hasattr(trainer.dataloader, "load_state_dict"):
-                trainer.dataloader.load_state_dict(extra["dataloader"])
-            if extra.get("meter") and trainer.meter:
-                trainer.meter.load_state_dict(extra["meter"])
-            for cb in trainer.callbacks:
-                cb_state = extra.get("callbacks", {}).get(type(cb).__name__)
-                if cb_state and hasattr(cb, "load_state_dict"):
-                    cb.load_state_dict(cb_state)
+            # shared with the supervisor's rollback path (trainer/base.py)
+            trainer.apply_restored_extra(state, extra)
 
     def on_step_end(self, trainer, state):
         if self.save_steps and state.global_step % self.save_steps == 0:

@@ -189,4 +189,5 @@ class DistillTrainer(BaseTrainer):
             batch_shardings=self.batch_shardings,
             max_grad_norm=self.args.train.max_grad_norm,
             grad_mask=self.grad_mask,
+            skip_nonfinite=self.args.train.resilience_skip_nonfinite,
         )

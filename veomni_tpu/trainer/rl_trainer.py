@@ -167,6 +167,7 @@ class BaseRLTrainer(BaseTrainer):
             batch_shardings=self.batch_shardings,
             max_grad_norm=self.args.train.max_grad_norm,
             grad_mask=self.grad_mask,
+            skip_nonfinite=self.args.train.resilience_skip_nonfinite,
         )
 
 

@@ -30,6 +30,9 @@ ENV_DEFAULTS: Dict[str, Any] = {
     # Head-chunk count for the async Ulysses pipeline (clamped to the
     # feasible maximum of the model's head layout).
     "VEOMNI_ULYSSES_ASYNC_CHUNKS": "4",
+    # Deterministic fault-injection plan (JSON text or @file) arming the
+    # resilience fault points — see docs/resilience.md. "" = unarmed.
+    "VEOMNI_FAULT_PLAN": "",
 }
 
 

@@ -84,6 +84,103 @@ class EnvironMeter:
         self.consumed_tokens = int(state.get("consumed_tokens", 0))
 
 
+def dump_thread_stacks() -> str:
+    """Formatted stack of every live Python thread (the first thing anyone
+    needs from a hung multi-host run: WHERE each thread is blocked)."""
+    import sys
+    import threading
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for tid, frame in sys._current_frames().items():
+        parts.append(f"--- thread {names.get(tid, '?')} (ident {tid}) ---")
+        parts.append("".join(traceback.format_stack(frame)).rstrip())
+    return "\n".join(parts)
+
+
+class Watchdog:
+    """Stall detector on a daemon thread (promoted from ``bench.py:_watchdog``
+    so the trainer supervisor and the bench share one implementation).
+
+    Arms at :meth:`start`; :meth:`pet` resets the deadline (call once per unit
+    of expected progress — a train step, a bench phase). If ``timeout_s``
+    elapses with no pet, the dog dumps every thread's stack via
+    :func:`dump_thread_stacks`, invokes ``on_stall(stack_dump)`` once per
+    stall, and — unless ``exit_code`` is None — hard-exits the process
+    (``os._exit``; a wedged backend can't be timeout-killed politely, see
+    BENCH_NOTES.md). With ``exit_code=None`` the run is left alive: the stall
+    may be a bounded hiccup (slow shared fs) the retry layer absorbs, and the
+    dump is the observability artifact either way. Re-arms after firing, so a
+    long stall produces periodic dumps rather than one.
+    """
+
+    def __init__(self, timeout_s: float, *, on_stall=None, exit_code=None,
+                 description: str = ""):
+        import threading
+
+        self.timeout_s = float(timeout_s)
+        self.on_stall = on_stall
+        self.exit_code = exit_code
+        self.description = description
+        self.stall_count = 0
+        self.last_dump: str = ""
+        self._pet_event = threading.Event()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Watchdog":
+        import threading
+
+        if self.timeout_s > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._watch, name="veomni-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def pet(self) -> None:
+        self._pet_event.set()
+
+    def stop(self) -> None:
+        self._done.set()
+        self._pet_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _watch(self) -> None:
+        import os as _os
+
+        while not self._done.is_set():
+            self._pet_event.clear()
+            if self._pet_event.wait(self.timeout_s):
+                continue  # progress (or stop) before the deadline
+            if self._done.is_set():
+                return
+            self.stall_count += 1
+            self.last_dump = dump_thread_stacks()
+            logger.error(
+                "watchdog: no progress in %.3gs%s; thread stacks:\n%s",
+                self.timeout_s,
+                f" ({self.description})" if self.description else "",
+                self.last_dump,
+            )
+            if self.on_stall is not None:
+                try:
+                    self.on_stall(self.last_dump)
+                except Exception:
+                    pass
+            if self.exit_code is not None:
+                _os._exit(self.exit_code)
+
+
 def host_floats(metrics: Dict[str, Any]) -> Dict[str, float]:
     """Keep only host-scalar metric values (drop device futures: fetching
     one would block an async loop). Shared by WandbCallback and the serving
