@@ -201,11 +201,26 @@ def run_bench(
         state, metrics = step(state, batch)
         _ = float(metrics["loss"])
 
+        # utilization accounting for the timed window: goodput split from
+        # host spans + recompile count from the train-step trace counter
+        # (a steady-state retrace inside the window voids the measurement)
+        from veomni_tpu.observability.goodput import GoodputTracker
+        from veomni_tpu.observability.spans import enable_spans, span
+        from veomni_tpu.train import train_step as train_step_mod
+
+        enable_spans()
+        tracker = GoodputTracker()
+        traces0 = train_step_mod.TRACE_COUNTS["train_step"]
+        tracker.begin_window()
         t0 = time.perf_counter()
         for _ in range(steps):
-            state, metrics = step(state, batch)
-        _ = float(metrics["loss"])
+            with span("step.dispatch"):
+                state, metrics = step(state, batch)
+        with span("sync.fetch"):
+            _ = float(metrics["loss"])
         dt = time.perf_counter() - t0
+        gp = tracker.end_window()
+        recompiles = train_step_mod.TRACE_COUNTS["train_step"] - traces0
 
         tokens = micro_bs * seq_len * steps
         tok_per_sec_chip = tokens / dt / n_chips
@@ -222,7 +237,10 @@ def run_bench(
                 "attention": attention_impl or "auto",
                 "remat_policy": remat_policy, "preset": preset,
                 "optimizer": optimizer, "ulysses_size": ulysses_size,
-                "ulysses_async": ulysses_async}
+                "ulysses_async": ulysses_async,
+                "goodput_pct": gp.get("goodput_pct", 0.0),
+                "data_wait_frac": gp.get("data_wait_frac", 0.0),
+                "recompiles": recompiles}
 
 
 def run_serve_bench(
@@ -380,6 +398,11 @@ def main():
         "unit": f"tokens/s/chip ({r['preset']} bf16 {r['optimizer']}, "
                 f"seq{seq_len}, mfu={r['mfu']:.1f}%)",
         "vs_baseline": round(r["mfu"] / 40.0, 4),
+        # utilization trajectory: BENCH_*.json now captures where the wall
+        # time went, not just the headline rate (docs/observability.md)
+        "goodput_pct": round(r["goodput_pct"], 2),
+        "data_wait_frac": round(r["data_wait_frac"], 4),
+        "recompiles": r["recompiles"],
     }), flush=True)
 
 

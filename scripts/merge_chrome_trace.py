@@ -1,9 +1,11 @@
-"""Merge multiple chrome-trace JSON files (e.g. per-host jax.profiler dumps)
-into one timeline, offsetting pids so hosts don't collide.
+"""Merge multiple chrome-trace JSON files (e.g. per-host jax.profiler dumps,
+or the observability span tracer's ``dump_chrome_trace`` output) into one
+timeline, offsetting pids so hosts don't collide.
 
 Reference capability: ``scripts/profile/merge_chrome_trace.py``.
 Our ProfileCallback writes traces under
-``<output_dir>/profile/plugins/profile/<run>/*.trace.json.gz``.
+``<output_dir>/profile/plugins/profile/<run>/*.trace.json.gz``; the span
+tracer writes via ``observability.spans.dump_chrome_trace`` (pid = rank).
 
 Usage:
   python scripts/merge_chrome_trace.py out.json trace_host0.json.gz trace_host1.json.gz
@@ -21,13 +23,13 @@ def load(path):
     return data.get("traceEvents", data) if isinstance(data, dict) else data
 
 
-def main():
-    if len(sys.argv) < 3:
-        raise SystemExit(__doc__)
-    out, inputs = sys.argv[1], sys.argv[2:]
+def merge_traces(paths):
+    """Concatenate trace events, remapping pids monotonically: every input's
+    pids are offset past the previous inputs' maximum, so host i+1's
+    processes always sort after host i's and never collide."""
     merged = []
     pid_base = 0
-    for i, path in enumerate(inputs):
+    for i, path in enumerate(paths):
         events = load(path)
         max_pid = 0
         for ev in events:
@@ -41,6 +43,14 @@ def main():
                 ev["args"]["name"] = f"host{i}/{ev['args'].get('name', '')}"
             merged.append(ev)
         pid_base += max_pid + 1
+    return merged
+
+
+def main():
+    if len(sys.argv) < 3:
+        raise SystemExit(__doc__)
+    out, inputs = sys.argv[1], sys.argv[2:]
+    merged = merge_traces(inputs)
     with open(out, "w") as f:
         json.dump({"traceEvents": merged}, f)
     print(f"merged {len(inputs)} traces, {len(merged)} events -> {out}")

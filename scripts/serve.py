@@ -15,7 +15,8 @@ Run:
       --temperature 0.8 --top-p 0.9
 
 Env knobs (flags win): VEOMNI_SERVE_SLOTS, VEOMNI_SERVE_BLOCK,
-VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS.
+VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS. VEOMNI_METRICS_PORT serves
+Prometheus /metrics + /healthz while the pump runs (docs/observability.md).
 """
 
 import argparse
@@ -87,6 +88,15 @@ def main():
         num_slots=args.slots, block_size=args.block_size,
         max_model_len=args.max_model_len, log_every_steps=args.log_steps,
     ))
+    # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz for the pump loop
+    # (the engine feeds the same registry the trainer exports through)
+    from veomni_tpu.observability.exporter import maybe_start_from_env
+
+    exporter = maybe_start_from_env(health_fn=lambda: {
+        "healthy": True,
+        "queue_depth": engine.scheduler.queue_depth,
+        "num_running": engine.scheduler.num_running,
+    })
 
     sampling = SamplingParams(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
@@ -110,6 +120,8 @@ def main():
         print(json.dumps(line), flush=True)
     outs = engine.run()  # no-op drain; collects final outputs
     print(json.dumps({"metrics": engine.metrics()}), flush=True)
+    if exporter is not None:
+        exporter.stop()
     for rid in sorted(outs):
         o = outs[rid]
         print(json.dumps({

@@ -45,7 +45,12 @@ if failures:
 # silently drop a whole subtree from this gate)
 for required in ("veomni_tpu.serving", "veomni_tpu.serving.engine",
                  "veomni_tpu.resilience", "veomni_tpu.resilience.faults",
-                 "veomni_tpu.resilience.retry", "veomni_tpu.resilience.supervisor"):
+                 "veomni_tpu.resilience.retry", "veomni_tpu.resilience.supervisor",
+                 "veomni_tpu.observability", "veomni_tpu.observability.metrics",
+                 "veomni_tpu.observability.spans",
+                 "veomni_tpu.observability.goodput",
+                 "veomni_tpu.observability.exporter",
+                 "veomni_tpu.observability.callback"):
     if required not in visited:
         print("MISSING:" + required)
         sys.exit(1)

@@ -1,0 +1,462 @@
+"""Observability subsystem: registry, spans, goodput, recompile detection,
+Prometheus exporter.
+
+Acceptance contract (ISSUE 4): registry thread-safety + percentiles; spans
+disabled cost ≈ nothing and produce chrome-trace JSON that
+``scripts/merge_chrome_trace.py`` accepts; goodput fractions for a synthetic
+step sum to ~1.0; a forced re-trace trips the recompile warning; ``/metrics``
+serves parseable Prometheus text with trainer *and* serving metrics on CPU.
+"""
+
+import gzip
+import importlib.util
+import json
+import logging
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veomni_tpu.observability import (
+    GoodputTracker,
+    MetricsExporter,
+    MetricsRegistry,
+    RecompileDetector,
+    render_prometheus,
+)
+from veomni_tpu.observability import spans as spans_mod
+from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.observability.spans import (
+    disable_spans,
+    dump_chrome_trace,
+    enable_spans,
+    span,
+)
+
+
+def _load_merge_script():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "merge_chrome_trace.py")
+    spec = importlib.util.spec_from_file_location("merge_chrome_trace", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def spans_off():
+    """Leave the process-global span switch the way we found it."""
+    was = spans_mod.spans_enabled()
+    disable_spans()
+    yield
+    if was:
+        enable_spans()
+
+
+@pytest.fixture
+def spans_on():
+    was = spans_mod.spans_enabled()
+    enable_spans()
+    yield
+    if not was:
+        disable_spans()
+
+
+# ----------------------------------------------------------------- registry
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    threads = 8
+    per_thread = 1000
+
+    def work():
+        c = reg.counter("t.count")
+        h = reg.histogram("t.hist")
+        g = reg.gauge("t.gauge")
+        for i in range(per_thread):
+            c.inc()
+            h.observe(float(i))
+            g.set(i)
+
+    ts = [threading.Thread(target=work) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert reg.counter("t.count").value == threads * per_thread
+    assert reg.histogram("t.hist").count == threads * per_thread
+
+
+def test_histogram_percentiles_and_bounds():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", max_samples=512)
+    for v in range(1, 101):
+        h.observe(float(v))
+    snap = h.snapshot()
+    assert snap["count"] == 100 and snap["sum"] == pytest.approx(5050.0)
+    assert snap["min"] == 1.0 and snap["max"] == 100.0
+    assert snap["p50"] == pytest.approx(50.0, abs=2.0)
+    assert snap["p95"] == pytest.approx(95.0, abs=2.0)
+    # reservoir stays bounded while count/sum stay exact
+    small = reg.histogram("small", max_samples=16)
+    for v in range(10_000):
+        small.observe(float(v))
+    assert small.count == 10_000
+    assert len(small._samples) == 16
+    assert small.snapshot()["max"] == 9999.0
+
+
+def test_registry_kind_conflict_and_get_or_create():
+    reg = MetricsRegistry()
+    c1 = reg.counter("x")
+    assert reg.counter("x") is c1  # shared instrument
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_registry_jsonl_sink_and_export_hook(tmp_path):
+    reg = MetricsRegistry()
+    path = str(tmp_path / "metrics.jsonl")
+    reg.attach_jsonl(path)
+    seen = []
+    reg.add_export_hook(lambda step, payload: seen.append((step, payload)))
+    reg.counter("c").inc(3)
+    merged = reg.export(7, {"loss": 1.5, "future": object()})
+    assert merged["c"] == 3.0 and merged["loss"] == 1.5
+    assert "future" not in merged  # non-numeric payload values dropped
+    assert seen and seen[0][0] == 7 and seen[0][1]["loss"] == 1.5
+    assert reg.last_export(step=7)["loss"] == 1.5
+    assert reg.last_export(step=8) is None
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["step"] == 7 and lines[0]["c"] == 3.0
+    assert "rank" in lines[0]
+
+
+# -------------------------------------------------------------------- spans
+def test_span_disabled_is_allocation_free(spans_off):
+    # the disabled path hands back ONE shared no-op context manager: no
+    # per-call object, no clock read, no histogram feed
+    assert span("a") is span("b")
+    before = len(get_registry().items_snapshot())
+    with span("disabled.phase"):
+        pass
+    # no histogram was created/fed: the disabled path never touches the
+    # registry (or the clock, or an allocator)
+    assert len(get_registry().items_snapshot()) == before
+    assert get_registry().get("span.disabled.phase") is None
+
+
+def test_span_feeds_histograms_and_chrome_trace(tmp_path, spans_on):
+    spans_mod.clear_events()
+    reg = get_registry()
+    base = reg.histogram_sum("span.unit.phase")
+    with span("unit.phase"):
+        time.sleep(0.002)
+    with span("unit.phase"):
+        time.sleep(0.002)
+    assert reg.histogram_sum("span.unit.phase") - base >= 0.004
+
+    plain = str(tmp_path / "trace.json")
+    gz = str(tmp_path / "trace.json.gz")
+    assert dump_chrome_trace(plain) >= 2
+    assert dump_chrome_trace(gz) >= 2
+    doc = json.load(open(plain))
+    events = doc["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert xs, "no complete events"
+    for e in xs:
+        assert e["ts"] >= 0 and e["dur"] > 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    assert any(e.get("name") == "process_name" for e in events)
+    with gzip.open(gz, "rt") as f:
+        assert json.load(f)["traceEvents"]
+
+    # ... and merge_chrome_trace accepts both (gzip + plain roundtrip)
+    merge = _load_merge_script()
+    merged = merge.merge_traces([plain, gz])
+    assert len(merged) == 2 * len(events)
+
+
+def test_merge_chrome_trace_monotonic_pid_remap(tmp_path):
+    merge = _load_merge_script()
+    host0 = [
+        {"name": "process_name", "ph": "M", "pid": 3, "args": {"name": "p"}},
+        {"name": "a", "ph": "X", "pid": 0, "tid": 1, "ts": 0, "dur": 5},
+        {"name": "b", "ph": "X", "pid": 3, "tid": 1, "ts": 1, "dur": 5},
+    ]
+    host1 = [
+        {"name": "a", "ph": "X", "pid": 0, "tid": 2, "ts": 0, "dur": 5},
+        {"name": "b", "ph": "X", "pid": 1, "tid": 2, "ts": 2, "dur": 5},
+    ]
+    p0 = str(tmp_path / "h0.json")
+    p1 = str(tmp_path / "h1.json.gz")
+    json.dump({"traceEvents": host0}, open(p0, "w"))
+    with gzip.open(p1, "wt") as f:
+        json.dump(host1, f)  # bare event-list form must load too
+    merged = merge.merge_traces([p0, p1])
+    assert len(merged) == 5
+    pids0 = {e["pid"] for e in merged[:3]}
+    pids1 = {e["pid"] for e in merged[3:]}
+    assert pids0 == {0, 3}  # first host unshifted
+    assert pids1 == {4, 5}  # offset past host0's max pid (3) + 1
+    assert max(pids0) < min(pids1)  # monotonic: later hosts sort after
+    # host tag folded into process names
+    pnames = [e for e in merged if e.get("name") == "process_name"]
+    assert pnames and pnames[0]["args"]["name"].startswith("host0/")
+    # roundtrip through main()'s output shape
+    out = str(tmp_path / "merged.json")
+    json.dump({"traceEvents": merged}, open(out, "w"))
+    again = merge.load(out)
+    assert len(again) == 5
+
+
+# ------------------------------------------------------------------ goodput
+def test_goodput_fractions_sum_to_one(spans_on):
+    reg = MetricsRegistry()
+    tracker = GoodputTracker(reg)
+    # synthetic step built from the exact spans the trainer emits — but fed
+    # through a private registry so other tests' spans can't skew it
+    prev = spans_mod.get_registry
+    spans_mod.get_registry = lambda: reg
+    try:
+        tracker.begin_window()
+        with span("data.wait"):
+            time.sleep(0.03)
+        with span("data.ship"):
+            time.sleep(0.005)
+        with span("step.dispatch"):
+            time.sleep(0.01)
+        with span("host.callbacks"):
+            with span("ckpt.save"):
+                time.sleep(0.01)
+            time.sleep(0.005)
+        time.sleep(0.02)  # unattributed (the sync fetch / device wait)
+        w = tracker.end_window()
+    finally:
+        spans_mod.get_registry = prev
+    fracs = {k: v for k, v in w.items() if k.endswith("_frac")}
+    assert set(fracs) == {"data_wait_frac", "host_frac", "dispatch_frac",
+                          "checkpoint_frac", "other_frac"}
+    assert sum(fracs.values()) == pytest.approx(1.0, abs=1e-6)
+    assert w["data_wait_frac"] > 0.15  # the dominant injected stall
+    assert w["checkpoint_frac"] > 0.05
+    # ckpt time nested in the callback hook must not be double counted
+    assert w["host_frac"] < w["checkpoint_frac"] + 0.15
+    assert w["goodput_pct"] == pytest.approx(
+        100.0 * (w["dispatch_frac"] + w["other_frac"]), abs=1e-6)
+    # next window starts clean
+    w2 = tracker.end_window()
+    assert w2["data_wait_frac"] == pytest.approx(0.0, abs=1e-3)
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+def test_forced_retrace_trips_recompile_warning():
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.train import train_step as train_step_mod
+
+    reg = MetricsRegistry()
+    det = RecompileDetector(
+        [("train_step", train_step_mod.TRACE_COUNTS, ("train_step",))],
+        shape_source=train_step_mod.LAST_TRACE_SHAPES,
+        registry=reg,
+    )
+
+    def impl(batch):
+        # the same trace-time counting discipline the real step_fn uses
+        train_step_mod.TRACE_COUNTS["train_step"] += 1
+        train_step_mod.LAST_TRACE_SHAPES["train_step"] = {
+            k: tuple(v.shape) for k, v in batch.items()
+        }
+        return batch["input_ids"] * 2
+
+    f = jax.jit(impl)
+    f({"input_ids": jnp.ones((1, 8), jnp.int32)})  # warmup compile
+    det.arm()
+    assert det.check() == 0  # steady state: same shape, no retrace
+    f({"input_ids": jnp.ones((1, 8), jnp.int32)})
+    assert det.check() == 0
+
+    cap = _Capture()
+    root = logging.getLogger("veomni_tpu")
+    root.addHandler(cap)
+    try:
+        f({"input_ids": jnp.ones((1, 16), jnp.int32)})  # forced re-trace
+        assert det.check() == 1
+    finally:
+        root.removeHandler(cap)
+    msgs = [r.getMessage() for r in cap.records]
+    assert any("RECOMPILE" in m for m in msgs), msgs
+    assert any("(1, 16)" in m for m in msgs), "offending shapes not logged"
+    assert reg.counter("recompiles").value == 1
+    assert det.total_recompiles == 1
+
+
+# ----------------------------------------------------------------- exporter
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [-+]?[0-9.eE+-]+$"
+)
+
+
+def _parse_prometheus(body: str):
+    names = set()
+    for line in body.strip().splitlines():
+        if line.startswith("#"):
+            assert line.startswith("# TYPE "), line
+            continue
+        assert _PROM_LINE.match(line), f"unparseable exposition line: {line!r}"
+        names.add(line.split("{")[0].split(" ")[0])
+    return names
+
+
+def test_metrics_endpoint_serves_trainer_and_serving_metrics(tmp_path):
+    """The acceptance check: one /metrics endpoint, trainer + serving
+    families, parseable Prometheus text, all under JAX_PLATFORMS=cpu."""
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.models import TransformerConfig, build_foundation_model
+    from veomni_tpu.parallel.parallel_state import destroy_parallel_state
+    from veomni_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+    from veomni_tpu.trainer import TextTrainer
+
+    from tests.test_e2e_training import TOY, _make_args, _write_dummy_data
+
+    destroy_parallel_state()
+    _write_dummy_data(tmp_path / "data.jsonl")
+    args = _make_args(tmp_path, train_steps=4, log_steps=2)
+    trainer = TextTrainer(args)
+    ctl = trainer.train()
+    assert ctl.global_step == 4
+    trainer.checkpointer.close()
+    destroy_parallel_state()
+
+    # the trainer's sync-step export also wrote the rank-local JSONL sink
+    jsonl = os.path.join(args.train.output_dir, "metrics_rank0.jsonl")
+    rows = [json.loads(l) for l in open(jsonl)]
+    assert rows and rows[-1]["step"] == 4
+    assert "loss" in rows[-1] and "goodput_pct" in rows[-1]
+    frac_keys = ("data_wait_frac", "host_frac", "dispatch_frac",
+                 "checkpoint_frac", "other_frac")
+    assert sum(rows[-1][k] for k in frac_keys) == pytest.approx(1.0, abs=1e-3)
+
+    # serving metrics land in the same registry
+    cfg = TransformerConfig(dtype=jnp.float32, **{
+        **TOY, "vocab_size": 128, "num_hidden_layers": 2})
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(params, cfg, EngineConfig(
+        num_slots=2, block_size=16, max_model_len=128))
+    eng.run([Request(prompt_ids=[1, 2, 3, 4],
+                     sampling=SamplingParams(max_new_tokens=4))])
+    eng.metrics()
+
+    sup_health = {"healthy": True, "anomalies": 0}
+    exp = MetricsExporter(port=0, health_fn=lambda: dict(sup_health))
+    port = exp.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        names = _parse_prometheus(body)
+        # trainer family
+        assert "veomni_train_loss" in names
+        assert "veomni_train_goodput_pct" in names
+        assert any(n.startswith("veomni_span_") for n in names)
+        # serving family
+        assert "veomni_serve_generated_tokens" in names
+        assert "veomni_serve_ttft_s_sum" in names
+        assert "veomni_serve_kv_utilization" in names
+        # healthz: healthy -> 200, unhealthy -> 503 (no body parsing needed)
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert doc["healthy"] is True
+        sup_health["healthy"] = False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=10)
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        exp.stop()
+
+
+def test_moe_router_stats_published():
+    from veomni_tpu.utils.moe_monitor import publish_router_stats
+
+    reg = MetricsRegistry()
+    load = np.array([
+        [0.5, 0.5, 0.0, 0.0],      # collapsed onto two experts
+        [0.25, 0.25, 0.25, 0.25],  # perfectly balanced
+    ])
+    publish_router_stats(load, registry=reg)
+    assert reg.gauge("moe.layer0.max_load").value == 0.5
+    assert reg.gauge("moe.layer0.entropy").value == pytest.approx(np.log(2))
+    # mass above the 1/E fair share = what a capacity-1.0 router would drop
+    assert reg.gauge("moe.layer0.drop_frac").value == pytest.approx(0.5)
+    assert reg.gauge("moe.layer1.entropy").value == pytest.approx(np.log(4))
+    assert reg.gauge("moe.layer1.drop_frac").value == pytest.approx(0.0)
+
+
+def test_supervisor_health_document():
+    from veomni_tpu.resilience import SupervisorPolicy, TrainSupervisor
+
+    sup = TrainSupervisor(SupervisorPolicy(
+        anomaly_budget=1, rollback_after=5, inflight_depth=0))
+    assert sup.health()["healthy"] is True
+    sup.observe(1, {"loss": float("nan"), "step_ok": np.False_})
+    sup.drain()
+    h = sup.health()
+    assert h["healthy"] is True and h["last_verdict"] == "skip"
+    sup.observe(2, {"loss": float("nan"), "step_ok": np.False_})
+    sup.drain()  # budget (1) blown -> abort, sticky
+    assert sup.health()["healthy"] is False
+    assert sup.health()["last_verdict"] == "abort"
+
+
+# ---------------------------------------------------- ProfileCallback fix
+def test_profile_callback_exception_safe_and_env_overrides(tmp_path, monkeypatch):
+    import veomni_tpu.trainer.callbacks as cb_mod
+
+    calls = {"start": 0, "stop": 0}
+    monkeypatch.setattr(
+        cb_mod.jax.profiler, "start_trace",
+        lambda d: calls.__setitem__("start", calls["start"] + 1))
+
+    def fake_stop():
+        calls["stop"] += 1
+        if calls["stop"] > calls["start"]:
+            raise RuntimeError("No profile data")  # double-stop would raise
+
+    monkeypatch.setattr(cb_mod.jax.profiler, "stop_trace", fake_stop)
+    monkeypatch.setenv("VEOMNI_PROFILE_START", "2")
+    monkeypatch.setenv("VEOMNI_PROFILE_END", "9")
+
+    cb = cb_mod.ProfileCallback(str(tmp_path), start_step=3, end_step=5)
+    assert cb.start == 2 and cb.end == 9  # env overrides win
+    state = cb_mod.TrainerControlState()
+    state.global_step = 2
+    cb.on_step_begin(None, state)
+    assert calls["start"] == 1 and cb._active
+    # crash inside the traced window: close() (the trainer's finally path)
+    # must stop the trace exactly once; every later stop is a guarded no-op
+    cb.close()
+    assert calls["stop"] == 1 and not cb._active
+    cb.close()
+    cb.on_train_end(None, state)
+    state.global_step = 9
+    cb.on_step_end(None, state)
+    assert calls["stop"] == 1  # double-stop guard held everywhere

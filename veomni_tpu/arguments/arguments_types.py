@@ -163,7 +163,22 @@ class TrainingArguments:
     # per-step device fetch serializes batch assembly with compute; the
     # dispatch-depth bound in the trainer caps run-ahead independently)
     log_steps: int = 10
+    # unified observability layer (veomni_tpu/observability/, see
+    # docs/observability.md). Spans: host-side phase timing feeding the
+    # goodput decomposition (disabled spans cost ~nothing, but off means no
+    # stall attribution)
+    observability_spans: bool = True
+    # rank-local metrics JSONL (output_dir/metrics_rank{R}.jsonl), one line
+    # per sync step: the offline utilization trajectory
+    observability_jsonl: bool = True
+    # serve Prometheus /metrics + supervisor-backed /healthz on this port;
+    # 0 = off, negative = ephemeral (tests). VEOMNI_METRICS_PORT overrides.
+    observability_port: int = 0
+    # dump the host span buffer as chrome-trace JSON here at train end
+    # ("" = off; merge across hosts with scripts/merge_chrome_trace.py)
+    observability_chrome_trace: str = ""
     enable_profiling: bool = False
+    # VEOMNI_PROFILE_START / VEOMNI_PROFILE_END env vars override the window
     profile_start_step: int = 3
     profile_end_step: int = 5
     use_wandb: bool = False

@@ -22,6 +22,8 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.observability.spans import span
 from veomni_tpu.resilience.faults import fault_point
 from veomni_tpu.resilience.retry import RetryPolicy, retry_call
 from veomni_tpu.utils.logging import get_logger
@@ -29,6 +31,14 @@ from veomni_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _STEP_RE = re.compile(r"^global_step_(\d+)$")
+
+
+def _tree_bytes(tree: Any) -> int:
+    """Payload size from array metadata (no device sync: nbytes is shape
+    math, not a fetch)."""
+    return sum(
+        int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree.leaves(tree)
+    )
 
 
 class Checkpointer:
@@ -147,17 +157,26 @@ class Checkpointer:
         # the previous async commit failed, the error raises here, belongs to
         # the previous step, and must evict that step — not be swallowed by
         # this step's retry loop
-        try:
-            self._ckptr.wait_until_finished()
-        except Exception as e:
-            self._evict_inflight(e)
-        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
-        retry_call(
-            self._dispatch_save, path, train_state, step_dir,
-            extra_state, rank_state,
-            policy=self._retry_policy,
-            description=f"checkpoint save (step {step})",
-        )
+        # the span is the single timing source (histogram ``span.ckpt.save``
+        # + goodput checkpoint attribution + chrome trace): async saves
+        # measure the host-blocking dispatch (serialize-with-previous +
+        # device->host copy), sync saves the full commit — either way, the
+        # wall time the step loop lost
+        with span("ckpt.save"):
+            try:
+                self._ckptr.wait_until_finished()
+            except Exception as e:
+                self._evict_inflight(e)
+            step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
+            retry_call(
+                self._dispatch_save, path, train_state, step_dir,
+                extra_state, rank_state,
+                policy=self._retry_policy,
+                description=f"checkpoint save (step {step})",
+            )
+        reg = get_registry()
+        reg.counter("ckpt.saves").inc()
+        reg.counter("ckpt.saved_bytes").inc(_tree_bytes(train_state))
         # dedupe only records a SUCCESSFUL dispatch (on failure the raise
         # above leaves the set untouched, so a later attempt of this step —
         # e.g. the train-end final save — isn't silently skipped)
@@ -167,15 +186,16 @@ class Checkpointer:
         self._prune()
 
     def wait(self):
-        try:
-            self._ckptr.wait_until_finished()
-        except Exception as e:
-            self._evict_inflight(e)
-            raise
-        err = self.check_for_errors()
-        if err is not None:
-            raise err
-        self._inflight_step = None
+        with span("ckpt.wait"):
+            try:
+                self._ckptr.wait_until_finished()
+            except Exception as e:
+                self._evict_inflight(e)
+                raise
+            err = self.check_for_errors()
+            if err is not None:
+                raise err
+            self._inflight_step = None
 
     def _prune(self):
         if not self.max_to_keep:
@@ -248,11 +268,15 @@ class Checkpointer:
         self.wait()
         step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
         path = os.path.join(step_dir, "train_state")
-        restored = retry_call(
-            self._dispatch_restore, path, abstract_state,
-            policy=self._retry_policy,
-            description=f"checkpoint restore (step {step})",
-        )
+        with span("ckpt.restore"):
+            restored = retry_call(
+                self._dispatch_restore, path, abstract_state,
+                policy=self._retry_policy,
+                description=f"checkpoint restore (step {step})",
+            )
+        reg = get_registry()
+        reg.counter("ckpt.restores").inc()
+        reg.counter("ckpt.restored_bytes").inc(_tree_bytes(restored))
         extra = None
         extra_path = os.path.join(step_dir, "extra_state.json")
         if os.path.exists(extra_path):

@@ -25,8 +25,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterator, Optional
 
+from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.resilience.faults import fault_point
 
 _SENTINEL = object()
@@ -57,6 +59,11 @@ class BackgroundPrefetcher:
             dataloader.state_dict() if hasattr(dataloader, "state_dict") else None
         )
         self._finished: Optional[BaseException | type] = None
+        # observability: queue fill tells whether the pipeline runs ahead
+        # (healthy: ~depth) or the trainer is starved (0 + growing waits)
+        reg = get_registry()
+        self._m_depth = reg.gauge("data.prefetch_queue_depth")
+        self._m_wait = reg.histogram("data.prefetch_wait_s")
         self._thread = threading.Thread(
             target=self._worker, name="veomni-prefetch", daemon=True
         )
@@ -102,6 +109,7 @@ class BackgroundPrefetcher:
             if self._finished is not StopIteration:
                 raise self._finished
             raise StopIteration
+        t_wait = time.perf_counter()
         while True:
             if self._closed:
                 raise PrefetcherClosed("prefetcher closed while awaiting a batch")
@@ -121,6 +129,8 @@ class BackgroundPrefetcher:
                 # where the data pipeline actually failed
                 raise err
             raise StopIteration
+        self._m_wait.observe(time.perf_counter() - t_wait)
+        self._m_depth.set(self._queue.qsize())
         self._consumed_state = snap
         return batch
 

@@ -1,0 +1,69 @@
+"""Unified observability: one layer every hot subsystem emits into.
+
+The paper's framework lives or dies on utilization, and the first question
+about any slow step is *where the time went* — data, dispatch, checkpoint,
+host callbacks, or a recompile. This package answers it without attaching a
+full profiler:
+
+* ``metrics``  — process-wide, thread-safe :class:`MetricsRegistry`
+                 (counters, gauges, bounded-reservoir histograms with
+                 p50/p95/max), rank-aware, with a rank-local JSONL sink and
+                 pluggable export hooks.
+* ``spans``    — near-zero-overhead host-side span tracing
+                 (``with span("data.wait"): ...``) feeding duration
+                 histograms, mirrored into ``jax.profiler.TraceAnnotation``
+                 when a device trace is active, and dumpable as chrome-trace
+                 JSON (``scripts/merge_chrome_trace.py`` consumes it).
+* ``goodput``  — per-window wall-time decomposition (data-wait /
+                 host-callback / dispatch / checkpoint / other), a goodput
+                 percentage, live device-memory gauges, and a recompile
+                 detector over the ``TRACE_COUNTS`` machinery.
+* ``exporter`` — optional stdlib-only HTTP daemon serving ``/metrics``
+                 (Prometheus text) and ``/healthz`` (resilience supervisor
+                 state), shared by the trainer and ``serving.InferenceEngine``.
+
+``callback.ObservabilityCallback`` (imported lazily by the trainer — it
+depends on ``trainer.callbacks``) ties the four together in the train loop.
+See ``docs/observability.md``.
+"""
+
+from veomni_tpu.observability.exporter import MetricsExporter, render_prometheus
+from veomni_tpu.observability.goodput import (
+    GoodputTracker,
+    RecompileDetector,
+    update_memory_gauges,
+)
+from veomni_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from veomni_tpu.observability.spans import (
+    disable_spans,
+    dump_chrome_trace,
+    enable_spans,
+    span,
+    spans_enabled,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "GoodputTracker",
+    "Histogram",
+    "MetricsExporter",
+    "MetricsRegistry",
+    "RecompileDetector",
+    "disable_spans",
+    "dump_chrome_trace",
+    "enable_spans",
+    "get_registry",
+    "render_prometheus",
+    "set_registry",
+    "span",
+    "spans_enabled",
+    "update_memory_gauges",
+]

@@ -1,0 +1,117 @@
+"""ObservabilityCallback: the train loop's wiring into the registry.
+
+Imported lazily by ``BaseTrainer._init_callbacks`` (this module depends on
+``trainer.callbacks``; everything else in ``observability`` is trainer-
+agnostic). Placed right after ``EnvironMeterCallback`` so the published
+payload already contains the meter's throughput/MFU rollup, and before
+``LoggingCallback``/``WandbCallback`` so their export-hook consumption sees
+this step's publish.
+
+Per sync step (the loop's existing host<->device sync cadence — zero added
+syncs): closes the goodput window, refreshes memory gauges, publishes
+``train.*`` gauges, and fires ``registry.export`` (JSONL sink + hooks).
+Every step: checks the recompile detector (a host-side dict compare).
+"""
+
+from __future__ import annotations
+
+import os
+
+from veomni_tpu.observability.exporter import MetricsExporter, resolve_port
+from veomni_tpu.observability.goodput import (
+    GoodputTracker,
+    RecompileDetector,
+    update_memory_gauges,
+)
+from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.observability.spans import (
+    dump_chrome_trace,
+    enable_spans,
+)
+from veomni_tpu.trainer.callbacks import Callback
+from veomni_tpu.utils.helper import host_floats
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ObservabilityCallback(Callback):
+    def __init__(self):
+        self.registry = None
+        self.tracker = None
+        self.detector = None
+        self.exporter = None
+        self._chrome_trace_path = ""
+        self._armed = False
+
+    def on_train_begin(self, trainer, state):
+        t = trainer.args.train
+        self.registry = get_registry()
+        if t.observability_spans:
+            enable_spans()
+        if t.observability_jsonl:
+            path = os.path.join(
+                t.output_dir, f"metrics_rank{self.registry.rank()}.jsonl"
+            )
+            self.registry.attach_jsonl(path)
+        self._chrome_trace_path = t.observability_chrome_trace
+        self.tracker = GoodputTracker(self.registry)
+        from veomni_tpu.train import train_step as train_step_mod
+
+        # watch ONLY the train step: a first eval jit or a decode bucket
+        # compile is a fresh program, not a steady-state retrace
+        self.detector = RecompileDetector(
+            [("train_step", train_step_mod.TRACE_COUNTS, ("train_step",))],
+            shape_source=train_step_mod.LAST_TRACE_SHAPES,
+            registry=self.registry,
+        )
+        port = resolve_port(t.observability_port)
+        if port is not None:
+            sup = getattr(trainer, "_supervisor", None)
+            health_fn = sup.health if sup is not None else None
+            self.exporter = MetricsExporter(
+                port=port, registry=self.registry, health_fn=health_fn
+            )
+            self.exporter.start()
+        self.tracker.begin_window()
+        self._armed = False
+
+    def on_step_end(self, trainer, state):
+        if not self._armed:
+            # absorb the warmup compile of step 1; everything after is a
+            # recompile worth shouting about
+            self.detector.arm()
+            self._armed = True
+        else:
+            self.detector.check()
+        if not state.synced:
+            return
+        state.metrics.update(self.tracker.end_window())
+        state.metrics["recompiles"] = float(self.detector.total_recompiles)
+        update_memory_gauges(self.registry)
+        payload = host_floats(state.metrics)
+        self.registry.set_gauges("train", payload)
+        self.registry.export(state.global_step, payload)
+
+    def on_train_end(self, trainer, state):
+        if self.registry is None:  # train() without on_train_begin (tests)
+            return
+        if self.tracker is not None:
+            state.metrics.update(self.tracker.end_window())
+        payload = host_floats(state.metrics)
+        self.registry.set_gauges("train", payload)
+        self.registry.export(state.global_step, payload)
+        if self._chrome_trace_path:
+            n = dump_chrome_trace(self._chrome_trace_path)
+            logger.info_rank0(
+                "wrote %d host span events to %s", n, self._chrome_trace_path
+            )
+        self.close()
+
+    def close(self):
+        """Exception-safe teardown (BaseTrainer calls every callback's
+        ``close`` in its finally block): the exporter thread must not
+        outlive a crashed run."""
+        if self.exporter is not None:
+            self.exporter.stop()
+            self.exporter = None

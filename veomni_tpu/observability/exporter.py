@@ -1,0 +1,169 @@
+"""Stdlib-only Prometheus exporter + health endpoint.
+
+One daemon thread, zero dependencies: ``/metrics`` renders the registry in
+Prometheus text exposition format 0.0.4; ``/healthz`` serves a JSON health
+document (the trainer wires it to the resilience supervisor's state — a
+scraper or k8s probe sees rollbacks/aborts without log scraping). Usable by
+both the trainer (``train.observability_port`` / ``VEOMNI_METRICS_PORT``)
+and ``serving.InferenceEngine`` (``scripts/serve.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from veomni_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "veomni_" + _NAME_RE.sub("_", name)
+
+
+def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Registry -> Prometheus text format. Counters/gauges map directly;
+    histograms render as summaries (quantile labels + _sum/_count) plus a
+    ``_max`` gauge (p100 is the stall-hunting number quantiles smear)."""
+    reg = registry or get_registry()
+    rank = str(reg.rank())
+    lines = []
+    for name, m in reg.items_snapshot():
+        pname = _prom_name(name)
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f'{pname}{{rank="{rank}"}} {m.value}')
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f'{pname}{{rank="{rank}"}} {m.value}')
+        elif isinstance(m, Histogram):
+            snap = m.snapshot()
+            lines.append(f"# TYPE {pname} summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95")):
+                if key in snap:
+                    lines.append(
+                        f'{pname}{{rank="{rank}",quantile="{q}"}} {snap[key]}'
+                    )
+            lines.append(f'{pname}_sum{{rank="{rank}"}} {snap["sum"]}')
+            lines.append(f'{pname}_count{{rank="{rank}"}} {int(snap["count"])}')
+            if "max" in snap:
+                lines.append(f"# TYPE {pname}_max gauge")
+                lines.append(f'{pname}_max{{rank="{rank}"}} {snap["max"]}')
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """Daemon-thread HTTP server for ``/metrics`` and ``/healthz``.
+
+    ``port=0`` binds an ephemeral port (tests); :meth:`start` returns the
+    actual port. ``health_fn`` returns a JSON-serializable dict; a falsy
+    ``"healthy"`` key turns the response into a 503 so load balancers and
+    probes need no body parsing."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 registry: Optional[MetricsRegistry] = None,
+                 health_fn: Optional[Callable[[], Dict]] = None):
+        self.requested_port = port
+        self.host = host
+        self.registry = registry  # None -> resolve the global lazily
+        self.health_fn = health_fn
+        self.port: Optional[int] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        if self._server is not None:
+            return self.port
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # no per-scrape stderr spam
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = render_prometheus(exporter.registry).encode()
+                        self._send(200, body,
+                                   "text/plain; version=0.0.4; charset=utf-8")
+                    elif self.path.split("?")[0] == "/healthz":
+                        doc = {"healthy": True}
+                        if exporter.health_fn is not None:
+                            doc = dict(exporter.health_fn())
+                        code = 200 if doc.get("healthy", True) else 503
+                        self._send(code, json.dumps(doc).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b"not found", "text/plain")
+                except Exception as e:  # a broken scrape must not kill us
+                    try:
+                        self._send(500, str(e).encode(), "text/plain")
+                    except Exception:
+                        pass
+
+        self._server = ThreadingHTTPServer((self.host, self.requested_port),
+                                           Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="veomni-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info_rank0(
+            "metrics exporter serving /metrics and /healthz on %s:%d",
+            self.host, self.port,
+        )
+        return self.port
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def resolve_port(config_port: int = 0) -> Optional[int]:
+    """Effective exporter port: ``VEOMNI_METRICS_PORT`` overrides the config
+    knob. ``0``/unset disables; negative means "ephemeral" (tests)."""
+    raw = os.environ.get("VEOMNI_METRICS_PORT", "").strip()
+    port = int(raw) if raw else config_port
+    if port == 0:
+        return None
+    return max(port, 0)  # negative -> 0 -> ephemeral bind
+
+
+def maybe_start_from_env(registry: Optional[MetricsRegistry] = None,
+                         health_fn: Optional[Callable[[], Dict]] = None,
+                         config_port: int = 0) -> Optional[MetricsExporter]:
+    """Start an exporter iff configured; returns it (caller owns stop())."""
+    port = resolve_port(config_port)
+    if port is None:
+        return None
+    exp = MetricsExporter(port=port, registry=registry, health_fn=health_fn)
+    exp.start()
+    return exp

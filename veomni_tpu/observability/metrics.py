@@ -1,0 +1,330 @@
+"""Process-wide, thread-safe metrics registry.
+
+Three instrument kinds, all safe to touch from any thread (prefetch worker,
+checkpoint commit thread, exporter HTTP thread, train loop):
+
+* :class:`Counter`   — monotonically increasing float.
+* :class:`Gauge`     — last-write-wins float.
+* :class:`Histogram` — bounded reservoir (exact count/sum/min/max; p50/p95
+  from a deterministic reservoir sample so memory stays O(max_samples) no
+  matter how many steps a run observes).
+
+The registry is *rank-aware* without ever forcing a backend init: rank is
+resolved through the same lazy path the logger uses (a metrics call must
+never be the thing that claims a TPU chip — see ``utils/logging.py``).
+
+Two egress paths, both pull-free for the hot loop:
+
+* ``attach_jsonl(path)`` — a rank-local JSONL sink; every ``export()`` call
+  (the trainer's sync cadence) appends one line.
+* ``add_export_hook(fn)`` — pluggable consumers (``WandbCallback``,
+  ``LoggingCallback``) receive the merged ``(step, payload)`` instead of
+  reaching into ``state.metrics`` directly.
+
+The Prometheus text rendering lives in ``observability/exporter.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from veomni_tpu.utils.logging import _process_index, get_logger
+
+logger = get_logger(__name__)
+
+
+class Counter:
+    """Monotonic counter. ``inc`` of a negative amount is rejected."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str, lock: threading.RLock):
+        self.name = name
+        self._value = 0.0
+        self._lock = lock
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative increment {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"value": self._value}
+
+
+class Gauge:
+    """Last-write-wins value (queue depth, utilization, live memory)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str, lock: threading.RLock):
+        self.name = name
+        self._value = 0.0
+        self._lock = lock
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def snapshot(self) -> Dict[str, float]:
+        return {"value": self._value}
+
+
+class Histogram:
+    """Bounded-reservoir duration/size distribution.
+
+    count/sum/min/max are exact over every observation; percentiles come
+    from an Algorithm-R reservoir (deterministically seeded per name, so a
+    fixed observation sequence yields fixed percentiles — tests and bit-
+    exact replay drills stay reproducible)."""
+
+    __slots__ = ("name", "_lock", "_samples", "_max_samples", "_count",
+                 "_sum", "_min", "_max", "_rng")
+
+    def __init__(self, name: str, lock: threading.RLock, max_samples: int = 512):
+        if max_samples < 1:
+            raise ValueError("max_samples must be >= 1")
+        self.name = name
+        self._lock = lock
+        self._samples: List[float] = []
+        self._max_samples = max_samples
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        # crc32, not hash(): str hash is salted per process, which would
+        # break the cross-restart reproducibility promised above
+        self._rng = random.Random(0xC0FFEE ^ zlib.crc32(name.encode()))
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            if value < self._min:
+                self._min = value
+            if value > self._max:
+                self._max = value
+            if len(self._samples) < self._max_samples:
+                self._samples.append(value)
+            else:
+                j = self._rng.randrange(self._count)
+                if j < self._max_samples:
+                    self._samples[j] = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the reservoir, q in [0, 100]."""
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            ordered = sorted(self._samples)
+            idx = min(len(ordered) - 1, max(0, round(q / 100.0 * (len(ordered) - 1))))
+            return ordered[idx]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            if not self._count:
+                return {"count": 0.0, "sum": 0.0}
+            return {
+                "count": float(self._count),
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+                "mean": self._sum / self._count,
+                "p50": self.percentile(50),
+                "p95": self.percentile(95),
+            }
+
+
+class MetricsRegistry:
+    """Name -> instrument map with JSONL + hook egress.
+
+    Instrument creation is get-or-create (two subsystems asking for the same
+    counter share it); asking for an existing name as a different kind is a
+    programming error and raises."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, Any] = {}
+        self._hooks: List[Callable[[int, Dict[str, float]], None]] = []
+        self._jsonl_path: Optional[str] = None
+        self._last_export: Optional[tuple] = None
+
+    # ------------------------------------------------------------ instruments
+    def _get_or_create(self, name: str, kind, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = kind(name, self._lock, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {kind.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str, max_samples: int = 512) -> Histogram:
+        return self._get_or_create(name, Histogram, max_samples=max_samples)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def histogram_sum(self, name: str) -> float:
+        """Cumulative sum of a histogram, 0.0 if it doesn't exist yet (the
+        goodput tracker deltas span histograms that may not have fired)."""
+        m = self._metrics.get(name)
+        return m.sum if isinstance(m, Histogram) else 0.0
+
+    def items_snapshot(self) -> List[tuple]:
+        """Stable-ordered (name, instrument) pairs for renderers."""
+        with self._lock:
+            return sorted(self._metrics.items())
+
+    # ---------------------------------------------------------------- egress
+    def export_scalars(self) -> Dict[str, float]:
+        """Flatten every instrument to plain floats (histograms expand to
+        ``name.p50`` / ``.p95`` / ``.max`` / ``.mean`` / ``.count``)."""
+        out: Dict[str, float] = {}
+        for name, m in self.items_snapshot():
+            if isinstance(m, Histogram):
+                snap = m.snapshot()
+                for k in ("p50", "p95", "max", "mean", "count"):
+                    if k in snap:
+                        out[f"{name}.{k}"] = snap[k]
+            else:
+                out[name] = m.value
+        return out
+
+    def set_gauges(self, prefix: str, values: Dict[str, Any]) -> None:
+        """Publish a dict of host floats as ``{prefix}.{key}`` gauges
+        (non-numeric values are skipped — device futures must never be
+        fetched here)."""
+        for k, v in values.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.gauge(f"{prefix}.{k}").set(v)
+
+    def add_export_hook(self, fn: Callable[[int, Dict[str, float]], None]) -> None:
+        with self._lock:
+            if fn not in self._hooks:
+                self._hooks.append(fn)
+
+    def remove_export_hook(self, fn) -> None:
+        with self._lock:
+            if fn in self._hooks:
+                self._hooks.remove(fn)
+
+    def attach_jsonl(self, path: str) -> None:
+        """Rank-local JSONL sink: every ``export()`` appends one line to
+        ``path``. Re-attaching switches files (a resumed run appends to the
+        new run's sink)."""
+        with self._lock:
+            self._jsonl_path = path
+
+    def export(self, step: int, payload: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, float]:
+        """Merge registry scalars with ``payload`` (step metrics already on
+        host), write the JSONL line, fire export hooks, return the merged
+        dict. Hook/sink failures are logged, never raised — observability
+        must not kill a training step."""
+        merged = self.export_scalars()
+        if payload:
+            merged.update({
+                k: v for k, v in payload.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            })
+        with self._lock:
+            self._last_export = (step, merged)
+            path, hooks = self._jsonl_path, list(self._hooks)
+        if path:
+            try:
+                with open(path, "a") as f:
+                    f.write(json.dumps({
+                        "ts": time.time(), "step": step,
+                        "rank": _process_index(), **merged,
+                    }) + "\n")
+            except OSError as e:
+                logger.warning("metrics JSONL write failed: %s", e)
+        for fn in hooks:
+            try:
+                fn(step, merged)
+            except Exception as e:
+                logger.warning("metrics export hook %r failed: %s", fn, e)
+        return merged
+
+    def last_export(self, step: Optional[int] = None
+                    ) -> Optional[Dict[str, float]]:
+        """The most recent ``export()`` payload; with ``step`` given, only
+        if it matches (consumers use this to detect a fresh publish)."""
+        last = self._last_export
+        if last is None:
+            return None
+        if step is not None and last[0] != step:
+            return None
+        return last[1]
+
+    def rank(self) -> int:
+        """Lazy rank (never the thing that initializes the backend)."""
+        return _process_index()
+
+    def reset(self) -> None:
+        """Drop every instrument + sink (tests)."""
+        with self._lock:
+            self._metrics.clear()
+            self._hooks.clear()
+            self._jsonl_path = None
+            self._last_export = None
+
+
+_GLOBAL: Optional[MetricsRegistry] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem emits into."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = MetricsRegistry()
+    return _GLOBAL
+
+
+def set_registry(registry: Optional[MetricsRegistry]) -> Optional[MetricsRegistry]:
+    """Swap the global registry (tests); returns the previous one."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        prev, _GLOBAL = _GLOBAL, registry
+    return prev

@@ -1,0 +1,158 @@
+"""Host-side span tracing: ``with span("data.wait"): ...``.
+
+Design constraints, in order:
+
+1. **Disabled ≈ free.** ``span()`` with tracing off returns one shared
+   no-op context manager — no allocation, no clock read, no dict lookup.
+   The trainer can leave call sites in the hot loop unconditionally.
+2. **Always feeds histograms when enabled.** Every span exit observes its
+   duration into the registry histogram ``span.<name>`` — that is what the
+   goodput decomposition deltas, so spans are useful with zero extra
+   machinery running.
+3. **Mirrors into the device profiler only when one is active.** When
+   ``jax.profiler`` has a trace running (``set_profiler_active(True)``, set
+   by ``ProfileCallback``), each span also opens a
+   ``jax.profiler.TraceAnnotation`` so host phases line up with device ops
+   in the heavyweight trace — but the heavyweight path is never *required*.
+4. **Self-serve chrome traces.** Completed spans land in a bounded ring
+   buffer; :func:`dump_chrome_trace` writes chrome-trace JSON (gzip by
+   extension) that ``scripts/merge_chrome_trace.py`` merges across hosts —
+   a stall timeline without ever starting the profiler.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.utils.logging import _process_index
+
+_enabled = False
+_profiler_active = False
+_epoch_ns: Optional[int] = None
+_events: deque = deque(maxlen=100_000)  # (name, t0_ns, dur_ns, tid)
+_tid_lock = threading.Lock()
+_tids: dict = {}  # thread ident -> small stable int
+
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _tids.get(ident)
+    if t is None:
+        with _tid_lock:
+            t = _tids.setdefault(ident, len(_tids))
+    return t
+
+
+class _Span:
+    __slots__ = ("name", "_t0", "_annot")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._annot = None
+
+    def __enter__(self):
+        if _profiler_active:
+            import jax.profiler
+
+            self._annot = jax.profiler.TraceAnnotation(self.name)
+            self._annot.__enter__()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur_ns = time.perf_counter_ns() - self._t0
+        if self._annot is not None:
+            self._annot.__exit__(*exc)
+            self._annot = None
+        get_registry().histogram(f"span.{self.name}").observe(dur_ns * 1e-9)
+        _events.append((self.name, self._t0, dur_ns, _tid()))
+        return False
+
+
+def span(name: str):
+    """Time a host phase. Returns the shared no-op when tracing is off."""
+    return _Span(name) if _enabled else _NULL
+
+
+def spans_enabled() -> bool:
+    return _enabled
+
+
+def enable_spans(max_events: int = 100_000) -> None:
+    """Turn tracing on; resizes the event ring if ``max_events`` changed.
+    The chrome-trace epoch is pinned on first enable so ts offsets stay
+    comparable across enable/disable cycles in one process."""
+    global _enabled, _epoch_ns, _events
+    if _epoch_ns is None:
+        _epoch_ns = time.perf_counter_ns()
+    if _events.maxlen != max_events:
+        _events = deque(_events, maxlen=max_events)
+    _enabled = True
+
+
+def disable_spans() -> None:
+    global _enabled
+    _enabled = False
+
+
+def set_profiler_active(active: bool) -> None:
+    """ProfileCallback toggles this around start/stop_trace so spans mirror
+    into ``jax.profiler.TraceAnnotation`` exactly while a trace runs."""
+    global _profiler_active
+    _profiler_active = bool(active)
+
+
+def clear_events() -> None:
+    _events.clear()
+
+
+def dump_chrome_trace(path: str) -> int:
+    """Write the span ring buffer as chrome-trace JSON ("X" complete
+    events, µs timebase; pid = process rank so multi-host merges group
+    naturally). Returns the number of span events written."""
+    epoch = _epoch_ns if _epoch_ns is not None else time.perf_counter_ns()
+    rank = _process_index()
+    events = list(_events)
+    trace = [{
+        "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+        "args": {"name": f"veomni host spans (rank {rank})"},
+    }]
+    for ident, t in sorted(_tids.items(), key=lambda kv: kv[1]):
+        trace.append({
+            "name": "thread_name", "ph": "M", "pid": rank, "tid": t,
+            "args": {"name": f"thread-{ident}"},
+        })
+    for name, t0_ns, dur_ns, tid in events:
+        trace.append({
+            "name": name, "cat": "host", "ph": "X", "pid": rank, "tid": tid,
+            "ts": (t0_ns - epoch) / 1e3, "dur": dur_ns / 1e3,
+        })
+    payload = {"traceEvents": trace, "displayTimeUnit": "ms"}
+    if path.endswith(".gz"):
+        with gzip.open(path, "wt") as f:
+            json.dump(payload, f)
+    else:
+        with open(path, "w") as f:
+            json.dump(payload, f)
+    return len(events)

@@ -37,6 +37,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.resilience.faults import fault_point
 from veomni_tpu.utils.logging import get_logger
 
@@ -98,6 +99,7 @@ class TrainSupervisor:
         self.rollbacks = 0
         self.stalls = 0
         self.anomaly_steps: List[int] = []
+        self.last_verdict = "ok"
 
     # ---------------------------------------------------------- observation
     def observe(self, step: int, metrics: Dict[str, Any]) -> str:
@@ -139,6 +141,7 @@ class TrainSupervisor:
             self.consec_start = None
             return "ok"
         self.anomalies += 1
+        get_registry().counter("resilience.anomalies").inc()
         self.consecutive += 1
         if self.consecutive == 1:
             self.consec_start = step
@@ -150,19 +153,31 @@ class TrainSupervisor:
             self.consecutive, self.anomalies, self.policy.anomaly_budget,
         )
         if self.anomalies > self.policy.anomaly_budget:
-            return "abort"
+            return self._verdict("abort")
         if self.consecutive >= self.policy.rollback_after:
             if self.rollbacks >= self.policy.max_rollbacks:
-                return "abort"
-            return "rollback"
-        return "skip"
+                return self._verdict("abort")
+            return self._verdict("rollback")
+        return self._verdict("skip")
+
+    def _verdict(self, v: str) -> str:
+        # "abort" is sticky for /healthz; skip/rollback clear when the
+        # trajectory recovers (note_rollback) — a probe must flip unhealthy
+        # the moment the budget is blown, even if the raise is still queued
+        self.last_verdict = worse_verdict(self.last_verdict, v)
+        if v == "skip":
+            get_registry().counter("resilience.skips").inc()
+        return v
 
     # ------------------------------------------------------------ lifecycle
     def note_rollback(self, to_step: int) -> None:
         self.rollbacks += 1
+        get_registry().counter("resilience.rollbacks").inc()
         self.consecutive = 0
         self.consec_start = None
         self._inflight.clear()  # futures from the abandoned trajectory
+        if self.last_verdict != "abort":
+            self.last_verdict = "ok"  # trajectory restored; probe recovers
         logger.warning_rank0(
             "rolled back to checkpoint step %d (rollback %d/%d)",
             to_step, self.rollbacks, self.policy.max_rollbacks,
@@ -170,6 +185,7 @@ class TrainSupervisor:
 
     def note_stall(self, stack_dump: str) -> None:
         self.stalls += 1
+        get_registry().counter("resilience.stalls").inc()
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -177,6 +193,17 @@ class TrainSupervisor:
             "anomaly_steps": list(self.anomaly_steps),
             "rollbacks": self.rollbacks,
             "watchdog_stalls": self.stalls,
+        }
+
+    def health(self) -> Dict[str, Any]:
+        """/healthz document (observability exporter): healthy until the
+        anomaly budget blows (``abort`` is sticky); a mid-escalation
+        skip/rollback reports degraded-but-healthy with full context."""
+        return {
+            "healthy": self.last_verdict != "abort",
+            "last_verdict": self.last_verdict,
+            "consecutive_anomalies": self.consecutive,
+            **self.stats(),
         }
 
 

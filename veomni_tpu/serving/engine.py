@@ -35,6 +35,8 @@ import numpy as np
 from veomni_tpu.models import decode as decode_mod
 from veomni_tpu.models.config import TransformerConfig
 from veomni_tpu.models.decode import supports_cached_decode
+from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.observability.spans import span
 from veomni_tpu.serving.api import (
     Request,
     RequestOutput,
@@ -112,6 +114,17 @@ class InferenceEngine:
         self._total_generated = 0
         self._window_tokens = 0
         self._window_t0 = time.perf_counter()
+        # observability registry: same surface the trainer exports through,
+        # so one /metrics endpoint covers both (docs/observability.md)
+        reg = get_registry()
+        self._m_requests = reg.counter("serve.requests")
+        self._m_tokens = reg.counter("serve.generated_tokens")
+        self._m_ttft = reg.histogram("serve.ttft_s")
+        self._m_queue = reg.gauge("serve.queue_depth")
+        self._m_running = reg.gauge("serve.num_running")
+        self._m_kv = reg.gauge("serve.kv_utilization")
+        self._m_preempt = reg.gauge("serve.preemptions")
+        self._m_tps = reg.gauge("serve.decode_tokens_per_sec")
 
     # ------------------------------------------------------------ jit plumbing
     def _build_decode_step(self):
@@ -169,6 +182,8 @@ class InferenceEngine:
             rng=np.asarray(jax.random.PRNGKey(sp.seed)),
         )
         self.scheduler.add(seq)
+        self._m_requests.inc()
+        self._m_queue.set(self.scheduler.queue_depth)
         self._outputs[request.request_id] = RequestOutput(
             request_id=request.request_id,
             prompt_ids=list(request.prompt_ids),
@@ -185,16 +200,22 @@ class InferenceEngine:
         Returns every token event produced this tick."""
         events: List[StreamEvent] = []
         for seq in self.scheduler.admit():
-            events.extend(self._prefill_seq(seq))
+            with span("serve.prefill"):
+                events.extend(self._prefill_seq(seq))
         self.scheduler.ensure_decode_capacity()
         if self.scheduler.num_running:
-            events.extend(self._decode_tick())
+            with span("serve.decode"):
+                events.extend(self._decode_tick())
         elif not events and self.scheduler.has_work:
             raise RuntimeError(
                 "scheduler stalled: waiting requests but nothing running "
                 "and nothing admissible (pool misconfigured?)"
             )
         self._step_counter += 1
+        self._m_queue.set(self.scheduler.queue_depth)
+        self._m_running.set(self.scheduler.num_running)
+        self._m_kv.set(self.blocks.utilization())
+        self._m_preempt.set(self.scheduler.preemption_count)
         le = self.config.log_every_steps
         if le and self._step_counter % le == 0:
             # non-resetting read: periodic logging must not clobber the
@@ -273,6 +294,7 @@ class InferenceEngine:
             self._outputs[seq.seq_id].ttft_s = ttft
             self._ttft_sum += ttft
             self._ttft_n += 1
+            self._m_ttft.observe(ttft)
         seq.prefill_len = pt
         seq.pos = pt  # the pending token's write position
         return [self._emit(seq, first)]
@@ -327,6 +349,7 @@ class InferenceEngine:
         seq.generated.append(token)
         self._window_tokens += 1
         self._total_generated += 1
+        self._m_tokens.inc()
         sp = seq.request.sampling
         out = self._outputs[seq.seq_id]
         out.token_ids.append(token)
@@ -365,6 +388,9 @@ class InferenceEngine:
         if self._ttft_n:
             m["ttft_avg_s"] = self._ttft_sum / self._ttft_n
         if reset_window:
+            # the resetting caller owns the throughput window; mirror its
+            # reading to the exporter gauge
+            self._m_tps.set(m["decode_tokens_per_sec"])
             self._window_tokens = 0
             self._window_t0 = now
         return host_floats(m)

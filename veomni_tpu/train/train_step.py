@@ -32,6 +32,14 @@ from veomni_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# Trace-time counters, same discipline as ``models/decode.py::TRACE_COUNTS``:
+# the jitted step body increments at TRACE time only, so a steady-state run
+# holds the count flat and any later bump is a recompile. The observability
+# recompile detector (``observability/goodput.py``) watches these and logs
+# the offending shapes from LAST_TRACE_SHAPES.
+TRACE_COUNTS: Dict[str, int] = {"train_step": 0, "eval_step": 0}
+LAST_TRACE_SHAPES: Dict[str, Any] = {}
+
 
 @flax.struct.dataclass
 class TrainState:
@@ -111,6 +119,10 @@ def build_train_step(
         return grads, loss_sum, metrics["ntokens"], extras
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+        TRACE_COUNTS["train_step"] += 1  # trace-time only
+        LAST_TRACE_SHAPES["train_step"] = {
+            k: tuple(v.shape) for k, v in batch.items()
+        }
         params = state.params
 
         def accum(carry, micro):
@@ -177,6 +189,10 @@ def build_train_step(
 
 def build_eval_step(loss_fn: Callable, state_shardings=None, batch_shardings=None):
     def eval_fn(params, batch):
+        TRACE_COUNTS["eval_step"] += 1  # trace-time only
+        LAST_TRACE_SHAPES["eval_step"] = {
+            k: tuple(v.shape) for k, v in batch.items()
+        }
         loss_sum, metrics = loss_fn(params, batch)
         return {"loss": loss_sum / jnp.maximum(metrics["ntokens"], 1), **metrics}
 

@@ -25,6 +25,7 @@ from veomni_tpu.data.data_loader import build_dataloader
 from veomni_tpu.data.data_transform import build_data_transform
 from veomni_tpu.data.dataset import build_dataset
 from veomni_tpu.models import build_foundation_model, build_tokenizer
+from veomni_tpu.observability.spans import span
 from veomni_tpu.optim import build_lr_scheduler, build_optimizer
 from veomni_tpu.parallel import init_parallel_state, use_parallel_state
 from veomni_tpu.train import build_train_state, build_train_step
@@ -437,9 +438,14 @@ class BaseTrainer:
         return lambda params, batch: model.loss_fn(params, batch)
 
     def _init_callbacks(self):
+        from veomni_tpu.observability.callback import ObservabilityCallback
+
         t = self.args.train
         self.callbacks = [
             EnvironMeterCallback(self.meter),
+            # after the meter (its rollup must be in the published payload),
+            # before Logging/Wandb (they consume the registry export)
+            ObservabilityCallback(),
             LoggingCallback(),
             CheckpointCallback(self.checkpointer, t.save_steps),
         ]
@@ -658,6 +664,8 @@ class BaseTrainer:
         arm_from_env()  # VEOMNI_FAULT_PLAN (tests/chaos drills); no-op else
         ctl = TrainerControlState(train_steps=self.train_steps)
         sup = TrainSupervisor(SupervisorPolicy.from_train_args(t))
+        # the observability callback wires /healthz to the supervisor state
+        self._supervisor = sup
         with use_parallel_state(self.parallel_state):
             self._fire("on_train_begin", ctl)
             # prefetcher construction AFTER on_train_begin: auto-resume
@@ -687,19 +695,23 @@ class BaseTrainer:
                             if shutdown.requested:
                                 break
                             try:
-                                batch_np = next(data_iter)
+                                with span("data.wait"):
+                                    batch_np = next(data_iter)
                             except Exception:
                                 if shutdown.requested:
                                     break  # prefetcher closed by the handler
                                 raise
                             self.current_batch = batch_np
-                            self._fire("on_step_begin", ctl)
+                            with span("host.callbacks"):
+                                self._fire("on_step_begin", ctl)
                             # each process holds [A, B_local, S]; stitch into
                             # the globally-sharded array (single-controller)
-                            batch = self._ship_batch(batch_np)
-                            self.train_state, metrics = self.train_step(
-                                self.train_state, batch
-                            )
+                            with span("data.ship"):
+                                batch = self._ship_batch(batch_np)
+                            with span("step.dispatch"):
+                                self.train_state, metrics = self.train_step(
+                                    self.train_state, batch
+                                )
                             ctl.global_step += 1
                             verdict = sup.observe(ctl.global_step, metrics)
                             watchdog.pet()
@@ -713,10 +725,16 @@ class BaseTrainer:
                                 or ctl.global_step >= self.train_steps
                             )
                             if ctl.synced:
-                                metrics = {
-                                    k: (float(v) if np.ndim(v) == 0 else np.asarray(v))
-                                    for k, v in metrics.items()
-                                }
+                                # the device fetch: on the async loop this
+                                # absorbs the window's real compute time, so
+                                # the span keeps it out of host-stall
+                                # attribution ("other" in the goodput split)
+                                with span("sync.fetch"):
+                                    metrics = {
+                                        k: (float(v) if np.ndim(v) == 0
+                                            else np.asarray(v))
+                                        for k, v in metrics.items()
+                                    }
                             ctl.metrics = dict(metrics)
                             if ctl.synced:
                                 # optax evaluated the schedule at count ==
@@ -737,7 +755,8 @@ class BaseTrainer:
                                 if verdict in ("ok", "skip"):
                                     verdict = worse_verdict(verdict, sup.drain())
                                 ctl.resilience = sup.stats()
-                            self._fire("on_step_end", ctl)
+                            with span("host.callbacks"):
+                                self._fire("on_step_end", ctl)
                             if verdict == "rollback":
                                 data_iter = self._rollback(ctl, sup)
                             elif verdict == "abort":
@@ -782,4 +801,16 @@ class BaseTrainer:
                     self._fire("on_train_end", ctl)
             finally:
                 self._close_prefetcher()
+                # exception path skips on_train_end (an abort must not run
+                # the final-checkpoint hooks) but resource-holding callbacks
+                # still need teardown: an active jax.profiler trace or a
+                # live exporter thread must not leak past a crashed run
+                for cb in self.callbacks:
+                    try:
+                        cb.close()
+                    except Exception as e:
+                        logger.warning_rank0(
+                            "callback %s close() failed: %s",
+                            type(cb).__name__, e,
+                        )
         return ctl

@@ -52,17 +52,40 @@ class Callback:
     def on_step_end(self, trainer, state: TrainerControlState):
         pass
 
+    def close(self):
+        """Exception-safe teardown: BaseTrainer calls this in its finally
+        block, so resource holders (profiler trace, exporter thread) release
+        even when the loop raises and ``on_train_end`` never fires. Must be
+        idempotent."""
+        pass
+
+
+def _export_payload(state: TrainerControlState) -> Dict[str, Any]:
+    """The metric payload consumers log: the observability registry's
+    export for this step (ObservabilityCallback publishes before the
+    logging callbacks run), falling back to ``state.metrics`` when the
+    observability layer isn't in the callback list (trainer-free tests)."""
+    from veomni_tpu.observability.metrics import get_registry
+
+    payload = get_registry().last_export(step=state.global_step)
+    return payload if payload is not None else state.metrics
+
 
 class LoggingCallback(Callback):
-    """Console log on the loop's sync cadence (train.log_steps)."""
+    """Console log on the loop's sync cadence (train.log_steps), fed from
+    the observability registry's export (one merged payload: step metrics +
+    goodput split + span/subsystem rollups)."""
+
+    KEYS = ("loss", "grad_norm", "lr", "tokens_per_sec_per_chip", "mfu",
+            "goodput_pct", "data_wait_frac")
 
     def on_step_end(self, trainer, state):
         if state.synced:
+            payload = _export_payload(state)
             parts = [f"step {state.global_step}/{state.train_steps}"]
-            for k in ("loss", "grad_norm", "lr", "tokens_per_sec_per_chip", "mfu"):
-                if k in state.metrics:
-                    v = state.metrics[k]
-                    parts.append(f"{k}={v:.4g}")
+            for k in self.KEYS:
+                if k in payload:
+                    parts.append(f"{k}={payload[k]:.4g}")
             logger.info_rank0(" | ".join(parts))
 
 
@@ -252,30 +275,56 @@ class HFCheckpointCallback(Callback):
 
 class ProfileCallback(Callback):
     """jax.profiler trace over [start_step, end_step)
-    (reference ProfileTraceCallback -> chrome trace; here Perfetto/XPlane)."""
+    (reference ProfileTraceCallback -> chrome trace; here Perfetto/XPlane).
+
+    ``VEOMNI_PROFILE_START`` / ``VEOMNI_PROFILE_END`` override the
+    configured window (re-profiling a deployed run without editing its
+    YAML). Stop is exception-safe: a raise inside the traced window (e.g. a
+    supervisor abort) leaves an active trace that would otherwise leak —
+    the trainer's finally block calls :meth:`close`, and every stop path is
+    double-stop-guarded because ``jax.profiler.stop_trace`` raises when no
+    trace is active."""
 
     def __init__(self, output_dir: str, start_step: int = 3, end_step: int = 5):
         self.dir = os.path.join(output_dir, "profile_trace")
-        self.start = start_step
-        self.end = end_step
+        self.start = int(os.environ.get("VEOMNI_PROFILE_START", start_step))
+        self.end = int(os.environ.get("VEOMNI_PROFILE_END", end_step))
         self._active = False
+
+    def _stop(self):
+        if not self._active:
+            return  # double-stop guard
+        self._active = False
+        from veomni_tpu.observability.spans import set_profiler_active
+
+        set_profiler_active(False)
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            # never let trace teardown mask the original failure
+            logger.warning_rank0("stop_trace failed: %s", e)
+            return
+        logger.info_rank0("profile trace written to %s", self.dir)
 
     def on_step_begin(self, trainer, state):
         if state.global_step == self.start and not self._active:
             os.makedirs(self.dir, exist_ok=True)
             jax.profiler.start_trace(self.dir)
             self._active = True
+            # host spans mirror into TraceAnnotations while the trace runs
+            from veomni_tpu.observability.spans import set_profiler_active
+
+            set_profiler_active(True)
 
     def on_step_end(self, trainer, state):
-        if state.global_step >= self.end and self._active:
-            jax.profiler.stop_trace()
-            self._active = False
-            logger.info_rank0("profile trace written to %s", self.dir)
+        if state.global_step >= self.end:
+            self._stop()
 
     def on_train_end(self, trainer, state):
-        if self._active:
-            jax.profiler.stop_trace()
-            self._active = False
+        self._stop()
+
+    def close(self):
+        self._stop()
 
 
 class WandbCallback(Callback):
@@ -301,7 +350,13 @@ class WandbCallback(Callback):
         # sync cadence — plus any step that produced host-side metrics
         # outside it (e.g. EvaluateCallback's eval_loss on eval_steps)
         if state.synced or "eval_loss" in state.metrics:
-            payload = self._host_floats(state.metrics)
+            # the registry export (step metrics + goodput + span/subsystem
+            # rollups), overlaid with state.metrics: callbacks that run
+            # AFTER the export (EvaluateCallback's eval_loss, channel
+            # losses) must not be dropped from the log
+            payload = self._host_floats(
+                {**_export_payload(state), **state.metrics}
+            )
             if payload:
                 self._run.log(payload, step=state.global_step)
 

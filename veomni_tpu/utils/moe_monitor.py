@@ -82,9 +82,34 @@ def capture_router_stats(model, params, batch) -> Dict[str, np.ndarray]:
     return {"expert_load": np.stack(loads) if loads else np.zeros((0, cfg.num_experts))}
 
 
+def publish_router_stats(load: "np.ndarray", registry=None) -> None:
+    """Per-layer router health -> registry gauges (``moe.layer{i}.*``):
+
+    * ``entropy``   — routing entropy in nats (ln E = perfectly balanced);
+    * ``max_load``  — the hottest expert's load fraction;
+    * ``drop_frac`` — load mass above the per-expert fair share, i.e. the
+      fraction a capacity-factor-1.0 dispatcher would drop. This impl
+      dispatches dropless, so it measures imbalance *pressure*, not actual
+      token loss.
+    """
+    from veomni_tpu.observability.metrics import get_registry
+
+    reg = registry or get_registry()
+    for li, row in enumerate(np.asarray(load, np.float64)):
+        nz = row[row > 0]
+        reg.gauge(f"moe.layer{li}.entropy").set(
+            float(-(nz * np.log(nz)).sum()) if len(nz) else 0.0
+        )
+        reg.gauge(f"moe.layer{li}.max_load").set(float(row.max()))
+        reg.gauge(f"moe.layer{li}.drop_frac").set(
+            float(np.clip(row - 1.0 / len(row), 0.0, None).sum())
+        )
+
+
 class MoERouterMonitorCallback(Callback):
-    """Periodically replays routing on the current batch and logs per-layer
-    expert load min/max (imbalance indicator)."""
+    """Periodically replays routing on the current batch, publishes
+    per-layer gauges (entropy / max-load / drop-fraction) through the
+    observability registry, and logs the min/max imbalance summary."""
 
     def __init__(self, every_steps: int = 100):
         self.every = every_steps
@@ -94,14 +119,13 @@ class MoERouterMonitorCallback(Callback):
             return
         if state.global_step % self.every:
             return
-        import numpy as np
-
         batch = {
             k: jnp.asarray(v[0]) for k, v in trainer.current_batch.items()
         }  # first micro-batch
         stats = capture_router_stats(trainer.model, trainer.train_state.params, batch)
         load = stats["expert_load"]
         if len(load):
+            publish_router_stats(load)
             logger.info_rank0(
                 "moe router load: min=%.3f max=%.3f (ideal %.3f) worst layer %d",
                 load.min(), load.max(), 1.0 / load.shape[1], int(load.max(1).argmax()),
