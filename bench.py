@@ -116,6 +116,27 @@ def _wait_for_backend(retry_s: float = 120.0):
             time.sleep(retry_s)
 
 
+# previous integrity-metric readings: each bench record reports the DELTA
+# since the last run_bench call (mfu_sweep.py ladders many configs in one
+# process — absolute registry values would re-report the first config's
+# restore traffic in every later record)
+_INTEGRITY_SNAP = {"verify_s": 0.0, "quarantined": 0, "fallbacks": 0}
+
+
+def _integrity_delta() -> dict:
+    from veomni_tpu.observability.metrics import get_registry
+
+    reg = get_registry()
+    cur = {
+        "verify_s": reg.histogram_sum("integrity.verify_s"),
+        "quarantined": int(reg.counter("integrity.ckpt_quarantined").value),
+        "fallbacks": int(reg.counter("integrity.ckpt_fallbacks").value),
+    }
+    delta = {k: cur[k] - _INTEGRITY_SNAP[k] for k in cur}
+    _INTEGRITY_SNAP.update(cur)
+    return delta
+
+
 def run_bench(
     seq_len: int,
     micro_bs: int,
@@ -222,6 +243,15 @@ def run_bench(
         gp = tracker.end_window()
         recompiles = train_step_mod.TRACE_COUNTS["train_step"] - traces0
 
+        # integrity trajectory: restore-verification time + quarantine and
+        # fallback counts for whatever checkpoint traffic this process did
+        # (zero for the pure-throughput path; scripts driving resume flows
+        # through run_bench see the real numbers)
+        _integ = _integrity_delta()
+        restore_verify_s = _integ["verify_s"]
+        ckpt_quarantined = _integ["quarantined"]
+        ckpt_fallbacks = _integ["fallbacks"]
+
         tokens = micro_bs * seq_len * steps
         tok_per_sec_chip = tokens / dt / n_chips
         flops = FlopsCounter.from_config(cfg).batch_flops(
@@ -240,7 +270,10 @@ def run_bench(
                 "ulysses_async": ulysses_async,
                 "goodput_pct": gp.get("goodput_pct", 0.0),
                 "data_wait_frac": gp.get("data_wait_frac", 0.0),
-                "recompiles": recompiles}
+                "recompiles": recompiles,
+                "restore_verify_s": restore_verify_s,
+                "ckpt_quarantined": ckpt_quarantined,
+                "ckpt_fallbacks": ckpt_fallbacks}
 
 
 def run_serve_bench(
@@ -403,6 +436,12 @@ def main():
         "goodput_pct": round(r["goodput_pct"], 2),
         "data_wait_frac": round(r["data_wait_frac"], 4),
         "recompiles": r["recompiles"],
+        # integrity trajectory (docs/resilience.md "Integrity & quarantine"):
+        # nonzero quarantine/fallback counts mean the measurement ran on a
+        # run that survived storage rot — worth knowing next to its MFU
+        "restore_verify_s": round(r["restore_verify_s"], 4),
+        "ckpt_quarantined": r["ckpt_quarantined"],
+        "ckpt_fallbacks": r["ckpt_fallbacks"],
     }), flush=True)
 
 

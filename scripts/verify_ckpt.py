@@ -1,0 +1,95 @@
+"""Operator CLI: verify checkpoint-generation integrity manifests.
+
+Walks a checkpoint directory (the trainer's ``<output_dir>/checkpoints``),
+prints a per-generation VerifyReport — OK / CORRUPT (with the per-file
+missing/truncated/mismatch classification) / UNVERIFIABLE (no manifest) /
+UNCOMMITTED — plus any already-quarantined ``*.corrupt`` corpses, and exits
+non-zero if anything is corrupt. Pure stdlib + ``resilience/integrity.py``:
+no JAX backend is touched, so it is safe to run next to a live job.
+
+Run:
+  python scripts/verify_ckpt.py /path/to/output_dir/checkpoints
+  python scripts/verify_ckpt.py --mode size /path/to/checkpoints
+  python scripts/verify_ckpt.py --step 1200 /path/to/checkpoints
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from veomni_tpu.resilience.integrity import (  # noqa: E402
+    MANIFEST_NAME,
+    QUARANTINE_DIR_RE,
+    STEP_DIR_RE,
+    VERIFY_MODES,
+    is_committed_dir,
+    verify_manifest,
+)
+
+_STEP_RE = STEP_DIR_RE
+_CORRUPT_RE = QUARANTINE_DIR_RE
+
+
+def verify_tree(ckpt_dir: str, mode: str, step: int = -1):
+    """Returns (rows: [(step, status, detail)], corpses: [dirname],
+    n_corrupt). Newest generation first — that is the one ``latest_step()``
+    would hand a resuming run."""
+    steps, corpses = [], []
+    for d in sorted(os.listdir(ckpt_dir)):
+        m = _STEP_RE.match(d)
+        if m:
+            steps.append(int(m.group(1)))
+        elif _CORRUPT_RE.match(d):
+            corpses.append(d)
+    if step >= 0:
+        steps = [s for s in steps if s == step]
+    rows = []
+    n_corrupt = 0
+    for s in sorted(steps, reverse=True):
+        step_dir = os.path.join(ckpt_dir, f"global_step_{s}")
+        if not is_committed_dir(step_dir):
+            rows.append((s, "UNCOMMITTED", "no train_state payload (crashed "
+                         "save debris; startup cleanup removes this)"))
+            continue
+        report = verify_manifest(step_dir, mode=mode)
+        if report is None:
+            rows.append((s, "UNVERIFIABLE", f"no readable {MANIFEST_NAME} "
+                         "(pre-integrity checkpoint, or crash before the "
+                         "manifest write)"))
+        elif report.passed:
+            rows.append((s, "OK", report.summary()))
+        else:
+            n_corrupt += 1
+            rows.append((s, "CORRUPT", report.summary()))
+    return rows, corpses, n_corrupt
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("ckpt_dir", help="directory holding global_step_N generations")
+    ap.add_argument("--mode", default="full", choices=[m for m in VERIFY_MODES if m != "off"],
+                    help="size = existence+bytes; full = re-digest every file (default)")
+    ap.add_argument("--step", type=int, default=-1,
+                    help="verify only this generation (default: all)")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.ckpt_dir):
+        print(f"error: {args.ckpt_dir} is not a directory", file=sys.stderr)
+        return 2
+    rows, corpses, n_corrupt = verify_tree(args.ckpt_dir, args.mode, args.step)
+    if not rows and not corpses:
+        print(f"{args.ckpt_dir}: no checkpoint generations found")
+        return 2
+    for s, status, detail in rows:
+        print(f"global_step_{s}: {status}\n    {detail}")
+    for d in sorted(corpses):
+        print(f"{d}: QUARANTINED (left on disk for post-mortem; aged out "
+              "beyond max_ckpt_to_keep)")
+    print(f"\n{len(rows)} generation(s) checked (mode={args.mode}): "
+          f"{n_corrupt} corrupt, {len(corpses)} previously quarantined")
+    return 1 if n_corrupt else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -45,6 +45,7 @@ if failures:
 # silently drop a whole subtree from this gate)
 for required in ("veomni_tpu.serving", "veomni_tpu.serving.engine",
                  "veomni_tpu.resilience", "veomni_tpu.resilience.faults",
+                 "veomni_tpu.resilience.integrity",
                  "veomni_tpu.resilience.retry", "veomni_tpu.resilience.supervisor",
                  "veomni_tpu.observability", "veomni_tpu.observability.metrics",
                  "veomni_tpu.observability.spans",

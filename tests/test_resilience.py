@@ -578,6 +578,11 @@ t.micro_batch_size = 2
 t.train_steps = cfg["train_steps"]
 t.save_steps = cfg.get("save_steps", 0)
 t.async_save = cfg.get("async_save", False)
+t.ckpt_verify = cfg.get("ckpt_verify", "size")
+t.data_skip_budget = cfg.get("data_skip_budget", 0)
+t.lr_decay_style = cfg.get("lr_decay_style", "cosine")
+if cfg.get("dataset_type"):
+    args.data.dataset_type = cfg["dataset_type"]
 t.lr = 1e-3
 t.bf16 = False
 t.save_hf_weights = False
@@ -606,21 +611,26 @@ class Rec(Callback):
 trainer.callbacks.append(Rec())
 ctl = trainer.train()
 trainer.checkpointer.close()
+res = {"global_step": ctl.global_step, "preempted": ctl.preempted,
+       "resilience": ctl.resilience}
+if hasattr(trainer.dataset, "state_dict"):
+    res["dataset_state"] = trainer.dataset.state_dict()
 with open(cfg["result"], "w") as f:
-    json.dump({"global_step": ctl.global_step, "preempted": ctl.preempted,
-               "resilience": ctl.resilience}, f)
+    json.dump(res, f)
 """
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn_driver(tmp_path, cfg):
+def _spawn_driver(tmp_path, cfg, extra_env=None):
     driver = tmp_path / "driver.py"
     driver.write_text(_DRIVER)
     cfg_path = tmp_path / f"cfg_{os.path.basename(cfg['loss_log'])}.json"
     cfg_path.write_text(json.dumps(cfg))
     env = dict(os.environ, JAX_PLATFORMS="cpu", VEOMNI_LOG_LEVEL="WARNING")
     env.pop("VEOMNI_FAULT_PLAN", None)
+    if extra_env:
+        env.update(extra_env)
     return subprocess.Popen(
         [sys.executable, str(driver), str(cfg_path)],
         env=env, cwd=_REPO,
@@ -734,3 +744,644 @@ def test_sigkill_mid_async_save_resume_bit_exact(tmp_path):
         assert ref[step] == hexloss, (
             f"step {step}: resumed loss {hexloss} != uninterrupted {ref[step]}"
         )
+
+
+# ---------------------------------------------------------------------------
+# integrity: manifest roundtrip + verify-mode matrix (resilience/integrity.py)
+# ---------------------------------------------------------------------------
+
+def _make_ckpt_tree(root):
+    ts = root / "train_state"
+    ts.mkdir(parents=True)
+    (ts / "arr0.bin").write_bytes(bytes(range(256)) * 8)  # largest file
+    (ts / "nested").mkdir()
+    (ts / "nested" / "arr1.bin").write_bytes(b"hello world" * 10)
+    (root / "extra_state.json").write_text('{"global_step": 3}')
+    (root / "extra_state_rank0.json").write_text('{"dataloader": {}}')
+
+
+def test_manifest_roundtrip_and_verify_matrix(tmp_path):
+    from veomni_tpu.resilience import integrity
+
+    step_dir = tmp_path / "global_step_3"
+    _make_ckpt_tree(step_dir)
+    integrity.write_manifest(str(step_dir))
+    doc = integrity.read_manifest(str(step_dir))
+    assert doc["version"] == integrity.MANIFEST_VERSION
+    # payload subtree (incl. nested dirs) + both extra-state sidecars
+    assert set(doc["files"]) == {
+        os.path.join("train_state", "arr0.bin"),
+        os.path.join("train_state", "nested", "arr1.bin"),
+        "extra_state.json", "extra_state_rank0.json",
+    }
+    # off -> no report (unverified, not verified-clean); size/full pass
+    assert integrity.verify_manifest(str(step_dir), mode="off") is None
+    for mode in ("size", "full"):
+        rep = integrity.verify_manifest(str(step_dir), mode=mode)
+        assert rep.passed and rep.total == 4 and rep.problems == []
+        assert "OK" in rep.summary()
+    with pytest.raises(ValueError, match="unknown verify mode"):
+        integrity.verify_manifest(str(step_dir), mode="paranoid")
+
+    # BITFLIP keeps the size: invisible to "size", caught only by "full"
+    payload = step_dir / "train_state" / "arr0.bin"
+    raw = bytearray(payload.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    payload.write_bytes(bytes(raw))
+    assert integrity.verify_manifest(str(step_dir), mode="size").passed
+    rep = integrity.verify_manifest(str(step_dir), mode="full")
+    assert [(p.path, p.kind) for p in rep.problems] == [
+        (os.path.join("train_state", "arr0.bin"), "mismatch")]
+    assert "CORRUPT" in rep.summary()
+
+    # TRUNCATION: already caught by "size", classified as truncated
+    raw2 = payload.read_bytes()
+    payload.write_bytes(raw2[: len(raw2) // 2])
+    rep = integrity.verify_manifest(str(step_dir), mode="size")
+    assert [(p.path, p.kind) for p in rep.problems] == [
+        (os.path.join("train_state", "arr0.bin"), "truncated")]
+
+    # MISSING file
+    payload.unlink()
+    rep = integrity.verify_manifest(str(step_dir), mode="size")
+    assert [(p.path, p.kind) for p in rep.problems] == [
+        (os.path.join("train_state", "arr0.bin"), "missing")]
+
+    # an unreadable or absent manifest is UNVERIFIABLE (None), never corrupt
+    (step_dir / integrity.MANIFEST_NAME).write_text("{not json")
+    assert integrity.verify_manifest(str(step_dir), mode="full") is None
+    (step_dir / integrity.MANIFEST_NAME).unlink()
+    assert integrity.verify_manifest(str(step_dir), mode="full") is None
+
+
+def test_corrupt_fault_mode_truncate_and_bitflip(tmp_path):
+    from veomni_tpu.resilience import faults
+
+    d = tmp_path / "gen"
+    d.mkdir()
+    (d / "a.bin").write_bytes(b"x" * 10)
+    (d / "b.bin").write_bytes(bytes(range(100)))
+
+    # default target = LARGEST file under the context dir; bitflip keeps size
+    faults.configure_faults([{"point": "ckpt.manifest", "mode": "corrupt"}])
+    act = faults.fault_point("ckpt.manifest", context={"dir": str(d)})
+    assert act is not None and act.mode == "corrupt"
+    assert act.target == str(d / "b.bin")
+    assert (d / "b.bin").stat().st_size == 100
+    assert (d / "b.bin").read_bytes()[50] == 50 ^ 0xFF  # middle byte flipped
+    assert (d / "a.bin").read_bytes() == b"x" * 10      # untouched
+
+    # truncate op; context names the file directly
+    faults.configure_faults([{"point": "data.record", "mode": "corrupt",
+                              "op": "truncate"}])
+    shard = tmp_path / "shard.jsonl"
+    shard.write_bytes(b"y" * 64)
+    act = faults.fault_point("data.record", context={"file": str(shard)})
+    assert act.target == str(shard) and shard.stat().st_size == 32
+
+    # glob-resolved explicit target + pinned offset
+    faults.configure_faults([{"point": "ckpt.manifest", "mode": "corrupt",
+                              "file": "*.bin", "offset": 0}])
+    act = faults.fault_point("ckpt.manifest", context={"dir": str(d)})
+    assert act.target == str(d / "a.bin")  # first sorted match
+    assert (d / "a.bin").read_bytes()[0] == ord("x") ^ 0xFF
+
+    with pytest.raises(ValueError, match="unknown corrupt op"):
+        faults.configure_faults([{"point": "ckpt.manifest", "mode": "corrupt",
+                                  "op": "melt"}])
+
+
+# ---------------------------------------------------------------------------
+# integrity: checkpointer quarantine + multi-generation restore fallback
+# ---------------------------------------------------------------------------
+
+def _corrupt_payload(step_dir, op="truncate"):
+    """Damage the largest payload file of a committed generation in place."""
+    best, best_size = None, -1
+    for dirpath, _dirs, files in os.walk(os.path.join(step_dir, "train_state")):
+        for f in files:
+            full = os.path.join(dirpath, f)
+            size = os.path.getsize(full)
+            if size > best_size:
+                best, best_size = full, size
+    with open(best, "r+b") as f:
+        if op == "truncate":
+            f.truncate(best_size // 2)
+        else:
+            f.seek(best_size // 2)
+            b = f.read(1)
+            f.seek(best_size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return best
+
+
+def test_ckpt_quarantine_and_multi_generation_fallback(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.checkpoint import build_checkpointer
+    from veomni_tpu.observability.metrics import get_registry
+    from veomni_tpu.resilience import CheckpointCorruptError
+
+    reg = get_registry()
+    q0 = reg.counter("integrity.ckpt_quarantined").value
+    f0 = reg.counter("integrity.ckpt_fallbacks").value
+
+    ck = build_checkpointer(str(tmp_path / "ck"), async_save=False,
+                            verify_mode="size")
+    state = None
+    for step in (1, 2, 3):
+        state = {"w": jnp.full((128,), float(step), jnp.float32)}
+        ck.save(step, state, extra_state={"global_step": step})
+    assert ck.list_steps() == [1, 2, 3]
+    for step in (1, 2, 3):  # sync saves wrote their manifests immediately
+        assert os.path.exists(os.path.join(
+            ck.ckpt_dir, f"global_step_{step}", "manifest.json"))
+
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+
+    # newest TWO generations rot: restore quarantines both, lands on step 1
+    _corrupt_payload(os.path.join(ck.ckpt_dir, "global_step_3"))
+    _corrupt_payload(os.path.join(ck.ckpt_dir, "global_step_2"))
+    restored, extra = ck.load(abstract)
+    assert int(extra["global_step"]) == 1
+    assert float(np.asarray(restored["w"])[0]) == 1.0
+    assert ck.list_steps() == [1] and ck.latest_step() == 1
+    assert os.path.isdir(os.path.join(ck.ckpt_dir, "global_step_3.corrupt"))
+    assert os.path.isdir(os.path.join(ck.ckpt_dir, "global_step_2.corrupt"))
+    assert not os.path.isdir(os.path.join(ck.ckpt_dir, "global_step_3"))
+    assert reg.counter("integrity.ckpt_quarantined").value - q0 == 2
+    assert reg.counter("integrity.ckpt_fallbacks").value - f0 == 2
+
+    # the last generation rots too: clean abort with actionable guidance
+    _corrupt_payload(os.path.join(ck.ckpt_dir, "global_step_1"))
+    with pytest.raises(CheckpointCorruptError, match="no trustworthy state"):
+        ck.load(abstract)
+    assert ck.list_steps() == []
+    ck.close()
+
+
+def test_resave_supersedes_quarantined_step_same_process(tmp_path):
+    """A quarantine must not block a later legitimate save() of the same
+    step IN THE SAME PROCESS (the supervisor-rollback timeline: quarantine
+    step N, restore older, train forward past N again): the re-save must
+    dispatch a fresh generation — not be deduped as "already dispatched" —
+    and that generation must be offered by list_steps/latest_step again."""
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.checkpoint import build_checkpointer
+    from veomni_tpu.resilience import CheckpointCorruptError
+
+    ck = build_checkpointer(str(tmp_path / "ck"), async_save=False,
+                            verify_mode="size")
+    for step in (1, 2):
+        ck.save(step, {"w": jnp.full((64,), float(step), jnp.float32)},
+                extra_state={"global_step": step})
+    _corrupt_payload(os.path.join(ck.ckpt_dir, "global_step_2"))
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        {"w": jnp.zeros((64,), jnp.float32)})
+    restored, extra = ck.load(abstract)  # quarantines 2, falls back to 1
+    assert int(extra["global_step"]) == 1 and ck.latest_step() == 1
+
+    # the run trains forward and saves step 2 again: fresh healthy bytes
+    ck.save(2, {"w": jnp.full((64,), 2.0, jnp.float32)},
+            extra_state={"global_step": 2})
+    assert ck.list_steps() == [1, 2] and ck.latest_step() == 2
+    assert os.path.isdir(os.path.join(ck.ckpt_dir, "global_step_2"))
+    assert os.path.isdir(os.path.join(ck.ckpt_dir, "global_step_2.corrupt"))
+    restored2, extra2 = ck.load(abstract)  # the new generation verifies
+    assert int(extra2["global_step"]) == 2
+    assert float(np.asarray(restored2["w"])[0]) == 2.0
+    ck.close()
+
+
+def test_resave_after_failed_quarantine_rename_clears_corpse(tmp_path, monkeypatch):
+    """If the quarantine rename itself fails (EBUSY/ESTALE on the flaky
+    shared fs this layer targets), the corrupt dir stays at the live path.
+    A later superseding save() of that step must clear the corpse (rename
+    retry, then deletion) instead of dispatching Orbax into the existing
+    dir and dying on an unretried 'destination already exists'."""
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.checkpoint import build_checkpointer
+
+    ck = build_checkpointer(str(tmp_path / "ck"), async_save=False,
+                            verify_mode="size")
+    for step in (1, 2):
+        ck.save(step, {"w": jnp.full((64,), float(step), jnp.float32)},
+                extra_state={"global_step": step})
+    _corrupt_payload(os.path.join(ck.ckpt_dir, "global_step_2"))
+
+    # every .corrupt rename fails; Orbax's own commit renames stay live
+    real_rename = os.rename
+
+    def flaky_rename(src, dst, *a, **kw):
+        if ".corrupt" in str(dst):
+            raise OSError("ESTALE: simulated shared-fs rename failure")
+        return real_rename(src, dst, *a, **kw)
+
+    monkeypatch.setattr(os, "rename", flaky_rename)
+
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        {"w": jnp.zeros((64,), jnp.float32)})
+    restored, extra = ck.load(abstract)  # quarantine rename fails in-flight
+    assert int(extra["global_step"]) == 1
+    # the corpse still occupies the live path, excluded only in-memory
+    assert os.path.isdir(os.path.join(ck.ckpt_dir, "global_step_2"))
+    assert ck.latest_step() == 1
+
+    # superseding save: rename retry fails again -> deletion fallback
+    ck.save(2, {"w": jnp.full((64,), 2.0, jnp.float32)},
+            extra_state={"global_step": 2})
+    assert ck.list_steps() == [1, 2]
+    restored2, extra2 = ck.load(abstract)
+    assert int(extra2["global_step"]) == 2
+    assert float(np.asarray(restored2["w"])[0]) == 2.0
+    ck.close()
+
+
+def test_ckpt_verify_mode_gates_bitflip_detection(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from veomni_tpu.checkpoint import build_checkpointer
+
+    ck = build_checkpointer(str(tmp_path / "ck"), async_save=False,
+                            verify_mode="full")
+    state = {"w": jnp.arange(1024, dtype=jnp.float32)}
+    ck.save(1, state, extra_state={"global_step": 1})
+    ck.save(2, state, extra_state={"global_step": 2})
+    _corrupt_payload(os.path.join(ck.ckpt_dir, "global_step_2"), op="bitflip")
+
+    # a size-mode verify misses the same-size bitflip...
+    ck_size = build_checkpointer(str(tmp_path / "ck"), async_save=False,
+                                 verify_mode="size")
+    rep = ck_size.verify_step(2)
+    assert rep is not None and rep.passed
+
+    # ...the full-mode gate catches it, quarantines, falls back to step 1
+    abstract = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+    restored, extra = ck.load(abstract)
+    assert int(extra["global_step"]) == 1
+    assert os.path.isdir(os.path.join(ck.ckpt_dir, "global_step_2.corrupt"))
+
+    # off-mode never verifies; bogus mode rejected at construction
+    ck_off = build_checkpointer(str(tmp_path / "ck"), async_save=False,
+                                verify_mode="off")
+    assert ck_off.verify_step(1) is None
+    with pytest.raises(ValueError, match="unknown ckpt verify mode"):
+        build_checkpointer(str(tmp_path / "x"), verify_mode="paranoid")
+    for c in (ck, ck_size, ck_off):
+        c.close()
+
+
+def test_quarantined_dirs_age_out_beyond_max_to_keep(tmp_path):
+    import jax.numpy as jnp
+
+    from veomni_tpu.checkpoint import build_checkpointer
+
+    ck = build_checkpointer(str(tmp_path / "ck"), async_save=False,
+                            max_to_keep=2)
+    # three pre-existing corpses (incl. a rename-collision suffix)
+    for name in ("global_step_1.corrupt", "global_step_2.corrupt",
+                 "global_step_3.corrupt.1"):
+        d = tmp_path / "ck" / name / "train_state"
+        d.mkdir(parents=True)
+        (d / "junk.bin").write_bytes(b"z" * 8)
+    ck.save(10, {"w": jnp.zeros(4)}, extra_state={"global_step": 10})
+    corpses = sorted(d for d in os.listdir(tmp_path / "ck")
+                     if ".corrupt" in d)
+    # newest max_to_keep corpses stay for post-mortem, the oldest is reaped
+    assert corpses == ["global_step_2.corrupt", "global_step_3.corrupt.1"]
+    assert ck.list_steps() == [10]
+    ck.close()
+
+
+# ---------------------------------------------------------------------------
+# integrity: streaming shard provenance + poison-record skip budget
+# ---------------------------------------------------------------------------
+
+def test_shard_decode_errors_carry_provenance(tmp_path):
+    from veomni_tpu.data.streaming import _open_shard
+    from veomni_tpu.resilience import ShardRecordError
+
+    shard = tmp_path / "00.jsonl"
+    shard.write_text('{"i": 0}\n{oops not json\n{"i": 2}\n')
+    reader = _open_shard(str(shard))
+    assert reader.read(0) == {"i": 0}
+    with pytest.raises(ShardRecordError) as ei:
+        reader.read(1)
+    assert ei.value.shard == str(shard) and ei.value.record == 1
+    assert "00.jsonl" in str(ei.value) and "record 1" in str(ei.value)
+    assert reader.read(2) == {"i": 2}  # neighbors unaffected
+
+    # tar member rot: same provenance contract, member named in the detail
+    import io
+    import tarfile
+
+    tar_path = tmp_path / "01.tar"
+    with tarfile.open(tar_path, "w") as tf:
+        for name, payload in (("s0.json", b'{"i": 0}'), ("s1.json", b"{rot")):
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            tf.addfile(info, io.BytesIO(payload))
+    treader = _open_shard(str(tar_path))
+    assert treader.read(0) == {"i": 0}
+    with pytest.raises(ShardRecordError) as ei:
+        treader.read(1)
+    assert ei.value.record == 1 and "member .json" in str(ei.value)
+
+
+def _poison_shard_dir(tmp_path, n=10, poison=(4,), name="shards"):
+    shard_dir = tmp_path / name
+    shard_dir.mkdir(exist_ok=True)
+    lines = ["{rot}" if i in poison else json.dumps({"i": i})
+             for i in range(n)]
+    (shard_dir / "00.jsonl").write_text("\n".join(lines) + "\n")
+    return shard_dir
+
+
+def test_poison_skip_budget_sequential_and_fail_fast(tmp_path):
+    from veomni_tpu.data.streaming import StreamingShardDataset
+    from veomni_tpu.resilience import ShardRecordError
+
+    shard_dir = _poison_shard_dir(tmp_path, n=10, poison=(4,))
+
+    # budget 0 (the default): fail FAST with shard+record provenance
+    ds0 = StreamingShardDataset(str(shard_dir), shuffle=False,
+                                retry_base_s=0.001)
+    with pytest.raises(ShardRecordError) as ei:
+        list(ds0)
+    assert ei.value.record == 4 and "00.jsonl" in str(ei.value)
+    assert "skip budget exhausted" in str(ei.value)
+
+    # budget 1: the poisoned record is dropped, order otherwise preserved
+    ds = StreamingShardDataset(str(shard_dir), shuffle=False,
+                               retry_base_s=0.001, skip_budget=1)
+    got = [r["i"] for r in ds]
+    assert got == [i for i in range(10) if i != 4]
+    assert ds.state_dict()["skipped"] == [["00.jsonl", 4]]
+
+    # epoch 2 re-skips the same record WITHOUT consuming fresh budget
+    got2 = [r["i"] for r in ds]
+    assert got2 == got and len(ds.state_dict()["skipped"]) == 1
+
+    # two poisons against a budget of one: exhaustion carries the history
+    shard_dir2 = _poison_shard_dir(tmp_path, n=10, poison=(2, 7), name="s2")
+    ds2 = StreamingShardDataset(str(shard_dir2), shuffle=False,
+                                retry_base_s=0.001, skip_budget=1)
+    with pytest.raises(ShardRecordError) as ei:
+        list(ds2)
+    assert ei.value.record == 7 and "already skipped" in str(ei.value)
+
+
+def test_poison_skip_replay_across_state_roundtrip(tmp_path):
+    from veomni_tpu.data.streaming import StreamingShardDataset
+
+    shard_dir = _poison_shard_dir(tmp_path, n=12, poison=(2, 7))
+
+    def build():
+        return StreamingShardDataset(str(shard_dir), shuffle=True, seed=5,
+                                     retry_base_s=0.001, skip_budget=2)
+
+    ref = build()
+    ref_rows = [r["i"] for r in ref]
+    assert len(ref_rows) == 10 and len(ref.state_dict()["skipped"]) == 2
+
+    # consume part of the epoch, snapshot mid-stream, resume in a FRESH
+    # dataset: the combined row sequence and the final skip history must be
+    # identical to the uninterrupted epoch
+    a = build()
+    it = iter(a)
+    first = [next(it)["i"] for _ in range(4)]
+    snap = a.state_dict()
+    b = build()
+    b.load_state_dict(snap)
+    rest = [r["i"] for r in b]
+    assert first + rest == ref_rows
+    assert b.state_dict()["skipped"] == ref.state_dict()["skipped"]
+
+
+def test_poison_getitem_substitutes_deterministically(tmp_path):
+    from veomni_tpu.data.streaming import StreamingShardDataset
+    from veomni_tpu.resilience import ShardRecordError
+
+    shard_dir = _poison_shard_dir(tmp_path, n=6, poison=(3,))
+    ds = StreamingShardDataset(str(shard_dir), shuffle=False,
+                               retry_base_s=0.001, skip_budget=1)
+    assert len(ds) == 6
+    # linear access substitutes the NEXT healthy record for the poisoned one
+    # (batch shapes must stay full), stable across repeated access
+    assert ds[3]["i"] == 4 and ds[3]["i"] == 4
+    assert ds[2]["i"] == 2 and ds[4]["i"] == 4
+    assert ds.state_dict()["skipped"] == [["00.jsonl", 3]]
+
+    # the substitution survives a state roundtrip
+    ds2 = StreamingShardDataset(str(shard_dir), shuffle=False,
+                                retry_base_s=0.001, skip_budget=1)
+    ds2.load_state_dict(ds.state_dict())
+    assert ds2[3]["i"] == 4
+
+    # budget 0: the same access fails fast instead of substituting
+    ds3 = StreamingShardDataset(str(shard_dir), shuffle=False,
+                                retry_base_s=0.001)
+    with pytest.raises(ShardRecordError):
+        ds3[3]
+
+
+def test_validate_hook_feeds_skip_budget(tmp_path):
+    from veomni_tpu.data.streaming import StreamingShardDataset
+    from veomni_tpu.resilience import ShardRecordError
+
+    shard_dir = tmp_path / "vshards"
+    shard_dir.mkdir()
+    with open(shard_dir / "00.jsonl", "w") as f:
+        for i in range(6):
+            f.write(json.dumps({"i": i}) + "\n")
+
+    def validate(row):
+        return row["i"] != 2
+
+    ds = StreamingShardDataset(str(shard_dir), shuffle=False,
+                               retry_base_s=0.001, skip_budget=1,
+                               validate=validate)
+    assert [r["i"] for r in ds] == [0, 1, 3, 4, 5]
+    ds0 = StreamingShardDataset(str(shard_dir), shuffle=False,
+                                retry_base_s=0.001, validate=validate)
+    with pytest.raises(ShardRecordError, match="validation hook"):
+        list(ds0)
+
+
+def test_retry_counters_and_exhaustion_log():
+    import logging
+
+    from veomni_tpu.observability.metrics import get_registry
+    from veomni_tpu.resilience.retry import RetryPolicy, retry_call
+
+    reg = get_registry()
+    a0 = reg.counter("retry.attempts").value
+    e0 = reg.counter("retry.exhausted").value
+
+    def doomed():
+        raise OSError("disk on fire")
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    target = logging.getLogger("veomni_tpu.resilience.retry")
+    target.addHandler(handler)
+    try:
+        with pytest.raises(OSError):
+            retry_call(doomed, policy=RetryPolicy(retries=2, base_delay_s=0.5),
+                       sleep=lambda _: None, description="probe")
+    finally:
+        target.removeHandler(handler)
+    assert reg.counter("retry.attempts").value - a0 == 2
+    assert reg.counter("retry.exhausted").value - e0 == 1
+    final = [r.getMessage() for r in records
+             if "exhausted" in r.getMessage()]
+    # evidence the retries happened rides the final-failure line
+    assert final and "3 attempt(s)" in final[0]
+    assert "total backoff" in final[0]
+
+
+# ---------------------------------------------------------------------------
+# integrity: real-process drills (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def test_subprocess_corrupt_ckpt_quarantine_fallback_bit_exact(tmp_path):
+    """A corrupt-mode fault plan flips bytes in the newest committed
+    generation right after its manifest is written; the resumed run must
+    quarantine it, restore the previous generation, and replay to the end
+    with a loss trajectory BIT-exact vs an uncorrupted control run."""
+    _write_data(tmp_path / "data.jsonl")
+
+    # constant LR: the cosine default bakes train_steps into every update,
+    # and the three legs train different horizons
+    # control: uninterrupted 8-step run over the same data/seed
+    ctl_cfg = _base_cfg(tmp_path, "ictl_out", "ictl.jsonl", save_steps=2,
+                        lr_decay_style="constant")
+    proc = _spawn_driver(tmp_path, ctl_cfg)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+    ref = _read_losses(ctl_cfg["loss_log"])
+    assert sorted(ref) == list(range(1, 9))
+
+    # leg 1: checkpoints at steps 2 and 4; the ckpt.manifest corrupt fault
+    # (hit 2 = the step-4 manifest) bitflips the step-4 payload AFTER its
+    # digests were recorded — the storage-rot timeline
+    leg1_cfg = _base_cfg(tmp_path, "ivic_out", "ivic1.jsonl",
+                         train_steps=4, save_steps=2,
+                         lr_decay_style="constant")
+    plan = [{"point": "ckpt.manifest", "mode": "corrupt", "hit": 2,
+             "op": "bitflip"}]
+    proc = _spawn_driver(tmp_path, leg1_cfg,
+                         extra_env={"VEOMNI_FAULT_PLAN": json.dumps(plan)})
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+    ck_dir = os.path.join(leg1_cfg["out"], "checkpoints")
+    assert os.path.isdir(os.path.join(ck_dir, "global_step_4"))  # committed
+
+    # leg 2: resume under full verification — step 4 quarantined, step 2
+    # restored, steps 3-8 replayed
+    leg2_cfg = _base_cfg(tmp_path, "ivic_out", "ivic2.jsonl",
+                         save_steps=0, ckpt_verify="full",
+                         lr_decay_style="constant")
+    proc = _spawn_driver(tmp_path, leg2_cfg)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+    result = json.load(open(leg2_cfg["result"]))
+    assert result["global_step"] == 8
+    assert os.path.isdir(os.path.join(ck_dir, "global_step_4.corrupt"))
+    assert not os.path.isdir(os.path.join(ck_dir, "global_step_4"))
+    leg2 = _read_losses(leg2_cfg["loss_log"])
+    assert sorted(leg2) == list(range(3, 9))  # resumed from step 2
+    for step, hexloss in leg2.items():
+        assert ref[step] == hexloss, (
+            f"step {step}: post-fallback loss {hexloss} != control {ref[step]}"
+        )
+
+
+def test_subprocess_data_skip_budget_across_resume_and_exhaustion(tmp_path):
+    """With ``train.data_skip_budget=1`` a poisoned streaming record is
+    skipped deterministically across a save/restore boundary (trajectory
+    bit-exact vs an uninterrupted run over the same poisoned corpus, skip
+    recorded in the restored rank state); with the default budget of 0 the
+    same corpus fails fast with shard+record provenance."""
+    # sized to the packing collator's demand-driven offer: with the pinned
+    # 4-device topology below it requests samples_per_micro_batch*local_mb
+    # = 64 raw samples per batch, so 64 records = every record (incl. the
+    # poison) is offered from step 1 on — and a smaller corpus would starve
+    # the offer loop outright
+    shard_dir = tmp_path / "stream_shards"
+    shard_dir.mkdir()
+    rng = np.random.default_rng(0)
+    poison_idx = 7
+    with open(shard_dir / "00.jsonl", "w") as f:
+        for i in range(64):
+            if i == poison_idx:
+                f.write("{this is not json\n")
+                continue
+            f.write(json.dumps({
+                "input_ids": rng.integers(
+                    0, 256, int(rng.integers(16, 80))).tolist(),
+            }) + "\n")
+
+    # constant LR: the cosine default bakes train_steps into every update,
+    # and the legs train different horizons. The device topology is pinned
+    # (not inherited from the pytest process) so batch assembly — and with
+    # it which records each step consumes — is identical across legs
+    # however the suite is invoked.
+    xla4 = {"XLA_FLAGS": "--xla_force_host_platform_device_count=4"}
+    common = dict(dataset_type="streaming", data_skip_budget=1,
+                  lr_decay_style="constant")
+
+    ctl = _base_cfg(tmp_path, "sctl_out", "sctl.jsonl", save_steps=2, **common)
+    ctl["data"] = str(shard_dir)
+    proc = _spawn_driver(tmp_path, ctl, extra_env=xla4)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+    ref = _read_losses(ctl["loss_log"])
+    assert sorted(ref) == list(range(1, 9))
+    ctl_result = json.load(open(ctl["result"]))
+    assert ctl_result["dataset_state"]["skipped"] == [["00.jsonl", poison_idx]]
+
+    leg1 = _base_cfg(tmp_path, "svic_out", "svic1.jsonl",
+                     train_steps=4, save_steps=2, **common)
+    leg1["data"] = str(shard_dir)
+    proc = _spawn_driver(tmp_path, leg1, extra_env=xla4)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+
+    leg2 = _base_cfg(tmp_path, "svic_out", "svic2.jsonl", save_steps=0,
+                     **common)
+    leg2["data"] = str(shard_dir)
+    proc = _spawn_driver(tmp_path, leg2, extra_env=xla4)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode == 0, err[-2000:]
+    result = json.load(open(leg2["result"]))
+    assert result["global_step"] == 8
+    # the restored run carries the identical skip record
+    assert result["dataset_state"]["skipped"] == [["00.jsonl", poison_idx]]
+    leg2_losses = _read_losses(leg2["loss_log"])
+    assert sorted(leg2_losses) == list(range(5, 9))  # resumed from step 4
+    for step, hexloss in leg2_losses.items():
+        assert ref[step] == hexloss, (
+            f"step {step}: post-resume loss {hexloss} != control {ref[step]}"
+        )
+
+    # budget exhaustion: same corpus, budget 0 -> fast failure w/ provenance
+    fail = _base_cfg(tmp_path, "sfail_out", "sfail.jsonl",
+                     dataset_type="streaming", data_skip_budget=0)
+    fail["data"] = str(shard_dir)
+    proc = _spawn_driver(tmp_path, fail, extra_env=xla4)
+    out, err = proc.communicate(timeout=300)
+    assert proc.returncode != 0
+    assert "00.jsonl" in err and f"record {poison_idx}" in err
+    assert "skip budget exhausted" in err

@@ -157,6 +157,20 @@ class TrainingArguments:
     # train-loop stall watchdog: dump all thread stacks if no step completes
     # within this many seconds (0 = disabled)
     resilience_watchdog_s: float = 0.0
+    # checkpoint integrity gate (resilience/integrity.py): manifest
+    # verification before every restore. "off" = trust the bytes; "size" =
+    # existence + byte size (catches truncation/missing files at
+    # directory-listing cost); "full" = re-digest every file (catches bit
+    # flips; reads the whole checkpoint). A failing generation is
+    # quarantined (global_step_N.corrupt) and restore falls back to the
+    # next-newest committed-and-verified one.
+    ckpt_verify: str = "size"
+    # poison-record tolerance for streaming data: how many distinct
+    # undecodable/invalid (shard, record) pairs may be skipped before the
+    # run fails fast with full provenance. 0 = fail on the first one.
+    # Skips are recorded in the rank-local checkpoint state so a resumed
+    # run replays them bit-exactly.
+    data_skip_budget: int = 0
     # observability. log_steps is also the host<->device sync cadence: the
     # loop only fetches metrics (blocking on the device) every log_steps —
     # default 10 so the async loop's lazy sync is ON out of the box (a

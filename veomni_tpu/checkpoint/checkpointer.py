@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 import os
-import re
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -25,12 +25,25 @@ import orbax.checkpoint as ocp
 from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.observability.spans import span
 from veomni_tpu.resilience.faults import fault_point
+from veomni_tpu.resilience.integrity import (
+    QUARANTINE_DIR_RE,
+    STEP_DIR_RE,
+    VERIFY_MODES,
+    CheckpointCorruptError,
+    is_committed_dir,
+    verify_manifest,
+    write_manifest,
+)
 from veomni_tpu.resilience.retry import RetryPolicy, retry_call
 from veomni_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-_STEP_RE = re.compile(r"^global_step_(\d+)$")
+# naming scheme lives in integrity.py (shared with scripts/verify_ckpt.py);
+# quarantined generations: global_step_N.corrupt (rename collisions get a
+# numeric suffix so a twice-quarantined step never blocks the rename)
+_STEP_RE = STEP_DIR_RE
+_CORRUPT_RE = QUARANTINE_DIR_RE
 
 
 def _tree_bytes(tree: Any) -> int:
@@ -51,17 +64,37 @@ class Checkpointer:
     commit errors are probed at the next step boundary (``save()``/``wait()``)
     and the failed step is EVICTED from the dedupe set, so a later save of
     that step re-dispatches instead of being silently lost.
+
+    Integrity (``resilience/integrity.py``): once a generation's commit is
+    observed, rank 0 digests it into ``manifest.json``; ``load()`` verifies
+    the manifest per ``verify_mode`` (``off|size|full``) BEFORE dispatching
+    the Orbax restore, quarantines failing generations to
+    ``global_step_N.corrupt``, and falls back to the next-newest
+    committed-and-verified one.
     """
 
     def __init__(self, ckpt_dir: str, *, async_save: bool = True, max_to_keep: int = 0,
-                 io_retries: int = 3, retry_base_s: float = 0.05):
+                 io_retries: int = 3, retry_base_s: float = 0.05,
+                 verify_mode: str = "size"):
+        if verify_mode not in VERIFY_MODES:
+            raise ValueError(
+                f"unknown ckpt verify mode {verify_mode!r}; choose from {VERIFY_MODES}"
+            )
         self.ckpt_dir = os.path.abspath(ckpt_dir)
         os.makedirs(self.ckpt_dir, exist_ok=True)
         self.async_save = async_save
         self.max_to_keep = max_to_keep
+        self.verify_mode = verify_mode
         self._retry_policy = RetryPolicy(retries=io_retries, base_delay_s=retry_base_s)
         self._saved_steps: set = set()
         self._inflight_step: Optional[int] = None
+        # steps condemned by a failed verify THIS process: the dir rename is
+        # rank-0's job, but every rank must stop offering the step locally
+        # (a lagging shared fs may still show the old name for a beat)
+        self._quarantined: set = set()
+        # in-flight async manifest digest (rank 0 only): the full-tree CRC
+        # re-reads every committed byte, so it runs off the hot save path
+        self._manifest_thread: Optional[threading.Thread] = None
         self._ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
         # startup is the only moment no save can be in flight anywhere, so
         # clear crashed-save debris here (never during save(): a lagging host
@@ -85,6 +118,27 @@ class Checkpointer:
                 for sub in os.listdir(step_dir):
                     if ".orbax-checkpoint-tmp" in sub:
                         shutil.rmtree(os.path.join(step_dir, sub), ignore_errors=True)
+        self._reap_quarantined()
+
+    def _reap_quarantined(self):
+        """Age out ``.corrupt`` quarantined generations beyond ``max_to_keep``
+        (rank-0-gated like ``_prune``). Quarantine keeps the bytes around for
+        post-mortem, but a flaky filesystem would otherwise leak disk forever;
+        the newest ``max_to_keep`` corpses stay, older ones are reaped.
+        ``max_to_keep == 0`` (keep-everything semantics, same as _prune)
+        never reaps."""
+        if not self.max_to_keep or jax.process_index() != 0:
+            return
+        import shutil
+
+        corpses = []
+        for d in os.listdir(self.ckpt_dir):
+            m = _CORRUPT_RE.match(d)
+            if m:
+                corpses.append((int(m.group(1)), d))
+        for _step, d in sorted(corpses)[: -self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.ckpt_dir, d), ignore_errors=True)
+            logger.warning_rank0("reaped quarantined checkpoint %s", d)
 
     # ------------------------------------------------------------------ save
     def check_for_errors(self) -> Optional[BaseException]:
@@ -150,7 +204,25 @@ class Checkpointer:
         if step in self._saved_steps:
             logger.info_rank0("checkpoint for step %d already dispatched; skipping", step)
             return
-        if os.path.isdir(path):
+        # a quarantined step is being SUPERSEDED by this save: the condemned
+        # dir was renamed away by rank 0 — but if that rename itself failed
+        # (flaky shared fs), the corpse still occupies the path and Orbax
+        # would refuse the dispatch with an unretried "destination exists"
+        if step in self._quarantined:
+            self._clear_corpse(step)
+            # every rank reaches this branch (_quarantined mutates in
+            # lockstep), but the clear is rank 0's job — without a barrier
+            # another rank's _dispatch_save could write its fresh rank-local
+            # sidecar INTO the corpse dir while rank 0 is still renaming or
+            # deleting it, losing that rank's cursor from the superseding
+            # generation
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices(
+                    f"ckpt_clear_corpse_{step}"
+                )
+        elif os.path.isdir(path):
             logger.info_rank0("checkpoint for step %d already exists; skipping", step)
             return
         # serialize with any in-flight save BEFORE the retried dispatch: if
@@ -167,6 +239,14 @@ class Checkpointer:
                 self._ckptr.wait_until_finished()
             except Exception as e:
                 self._evict_inflight(e)
+            else:
+                # the PREVIOUS async save just committed: its bytes are now
+                # final, so this is the earliest safe moment to digest them —
+                # in the background, so the full-tree CRC read doesn't stall
+                # this save boundary (joined at the next wait()/load())
+                if self._inflight_step is not None:
+                    self._start_manifest(self._inflight_step)
+                    self._inflight_step = None
             step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
             retry_call(
                 self._dispatch_save, path, train_state, step_dir,
@@ -181,7 +261,12 @@ class Checkpointer:
         # above leaves the set untouched, so a later attempt of this step —
         # e.g. the train-end final save — isn't silently skipped)
         self._saved_steps.add(step)
+        # the fresh generation replaces any condemned one at this step:
+        # list_steps/latest_step must offer it again once committed
+        self._quarantined.discard(step)
         self._inflight_step = step if self.async_save else None
+        if not self.async_save:  # sync: committed right here
+            self._write_manifest(step)
         logger.info_rank0("checkpoint save dispatched: step %d -> %s", step, path)
         self._prune()
 
@@ -195,7 +280,203 @@ class Checkpointer:
             err = self.check_for_errors()
             if err is not None:
                 raise err
+            # wait() is the explicit durability barrier: the manifest must be
+            # on disk when it returns, so the inflight digest runs inline
+            self._join_manifest()
+            if self._inflight_step is not None:
+                self._write_manifest(self._inflight_step)
             self._inflight_step = None
+
+    # ------------------------------------------------------------- integrity
+    def _start_manifest(self, step: int) -> None:
+        """Digest a just-committed async generation off the hot save path —
+        a synchronous full-tree CRC would stall rank 0 at every save boundary
+        and make it a straggler at the next collective, exactly the
+        host-blocking async save exists to avoid. Serialized: any previous
+        digest is joined first, so manifest fault hits stay deterministic."""
+        self._join_manifest()
+        if self.verify_mode == "off" or jax.process_index() != 0:
+            return
+        t = threading.Thread(
+            target=self._write_manifest, args=(step,),
+            name=f"ckpt-manifest-{step}", daemon=True,
+        )
+        t.start()
+        self._manifest_thread = t
+
+    def _join_manifest(self) -> None:
+        t = self._manifest_thread
+        if t is not None:
+            t.join()
+            self._manifest_thread = None
+    def _write_manifest(self, step: int) -> None:
+        """Rank 0 digests the committed generation into ``manifest.json``
+        (the verify gate's ground truth, written NEXT to the extra-state
+        sidecars). Never fatal: a failed manifest write leaves an
+        unverifiable-but-healthy checkpoint, which ``load()`` accepts with a
+        warning — refusing it would turn the safety net into a data killer.
+
+        ``verify_mode == 'off'`` skips the digest entirely: "trust the
+        bytes" must not cost a full-tree read of every committed byte per
+        save (inline for sync saves!) to record CRCs nothing will consume.
+        ``size`` mode still records them — its manifests feed the operator
+        CLI's out-of-band ``--mode full`` sweep, not just its own gate."""
+        if self.verify_mode == "off" or jax.process_index() != 0:
+            return
+        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
+        if not self._is_committed(step):
+            return
+        try:
+            with span("ckpt.manifest"):
+                write_manifest(step_dir)
+            # drill point: a corrupt-mode fault spec here damages the
+            # just-committed generation AFTER its digests were recorded —
+            # exactly the storage-rot timeline the verify gate exists for.
+            # Inside the try: an exception-mode spec must stay never-fatal
+            # like any manifest failure (sync saves call this inline, async
+            # ones from a daemon thread where a raise would vanish)
+            fault_point("ckpt.manifest", context={"dir": step_dir})
+        except Exception as e:
+            logger.warning_rank0(
+                "manifest write for step %d failed: %s (generation stays "
+                "restorable, just unverifiable)", step, e,
+            )
+            return
+
+    def verify_step(self, step: int):
+        """Manifest verification per ``self.verify_mode``. Returns the
+        :class:`VerifyReport`, or None when verification is off or the
+        generation has no readable manifest (unverifiable ≠ corrupt: a crash
+        can land between payload commit and manifest write, and pre-integrity
+        checkpoints have no manifest at all)."""
+        if self.verify_mode == "off":
+            return None
+        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
+        report = verify_manifest(step_dir, mode=self.verify_mode)
+        if report is None:
+            logger.warning_rank0(
+                "checkpoint step %d has no readable manifest; restoring "
+                "UNVERIFIED", step,
+            )
+            return None
+        reg = get_registry()
+        reg.histogram("integrity.verify_s").observe(report.elapsed_s)
+        if report.passed:
+            reg.counter("integrity.ckpt_verified").inc()
+        return report
+
+    def _verify_gate(self, step: int) -> None:
+        """Restore gate: verify on rank 0 and share ONE verdict with every
+        process, so the multi-process Orbax restore collective can never
+        split across generations — rot landing between two ranks'
+        independent verifies would let rank A pass step N while rank B
+        quarantines it and walks back, wedging the collective instead of
+        falling back cleanly. A single verify also keeps ``full`` mode from
+        multiplying restore-time I/O by the process count (every rank would
+        re-digest the same shared files). On a condemned generation EVERY
+        rank quarantines locally and raises, so the fallback walk stays in
+        lockstep."""
+        if self.verify_mode == "off":
+            return
+        multi = jax.process_count() > 1
+        report = None
+        if not multi or jax.process_index() == 0:
+            try:
+                report = self.verify_step(step)
+            except Exception as e:
+                # verification must ALWAYS reach the broadcast below — an
+                # exception escaping on rank 0 alone would leave the other
+                # ranks blocked in it. An errored verify is unverifiable,
+                # not corrupt: restore proceeds with a warning
+                logger.warning_rank0(
+                    "manifest verification of step %d errored: %s; "
+                    "restoring UNVERIFIED", step, e,
+                )
+                report = None
+        failed = report is not None and not report.passed
+        if multi:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            failed = bool(multihost_utils.broadcast_one_to_all(
+                np.int32(1 if failed else 0)
+            ))
+        if failed:
+            reason = report.summary() if report is not None else (
+                f"rank-0 manifest verification failed (mode={self.verify_mode})"
+            )
+            self._quarantine(step, reason)
+            raise CheckpointCorruptError(
+                f"checkpoint step {step} failed '{self.verify_mode}' "
+                f"verification and was quarantined: {reason}",
+                report,
+            )
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        """Condemn a generation that failed verification: atomic rename to
+        ``global_step_N.corrupt`` (rank-0-gated like ``_prune``) so no later
+        ``list_steps``/``latest_step`` can ever offer it again, while the
+        bytes stay on disk for post-mortem until ``_reap_quarantined`` ages
+        them out."""
+        self._quarantined.add(step)
+        # un-dedupe: a later legitimate save() of this step must dispatch a
+        # fresh generation, not be skipped as "already dispatched"
+        self._saved_steps.discard(step)
+        get_registry().counter("integrity.ckpt_quarantined").inc()
+        logger.error("QUARANTINING checkpoint step %d: %s", step, reason)
+        if jax.process_index() != 0:
+            return  # rename is rank 0's job; the in-memory set covers this rank
+        self._rename_corpse(step)
+
+    def _rename_corpse(self, step: int) -> bool:
+        """Rank 0: move ``global_step_N`` aside to ``global_step_N.corrupt``
+        (collision-suffixed). Returns True iff the step path is gone after
+        the attempt — a failed rename is logged, never raised, because the
+        in-memory ``_quarantined`` set already excludes the step."""
+        src = os.path.join(self.ckpt_dir, f"global_step_{step}")
+        dst = src + ".corrupt"
+        k = 0
+        while os.path.exists(dst):
+            k += 1
+            dst = src + f".corrupt.{k}"
+        try:
+            os.rename(src, dst)
+            logger.error("quarantined %s -> %s", src, dst)
+            return True
+        except OSError as e:
+            logger.error(
+                "quarantine rename of %s failed: %s (step stays excluded "
+                "in-memory)", src, e,
+            )
+            return not os.path.exists(src)
+
+    def _clear_corpse(self, step: int) -> None:
+        """A condemned generation is being SUPERSEDED by a fresh ``save()``
+        of the same step. Normally the quarantine rename already moved the
+        dir aside and this is a no-op; if that rename failed (flaky shared
+        fs), the corpse still occupies the path and the Orbax dispatch would
+        die on an unretried "destination already exists". Retry the move
+        now, falling back to deletion — the bytes were condemned anyway."""
+        if jax.process_index() != 0:
+            return
+        src = os.path.join(self.ckpt_dir, f"global_step_{step}")
+        if not os.path.isdir(src):
+            return
+        if self._rename_corpse(step):
+            return
+        import shutil
+
+        shutil.rmtree(src, ignore_errors=True)
+        if os.path.exists(src):
+            logger.error(
+                "could not clear condemned checkpoint dir %s; the "
+                "superseding save of step %d may fail", src, step,
+            )
+        else:
+            logger.warning_rank0(
+                "deleted condemned checkpoint dir %s (quarantine rename had "
+                "failed) to clear the path for a superseding save", src,
+            )
 
     def _prune(self):
         if not self.max_to_keep:
@@ -211,6 +492,7 @@ class Checkpointer:
             import shutil
 
             shutil.rmtree(os.path.join(self.ckpt_dir, f"global_step_{s}"), ignore_errors=True)
+        self._reap_quarantined()
 
     # ------------------------------------------------------------------ load
     def _dispatch_restore(self, path: str, abstract_state):
@@ -221,53 +503,101 @@ class Checkpointer:
         return self._ckptr.restore(path, args=ocp.args.StandardRestore(abstract_state))
 
     def _is_committed(self, step: int) -> bool:
-        """True iff the step's train_state payload finished committing.
-
-        A crash during an async Orbax save leaves the step dir with only the
-        uncommitted ``*.orbax-checkpoint-tmp-*`` payload (and possibly an
-        eagerly-written extra_state.json). Orbax renames the tmp dir to its
-        final name atomically on commit, so the final ``train_state`` dir
-        existing IS the commit marker — a stale tmp *sibling* from an earlier
-        crashed save must not invalidate a later successful one.
-        """
-        step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
-        return os.path.isdir(os.path.join(step_dir, "train_state"))
+        """True iff the step's payload finished committing — the commit
+        marker predicate lives in integrity.py (shared with write_manifest
+        and scripts/verify_ckpt.py): a stale ``*.orbax-checkpoint-tmp-*``
+        *sibling* from an earlier crashed save must not invalidate a later
+        successful one."""
+        return is_committed_dir(
+            os.path.join(self.ckpt_dir, f"global_step_{step}")
+        )
 
     def list_steps(self):
         out = []
         if os.path.isdir(self.ckpt_dir):
             for d in os.listdir(self.ckpt_dir):
                 m = _STEP_RE.match(d)
-                if m and self._is_committed(int(m.group(1))):
-                    out.append(int(m.group(1)))
+                if not m:
+                    continue
+                s = int(m.group(1))
+                # locally-condemned steps stay invisible even if the rank-0
+                # quarantine rename hasn't propagated over the shared fs yet
+                if s in self._quarantined:
+                    continue
+                if self._is_committed(s):
+                    out.append(s)
         return sorted(out)
 
     def latest_step(self) -> Optional[int]:
         steps = self.list_steps()
         return steps[-1] if steps else None
 
-    def load(self, abstract_state, step: Optional[int] = None):
+    def load(self, abstract_state, step: Optional[int] = None,
+             max_step: Optional[int] = None):
         """Restore into the sharding/dtype structure of ``abstract_state``
-        (a pytree of sharded jax.ShapeDtypeStructs). Returns (state, extra)."""
+        (a pytree of sharded jax.ShapeDtypeStructs). Returns (state, extra).
+
+        ``step=None`` walks newest-first over committed-and-verified
+        generations (optionally capped at ``max_step`` — the supervisor's
+        rollback uses this to stay before the anomalous window): a generation
+        that fails manifest verification is QUARANTINED and the walk falls
+        back to the next-newest one. If every generation fails verification
+        the run aborts cleanly with the full quarantine history; any other
+        restore failure (e.g. abstract_state no longer matching the run) is
+        systemic and surfaces as-is."""
         if step is None:
-            # walk back through committed steps so a corrupt latest checkpoint
-            # still resumes; if EVERY step fails the failure is systemic (e.g.
-            # abstract_state no longer matches the run) and must surface
             last_err = None
-            for cand in reversed(self.list_steps()):
+            all_corrupt = True
+            candidates = [s for s in reversed(self.list_steps())
+                          if max_step is None or s <= max_step]
+            for i, cand in enumerate(candidates):
                 try:
                     return self.load(abstract_state, step=cand)
                 except Exception as e:
                     last_err = e
-                    logger.warning_rank0(
-                        "restore of step %d failed: %s; trying previous step", cand, e
+                    all_corrupt = all_corrupt and isinstance(
+                        e, CheckpointCorruptError
                     )
+                    if i + 1 < len(candidates):
+                        # integrity.ckpt_fallbacks means "walked past storage
+                        # rot" (/healthz + bench surface it next to the
+                        # quarantine count) — a fallback past a transient
+                        # restore failure is NOT an integrity incident and
+                        # must not send an operator hunting for .corrupt
+                        # dirs that don't exist
+                        reg = get_registry()
+                        reg.counter("ckpt.restore_fallbacks").inc()
+                        if isinstance(e, CheckpointCorruptError):
+                            reg.counter("integrity.ckpt_fallbacks").inc()
+                        logger.warning_rank0(
+                            "restore of step %d failed: %s; falling back to "
+                            "step %d", cand, e, candidates[i + 1],
+                        )
+                    else:
+                        logger.warning_rank0(
+                            "restore of step %d failed: %s; no earlier "
+                            "committed generation remains", cand, e,
+                        )
             if last_err is not None:
+                if all_corrupt:
+                    raise CheckpointCorruptError(
+                        f"every committed checkpoint generation under "
+                        f"{self.ckpt_dir} failed {self.verify_mode} "
+                        f"verification (tried {candidates}; all quarantined "
+                        f"as *.corrupt). The run has no trustworthy state to "
+                        f"resume from — inspect the quarantined dirs with "
+                        f"scripts/verify_ckpt.py, restore from off-site "
+                        f"backup, or restart from scratch."
+                    ) from last_err
                 raise last_err
             return None, None
         self.wait()
         step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
         path = os.path.join(step_dir, "train_state")
+        # verification gates the restore: Orbax must never be handed bytes
+        # the manifest condemns (its own failure modes on corrupt input are
+        # not guaranteed to be loud)
+        self._verify_gate(step)
         with span("ckpt.restore"):
             restored = retry_call(
                 self._dispatch_restore, path, abstract_state,
@@ -308,6 +638,13 @@ class Checkpointer:
 
     def close(self):
         self._ckptr.wait_until_finished()
+        self._join_manifest()
+        # same contract as wait(): a final async save committed by this
+        # close must not leave the newest — most likely to be restored —
+        # generation without its manifest
+        if self._inflight_step is not None:
+            self._write_manifest(self._inflight_step)
+            self._inflight_step = None
         self._ckptr.close()
 
 

@@ -126,7 +126,23 @@ class BackgroundPrefetcher:
             if err is not None:
                 # re-raising the worker's exception object keeps its
                 # __traceback__ — the consumer sees the worker-side frames
-                # where the data pipeline actually failed
+                # where the data pipeline actually failed. Data-integrity
+                # failures (poison-skip budget exhaustion) additionally get
+                # the last CONSUMED loader cursor pinned on: the worker ran
+                # ahead of the trainer, so its own state is NOT where a
+                # resumed run would restart from.
+                from veomni_tpu.resilience.integrity import ShardRecordError
+
+                if isinstance(err, ShardRecordError):
+                    note = (
+                        f"last consumed dataloader cursor: {self._consumed_state}"
+                    )
+                    if hasattr(err, "add_note"):  # py3.11+
+                        err.add_note(note)
+                    else:  # pragma: no cover - older interpreters
+                        import logging
+
+                        logging.getLogger(__name__).error(note)
                 raise err
             raise StopIteration
         self._m_wait.observe(time.perf_counter() - t_wait)

@@ -28,12 +28,14 @@ import io
 import json
 import os
 import tarfile
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from veomni_tpu.data.dataset import DATASET_REGISTRY
+from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.resilience.faults import fault_point
+from veomni_tpu.resilience.integrity import ShardRecordError
 from veomni_tpu.resilience.retry import RetryPolicy, retry_call
 from veomni_tpu.utils.logging import get_logger
 
@@ -63,7 +65,13 @@ class _JsonlShard:
     def read(self, i: int) -> Dict[str, Any]:
         with open(self.path, "rb") as f:
             f.seek(self._offsets[i])
-            return json.loads(f.readline())
+            raw = f.readline()
+        try:
+            return json.loads(raw)
+        except ValueError as e:
+            # bare JSONDecodeError loses WHICH shard/record rotted — the one
+            # fact bad-shard triage (and the poison-skip budget) needs
+            raise ShardRecordError(self.path, i, e) from e
 
 
 class _ParquetShard:
@@ -86,8 +94,15 @@ class _ParquetShard:
 
         g = int(np.searchsorted(self._bounds, i, side="right") - 1)
         if self._cached_group[0] != g:
-            with pq.ParquetFile(self.path) as pf:
-                self._cached_group = (g, pf.read_row_group(g).to_pylist())
+            try:
+                with pq.ParquetFile(self.path) as pf:
+                    self._cached_group = (g, pf.read_row_group(g).to_pylist())
+            except OSError:
+                raise  # transient I/O: stays retryable, not a poison record
+            except Exception as e:  # ArrowInvalid etc.: rotten row group
+                raise ShardRecordError(
+                    self.path, i, e, detail=f"row group {g}"
+                ) from e
         return self._cached_group[1][i - int(self._bounds[g])]
 
 
@@ -136,7 +151,15 @@ class _TarShard:
         with open(self.path, "rb") as f:
             for ext, off, size in self._groups[i]:
                 f.seek(off)
-                sample[ext] = self._decode(ext, f.read(size))
+                raw = f.read(size)
+                try:
+                    sample[ext] = self._decode(ext, raw)
+                except OSError:
+                    raise  # transient I/O: stays retryable
+                except Exception as e:  # json/int/npy parse: rotten member
+                    raise ShardRecordError(
+                        self.path, i, e, detail=f"member .{ext}"
+                    ) from e
         # webdataset convention: a lone .json payload IS the sample row
         if set(sample) == {"json"} and isinstance(sample["json"], dict):
             return sample["json"]
@@ -146,6 +169,9 @@ class _TarShard:
 def _read_record(reader, rec: int) -> Dict[str, Any]:
     """One fetch attempt (the retried unit; exceptions carry reader.path)."""
     fault_point("data.fetch")
+    # corrupt-mode drill point: damages the shard ON DISK before the read,
+    # so the decode below fails the way real record rot does
+    fault_point("data.record", context={"file": reader.path})
     return reader.read(rec)
 
 
@@ -165,7 +191,18 @@ def _open_shard(path: str):
 
 @DATASET_REGISTRY.register("streaming")
 class StreamingShardDataset:
-    """Deterministic sharded streaming with 3-integer exact resume."""
+    """Deterministic sharded streaming with 3-integer exact resume.
+
+    Poison-record policy: a record that fails decode (``ShardRecordError``,
+    with shard + index provenance) or the ``validate`` hook is NOT retried —
+    rot is persistent. With ``skip_budget == 0`` (default) it fails the run
+    fast; with a budget, up to that many distinct ``(shard, record)`` pairs
+    are skipped (sequential iteration drops them; random access substitutes
+    the next healthy record so batch shapes stay full), each recorded in
+    ``state_dict`` so a resumed run replays the identical skips with
+    identical budget accounting — bit-exact trajectories survive the
+    save/restore boundary. Budget exhaustion re-raises with the full skip
+    history."""
 
     def __init__(
         self,
@@ -178,19 +215,34 @@ class StreamingShardDataset:
         dp_size: int = 1,
         io_retries: int = 3,
         retry_base_s: float = 0.05,
+        skip_budget: int = 0,
+        validate: Optional[Callable[[Dict[str, Any]], Any]] = None,
         **_,
     ):
         # streaming corpora live on shared/remote filesystems where reads
         # fail transiently; shard opens + record fetches retry with
         # deterministic backoff (and carry the data.fetch fault point)
         self._retry_policy = RetryPolicy(retries=io_retries, base_delay_s=retry_base_s)
+        self.skip_budget = max(0, int(skip_budget))
+        self.validate = validate
+        # skipped (shard key, record) pairs IN SKIP ORDER — rank-local resume
+        # state; keys are corpus-root-relative paths, which keep the state
+        # relocatable with the corpus while staying distinct across
+        # same-named shards in different directories (a glob can span many)
+        self._skipped: List[Tuple[str, int]] = []
+        self._skipped_set: set = set()
         if os.path.isdir(path):
             shards = sorted(
                 os.path.join(path, f) for f in os.listdir(path)
                 if f.endswith(_SHARD_EXTS)
             )
+            self._skip_root = path
         else:
             shards = sorted(_glob.glob(path))
+            self._skip_root = (
+                os.path.commonpath([os.path.dirname(s) for s in shards])
+                if shards else ""
+            )
         if not shards:
             raise FileNotFoundError(f"no shards under {path!r}")
         self.shards = shards
@@ -222,11 +274,57 @@ class StreamingShardDataset:
         return r
 
     def _fetch(self, reader, rec: int) -> Dict[str, Any]:
-        """One record fetch: fault-injectable, retried. No per-call closure
-        or eager description string — this is the innermost loader loop, and
-        retry_call's qualname fallback only materializes on failure."""
-        return retry_call(
+        """One record fetch: fault-injectable, retried, validated. No
+        per-call closure or eager description string — this is the innermost
+        loader loop, and retry_call's qualname fallback only materializes on
+        failure. Decode failures (``ShardRecordError``) bypass the retry
+        classification (rot is persistent) and surface to the poison-budget
+        accounting in the callers."""
+        row = retry_call(
             _read_record, reader, rec, policy=self._retry_policy,
+        )
+        if self.validate is not None:
+            try:
+                ok = self.validate(row)
+            except Exception as e:
+                raise ShardRecordError(
+                    reader.path, rec, e, detail="validation hook"
+                ) from e
+            if ok is False:
+                raise ShardRecordError(
+                    reader.path, rec,
+                    ValueError("validation hook rejected record"),
+                    detail="validation hook",
+                )
+        return row
+
+    def _note_poison(self, err: ShardRecordError) -> None:
+        """Budget accounting for one poison record; raises when exhausted.
+        Re-encounters of an already-recorded pair — post-resume replay, or
+        the dataloader's ``__len__`` probe touching the same record training
+        later reads — consume NO budget, so replay accounting is exact."""
+        key = (os.path.relpath(err.shard, self._skip_root), int(err.record))
+        if key in self._skipped_set:
+            logger.warning(
+                "re-skipping known poison record %s[%d] (replay)",
+                err.shard, err.record,
+            )
+            return
+        if len(self._skipped) >= self.skip_budget:
+            raise ShardRecordError(
+                err.shard, err.record, err.cause,
+                detail=(
+                    f"poison-record skip budget exhausted "
+                    f"(data_skip_budget={self.skip_budget}, already skipped "
+                    f"{self._skipped})"
+                ),
+            ) from err
+        self._skipped.append(key)
+        self._skipped_set.add(key)
+        get_registry().counter("integrity.data_skipped").inc()
+        logger.warning(
+            "skipping poison record %s[%d] (%d/%d budget used): %s",
+            err.shard, err.record, len(self._skipped), self.skip_budget, err,
         )
 
     def _shard_len(self, shard: str) -> int:
@@ -262,7 +360,12 @@ class StreamingShardDataset:
             order = self._rec_order(shard, self._epoch)
             reader = self._reader(shard)
             while self._rec_pos < len(order):
-                row = self._fetch(reader, int(order[self._rec_pos]))
+                try:
+                    row = self._fetch(reader, int(order[self._rec_pos]))
+                except ShardRecordError as e:
+                    self._note_poison(e)  # raises once the budget is spent
+                    self._rec_pos += 1
+                    continue
                 self._rec_pos += 1
                 yield self.transform(row) if self.transform else row
             self._rec_pos = 0
@@ -270,17 +373,22 @@ class StreamingShardDataset:
         self._shard_pos = 0
         self._epoch += 1
 
-    def state_dict(self) -> Dict[str, int]:
+    def state_dict(self) -> Dict[str, Any]:
         return {
             "epoch": self._epoch,
             "shard_pos": self._shard_pos,
             "rec_pos": self._rec_pos,
+            # list-of-lists (JSON-stable) in skip order: restoring makes the
+            # resumed run replay the identical skips with identical budget
+            "skipped": [[s, r] for s, r in self._skipped],
         }
 
-    def load_state_dict(self, state: Dict[str, int]) -> None:
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
         self._epoch = int(state.get("epoch", 0))
         self._shard_pos = int(state.get("shard_pos", 0))
         self._rec_pos = int(state.get("rec_pos", 0))
+        self._skipped = [(str(s), int(r)) for s, r in state.get("skipped", [])]
+        self._skipped_set = set(self._skipped)
 
     # -- random access (weighted mixing) ------------------------------------
     def _bounds(self):
@@ -298,10 +406,26 @@ class StreamingShardDataset:
 
     def __getitem__(self, idx: int) -> Dict[str, Any]:
         """Linear (epoch-0, unshuffled, all-rank) order — lets a streaming
-        source plug into WeightedMultiSourceDataset's cursor mixing."""
+        source plug into WeightedMultiSourceDataset's cursor mixing.
+
+        A poison record here cannot be dropped (the caller is filling a
+        fixed batch shape), so within the skip budget it deterministically
+        substitutes the next healthy record in linear order (wrapping) —
+        the same substitution on every encounter, before and after resume."""
         b = self._bounds()
-        if idx < 0 or idx >= b[-1]:
+        total = int(b[-1])
+        if idx < 0 or idx >= total:
             raise IndexError(idx)
-        si = int(np.searchsorted(b, idx, side="right") - 1)
-        row = self._fetch(self._reader(self.shards[si]), idx - int(b[si]))
-        return self.transform(row) if self.transform else row
+        probe = idx
+        for _ in range(total):  # at most one full lap; budget raises earlier
+            si = int(np.searchsorted(b, probe, side="right") - 1)
+            try:
+                row = self._fetch(self._reader(self.shards[si]), probe - int(b[si]))
+            except ShardRecordError as e:
+                self._note_poison(e)  # raises once the budget is spent
+                probe = (probe + 1) % total
+                continue
+            return self.transform(row) if self.transform else row
+        raise ShardRecordError(  # unreachable with a finite budget
+            self.shards[0], idx, RuntimeError("every record poisoned"),
+        )

@@ -8,6 +8,9 @@ JAX pjit and TPUv4", PAPERS.md). This package supplies the three legs:
                    every recovery path below is exercisable on CPU in tier-1;
 * ``retry``      — bounded deterministic-backoff retry for checkpoint and
                    data-fetch I/O;
+* ``integrity``  — CRC32 manifests + verification over checkpoint
+                   generations (quarantine + multi-generation restore
+                   fallback) and poison-record provenance for streaming data;
 * ``supervisor`` — train-loop anomaly supervision (device-side finite-loss
                    flag -> skip-step -> checkpoint rollback -> abort), a hang
                    watchdog, and SIGTERM/preemption-safe graceful shutdown.
@@ -22,6 +25,15 @@ from veomni_tpu.resilience.faults import (
     fault_point,
     fired_faults,
 )
+from veomni_tpu.resilience.integrity import (
+    CheckpointCorruptError,
+    ShardRecordError,
+    VerifyReport,
+    crc32_file,
+    read_manifest,
+    verify_manifest,
+    write_manifest,
+)
 from veomni_tpu.resilience.retry import RetryPolicy, retry_call
 from veomni_tpu.resilience.supervisor import (
     AnomalyBudgetExceeded,
@@ -33,17 +45,24 @@ from veomni_tpu.resilience.supervisor import (
 
 __all__ = [
     "AnomalyBudgetExceeded",
+    "CheckpointCorruptError",
     "FaultAction",
     "GracefulShutdown",
     "InjectedFault",
     "RetryPolicy",
     "RollbackImpossible",
+    "ShardRecordError",
     "SupervisorPolicy",
     "TrainSupervisor",
+    "VerifyReport",
     "arm_from_env",
     "configure_faults",
+    "crc32_file",
     "disarm_faults",
     "fault_point",
     "fired_faults",
+    "read_manifest",
     "retry_call",
+    "verify_manifest",
+    "write_manifest",
 ]

@@ -11,11 +11,16 @@ Fault points wired through the stack:
 ==============  ==============================================================
 ``ckpt.save``   inside the checkpointer's per-attempt save dispatch (retried)
 ``ckpt.restore``inside the checkpointer's per-attempt restore (retried)
+``ckpt.manifest`` right after rank 0 writes a committed generation's
+                integrity manifest (context: the step dir) — the ``corrupt``
+                drill point for storage rot on checkpoint payloads
 ``data.fetch``  streaming shard record reads (retried, fires per attempt)
                 AND the prefetch worker's per-batch pull (NOT retried: an
                 exception there exercises the worker->consumer error
                 transport and fails the run fast). With streaming+prefetch
                 both active the two sites share one hit counter.
+``data.record`` per streaming record read, BEFORE decode (context: the shard
+                file) — the ``corrupt`` drill point for poisoned data records
 ``step.loss``   host-side observation of the train step's finite-loss flag
 ==============  ==============================================================
 
@@ -25,17 +30,31 @@ Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
 
     [{"point": "ckpt.save", "mode": "exception", "hit": 2, "times": 3},
      {"point": "step.loss", "mode": "nan", "hit": 4},
-     {"point": "data.fetch", "mode": "hang", "hit": 1, "seconds": 2.0}]
+     {"point": "data.fetch", "mode": "hang", "hit": 1, "seconds": 2.0},
+     {"point": "ckpt.manifest", "mode": "corrupt", "hit": 4, "op": "bitflip"}]
 
 * ``point``   (required) fault-point name;
 * ``mode``    ``exception`` (default; raises :class:`InjectedFault`, an
   ``OSError`` so the retry layer treats it as I/O), ``nan`` (returns a
   :class:`FaultAction` the site applies — poisons the observed loss signal),
-  ``hang`` (sleeps ``seconds`` — bounded, so a watchdog test can't wedge CI);
+  ``hang`` (sleeps ``seconds`` — bounded, so a watchdog test can't wedge CI),
+  ``corrupt`` (damages a file ON DISK — deterministic truncate-or-bitflip —
+  then returns normally: the *later* read of those bytes is what fails, like
+  real storage rot);
 * ``hit``     1-based hit index at which the fault starts firing (default 1);
 * ``times``   consecutive hits that fire from ``hit`` on (default 1);
 * ``seconds`` hang duration (default 30);
-* ``message`` exception text override.
+* ``message`` exception text override;
+* ``op``      corrupt only: ``bitflip`` (default; XOR 0xFF one byte in place
+  — same size, only a ``full`` digest verify catches it) or ``truncate``
+  (cut the file short — a ``size`` verify catches it);
+* ``file``    corrupt only: the target, resolved against the site's context
+  dir (glob allowed, first sorted match). Default: the LARGEST file under
+  the context dir (for a checkpoint dir that is the array payload), or the
+  context file itself when the site names one;
+* ``offset``  corrupt/bitflip only: byte offset to flip (default -1 = the
+  middle byte — deterministic, and never the final partial page a truncate
+  test would also catch).
 
 Hit counters are per point and shared across specs targeting the same point,
 so "fail hits 2-4" composes with "hang hit 7" on one point deterministically.
@@ -55,9 +74,12 @@ logger = get_logger(__name__)
 
 ENV_PLAN = "VEOMNI_FAULT_PLAN"
 
-KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "data.fetch", "step.loss")
+KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "data.fetch",
+                "data.record", "step.loss")
 
-_MODES = ("exception", "nan", "hang")
+_MODES = ("exception", "nan", "hang", "corrupt")
+
+_CORRUPT_OPS = ("bitflip", "truncate")
 
 
 class InjectedFault(OSError):
@@ -71,11 +93,13 @@ class InjectedFault(OSError):
 @dataclass
 class FaultAction:
     """What an armed fault point decided for this hit (returned for modes the
-    call site must apply itself, i.e. ``nan``)."""
+    call site must apply itself, i.e. ``nan``; ``corrupt`` actions carry the
+    damaged path for test assertions)."""
 
     point: str
     mode: str
     hit: int
+    target: str = ""
 
 
 @dataclass
@@ -86,6 +110,9 @@ class _FaultSpec:
     times: int = 1
     seconds: float = 30.0
     message: str = ""
+    op: str = "bitflip"
+    file: str = ""
+    offset: int = -1
 
     def covers(self, hit: int) -> bool:
         return self.hit <= hit < self.hit + self.times
@@ -123,6 +150,11 @@ def _parse_specs(raw: Any) -> List[_FaultSpec]:
             raise ValueError(
                 f"mode 'nan' only applies to point 'step.loss', not {point!r}"
             )
+        op = entry.get("op", "bitflip")
+        if op not in _CORRUPT_OPS:
+            raise ValueError(
+                f"unknown corrupt op {op!r}; choose from {_CORRUPT_OPS}"
+            )
         if point not in KNOWN_POINTS:
             # warn, don't reject (plans may target points added later) — but
             # a typo'd name would otherwise arm a drill that tests nothing
@@ -137,6 +169,9 @@ def _parse_specs(raw: Any) -> List[_FaultSpec]:
             times=int(entry.get("times", 1)),
             seconds=float(entry.get("seconds", 30.0)),
             message=str(entry.get("message", "")),
+            op=op,
+            file=str(entry.get("file", "")),
+            offset=int(entry.get("offset", -1)),
         ))
     return specs
 
@@ -180,13 +215,84 @@ def fired_faults() -> List[FaultAction]:
     return list(_PLAN.fired) if _PLAN is not None else []
 
 
-def fault_point(name: str) -> Optional[FaultAction]:
+def _resolve_corrupt_target(spec: _FaultSpec,
+                            context: Optional[Dict[str, str]]) -> Optional[str]:
+    """The file a ``corrupt`` spec damages. Explicit ``spec.file`` resolves
+    against the site's context dir (glob allowed, first sorted match);
+    otherwise the context's named file, or the LARGEST file under the
+    context dir — for a checkpoint generation that is the array payload,
+    which is exactly what real storage rot statistically hits."""
+    ctx = context or {}
+    base = ctx.get("dir") or (
+        os.path.dirname(ctx["file"]) if ctx.get("file") else ""
+    )
+    if spec.file:
+        if not os.path.isabs(spec.file) and not base:
+            # a relative pattern at a context-less point would glob the
+            # process CWD and damage an unrelated file; refuse (the caller
+            # warns that the drill corrupted nothing)
+            return None
+        pattern = spec.file if os.path.isabs(spec.file) else os.path.join(
+            base, spec.file
+        )
+        import glob as _glob
+
+        matches = sorted(
+            p for p in _glob.glob(pattern, recursive=True) if os.path.isfile(p)
+        )
+        return matches[0] if matches else None
+    if ctx.get("file"):
+        return ctx["file"] if os.path.isfile(ctx["file"]) else None
+    if base:
+        best, best_size = None, -1
+        for dirpath, _dirs, files in sorted(os.walk(base)):
+            for fname in sorted(files):
+                full = os.path.join(dirpath, fname)
+                try:
+                    size = os.path.getsize(full)
+                except OSError:
+                    continue
+                if size > best_size:
+                    best, best_size = full, size
+        return best
+    return None
+
+
+def _apply_corruption(spec: _FaultSpec, target: str) -> None:
+    size = os.path.getsize(target)
+    if spec.op == "truncate":
+        new_size = max(0, size // 2)
+        with open(target, "r+b") as f:
+            f.truncate(new_size)
+        logger.warning_rank0(
+            "fault corrupted %s: truncated %d -> %d bytes", target, size, new_size
+        )
+    else:  # bitflip: same size, so only a full digest verify can see it
+        if size == 0:
+            logger.warning_rank0("fault corrupt target %s is empty; no-op", target)
+            return
+        off = spec.offset if 0 <= spec.offset < size else size // 2
+        with open(target, "r+b") as f:
+            f.seek(off)
+            byte = f.read(1)
+            f.seek(off)
+            f.write(bytes([byte[0] ^ 0xFF]))
+        logger.warning_rank0(
+            "fault corrupted %s: flipped byte at offset %d of %d", target, off, size
+        )
+
+
+def fault_point(name: str,
+                context: Optional[Dict[str, str]] = None) -> Optional[FaultAction]:
     """Instrumentation hook. Unarmed: one None-check, zero overhead.
 
     Armed: bumps the point's hit counter; if a spec covers this hit, applies
     the action — ``exception`` raises :class:`InjectedFault`, ``hang`` sleeps
     (bounded) then returns the action, ``nan`` returns the action for the
-    call site to apply. Returns None when nothing fired.
+    call site to apply, ``corrupt`` damages the resolved file on disk and
+    returns (the later READ of those bytes is the failure, like real rot).
+    ``context`` is site-supplied corruption scope: ``{"dir": step_dir}`` or
+    ``{"file": shard_path}``. Returns None when nothing fired.
     """
     plan = _PLAN
     if plan is None:
@@ -197,6 +303,16 @@ def fault_point(name: str) -> Optional[FaultAction]:
         if spec.point != name or not spec.covers(hit):
             continue
         action = FaultAction(point=name, mode=spec.mode, hit=hit)
+        if spec.mode == "corrupt":
+            target = _resolve_corrupt_target(spec, context)
+            if target is None:
+                logger.warning_rank0(
+                    "corrupt fault at %s (hit %d) resolved NO target file "
+                    "(context=%r, file=%r) — drill corrupted nothing",
+                    name, hit, context, spec.file,
+                )
+                continue
+            action.target = target
         plan.fired.append(action)
         logger.warning_rank0(
             "fault injected: point=%s mode=%s hit=%d", name, spec.mode, hit
@@ -207,5 +323,7 @@ def fault_point(name: str) -> Optional[FaultAction]:
             )
         if spec.mode == "hang":
             time.sleep(spec.seconds)
+        if spec.mode == "corrupt":
+            _apply_corruption(spec, action.target)
         return action
     return None

@@ -48,23 +48,40 @@ def retry_call(
 ) -> Any:
     """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
 
-    Each failed attempt logs at warning with the remaining budget; exhaustion
-    logs at error and re-raises the last exception unchanged.
+    Each RETRIED failure logs at warning with the remaining budget and bumps
+    the ``retry.attempts`` counter (it counts retries actually burned, not
+    total failed attempts: the exhausting failure is not retried, so
+    ``retries=2`` records 2, not 3); exhaustion bumps ``retry.exhausted``,
+    logs at error WITH the attempt count and total backoff burned (the
+    original exception re-raises unchanged, so without this line there would
+    be no evidence retries ever happened), and re-raises.
     """
-    what = description or getattr(fn, "__qualname__", repr(fn))
+    what = None
     attempt = 0
+    total_backoff = 0.0
     while True:
         try:
             return fn(*args, **kwargs)
         except policy.retry_on as e:
+            # failure path only: the registry import (observability-layer;
+            # retry is leaf) and the qualname fallback stay off the success
+            # path — this wraps the innermost record-fetch loop
+            from veomni_tpu.observability.metrics import get_registry
+
+            if what is None:
+                what = description or getattr(fn, "__qualname__", repr(fn))
             if attempt >= policy.retries:
+                get_registry().counter("retry.exhausted").inc()
                 logger.error(
-                    "%s: retry budget exhausted after %d attempt(s): %s",
-                    what, attempt + 1, e,
+                    "%s: retry budget exhausted after %d attempt(s) "
+                    "(%.3gs total backoff): %s",
+                    what, attempt + 1, total_backoff, e,
                 )
                 raise
             delay = policy.delay(attempt)
             attempt += 1
+            total_backoff += delay
+            get_registry().counter("retry.attempts").inc()
             logger.warning(
                 "%s failed (attempt %d/%d): %s; retrying in %.3gs",
                 what, attempt, policy.retries + 1, e, delay,

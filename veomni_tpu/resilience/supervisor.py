@@ -198,11 +198,18 @@ class TrainSupervisor:
     def health(self) -> Dict[str, Any]:
         """/healthz document (observability exporter): healthy until the
         anomaly budget blows (``abort`` is sticky); a mid-escalation
-        skip/rollback reports degraded-but-healthy with full context."""
+        skip/rollback reports degraded-but-healthy with full context.
+        Integrity counts ride along so a probe sees storage rot (quarantined
+        checkpoint generations, skipped poison records) without log
+        scraping."""
+        reg = get_registry()
         return {
             "healthy": self.last_verdict != "abort",
             "last_verdict": self.last_verdict,
             "consecutive_anomalies": self.consecutive,
+            "ckpt_quarantined": int(reg.counter("integrity.ckpt_quarantined").value),
+            "ckpt_fallbacks": int(reg.counter("integrity.ckpt_fallbacks").value),
+            "data_skipped": int(reg.counter("integrity.data_skipped").value),
             **self.stats(),
         }
 

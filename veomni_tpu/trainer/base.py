@@ -217,8 +217,15 @@ class BaseTrainer:
 
     def _build_dataset(self):
         d = self.args.data
+        kwargs = {}
+        if d.dataset_type == "streaming":
+            # poison-record skip budget (resilience/integrity.py): bounded
+            # tolerance for undecodable shard records, replayed bit-exactly
+            # across resume via the rank-local cursor state
+            kwargs["skip_budget"] = self.args.train.data_skip_budget
         self.dataset = build_dataset(
-            d.dataset_type, path=d.train_path, transform=self.data_transform
+            d.dataset_type, path=d.train_path, transform=self.data_transform,
+            **kwargs,
         )
 
     def _build_dataloader(self):
@@ -417,6 +424,7 @@ class BaseTrainer:
             max_to_keep=t.max_ckpt_to_keep,
             io_retries=t.resilience_io_retries,
             retry_base_s=t.resilience_retry_base_s,
+            verify_mode=t.ckpt_verify,
         )
 
     def _inner_loss_fn(self, model):
@@ -481,16 +489,20 @@ class BaseTrainer:
         return {k: P(None, ps.dp_axes, ps.sp_axes) for k in keys}
 
     # ----------------------------------------------------------------- resume
-    def try_resume(self, step: Optional[int] = None):
-        """``step=None`` walks back from the latest committed checkpoint;
-        an explicit step pins the restore (supervisor rollback targets a
-        checkpoint from BEFORE the anomalous window)."""
+    def try_resume(self, step: Optional[int] = None,
+                   max_step: Optional[int] = None):
+        """``step=None`` walks back from the latest committed-and-verified
+        checkpoint (generations failing manifest verification are
+        quarantined and skipped); ``max_step`` caps the walk (supervisor
+        rollback targets checkpoints from BEFORE the anomalous window); an
+        explicit ``step`` pins the restore with no fallback."""
         restored, extra = self.checkpointer.load(
             jax.tree.map(
                 lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
                 self.abstract_state, self.state_shardings,
             ),
             step=step,
+            max_step=max_step,
         )
         if restored is not None:
             # normalize on-device layouts to what a fresh jit would produce:
@@ -626,21 +638,25 @@ class BaseTrainer:
         # target a checkpoint committed BEFORE the anomalous run began: a
         # save that landed inside the window (detection lags by the
         # in-flight depth) would make the rewind a no-op — the cursor must
-        # back up past the anomalous batches so the replay re-runs them
-        target = None
+        # back up past the anomalous batches so the replay re-runs them.
+        # max_step (not a pinned step) keeps the checkpointer's verify-and-
+        # fall-back walk in play: a rollback must never restore from a
+        # generation that fails manifest verification, so a corrupt target
+        # quarantines and the walk drops to the next-newest verified one.
+        max_step = None
         first_bad = sup.consec_start
         committed = self.checkpointer.list_steps()
         if first_bad is not None:
             before = [s for s in committed if s < first_bad]
             if before:
-                target = before[-1]
+                max_step = before[-1]
             elif committed:
                 logger.warning_rank0(
                     "no committed checkpoint precedes anomalous step %d; "
                     "restoring the latest (cursor will NOT re-run the "
                     "anomalous batches)", first_bad,
                 )
-        restored, extra = self.try_resume(step=target)
+        restored, extra = self.try_resume(max_step=max_step)
         if not restored:
             raise RollbackImpossible(
                 "rollback requested but no committed checkpoint exists "
