@@ -24,14 +24,32 @@ def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_ch
     """The axon TPU tunnel can wedge its chip claim (a killed process leaves
     the grant held), after which backend init hangs indefinitely. If the
     bench can't produce a measurement in time, emit an honest zero-valued
-    record — now including the thread-stack dump showing WHERE it wedged —
-    instead of hanging the driver (see BENCH_NOTES.md). ``metric`` keeps the
-    zero record in the right bench series (train vs serve). Uses the shared
+    record — now including the thread-stack dump showing WHERE it wedged and
+    the flight-recorder post-mortem path showing WHAT it was doing — instead
+    of hanging the driver (see BENCH_NOTES.md). ``metric`` keeps the zero
+    record in the right bench series (train vs serve). Uses the shared
     ``utils.helper.Watchdog`` (same stall detector as the train-loop
     supervisor); caller must ``.stop()`` it before printing the real record
     so the dog never races a measurement out of a block-buffered stdout via
     its os._exit."""
+    import tempfile
+
+    from veomni_tpu.observability.flight_recorder import (
+        configure_flight_recorder,
+    )
     from veomni_tpu.utils.helper import Watchdog
+
+    # the bench has no output_dir; without this the dog's post-mortem falls
+    # back to the launch CWD (which may be read-only). Default is a
+    # per-PROCESS dir (rank is 0 for every bench, so a shared /tmp would
+    # collide two concurrent benches on one postmortem-0.json), created
+    # lazily by the dump itself so the common no-stall run leaks nothing.
+    # The stall JSON below records the exact path either way.
+    configure_flight_recorder(
+        dump_dir=os.environ.get("BENCH_OUT")
+        or os.path.join(tempfile.gettempdir(),
+                        f"veomni-bench-pm-{os.getpid()}")
+    )
 
     def on_stall(stack_dump: str):
         print(json.dumps({
@@ -41,11 +59,16 @@ def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_ch
                     "(TPU init or run stalled); last good numbers in BENCH_NOTES.md",
             "vs_baseline": 0,
             "watchdog_stack_dump": stack_dump,
+            # the dog wrote postmortem-<rank>.json (event ring + metrics +
+            # stacks) just before invoking this callback; wd is late-bound
+            # and the dog can only fire timeout_s after it is assigned
+            "postmortem": wd.last_postmortem_path,
         }), flush=True)
 
-    return Watchdog(
+    wd = Watchdog(
         timeout_s, on_stall=on_stall, exit_code=3, description=f"bench ({metric})"
     ).start()
+    return wd
 
 
 BENCH_PRESETS = {
@@ -342,6 +365,17 @@ def run_serve_bench(
     dt = time.perf_counter() - t0
     total = sum(len(outs[rid].token_ids) for rid in ids)
     ttfts = [outs[rid].ttft_s for rid in ids if outs[rid].ttft_s is not None]
+
+    # per-request latency distribution over the TIMED requests only (the
+    # outputs carry the request_trace rollup, so warmup traffic in the
+    # process-global histograms can't skew these) — the numbers the
+    # SLO-scheduling roadmap item regresses against
+    def _pctl(vals, q):
+        return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+
+    waits = [outs[rid].queue_wait_s for rid in ids
+             if outs[rid].queue_wait_s is not None]
+    tpots = [outs[rid].tpot_s for rid in ids if outs[rid].tpot_s is not None]
     return {
         "decode_tok_s": total / dt,
         "ttft_mean_s": sum(ttfts) / max(1, len(ttfts)),
@@ -354,6 +388,14 @@ def run_serve_bench(
         "max_new_tokens": max_new_tokens,
         "preset": preset,
         "preemptions": eng.scheduler.preemption_count,
+        "queue_wait_p50_s": _pctl(waits, 50),
+        "queue_wait_p99_s": _pctl(waits, 99),
+        "tpot_p50_s": _pctl(tpots, 50),
+        "tpot_p99_s": _pctl(tpots, 99),
+        # from the timed outputs, like the percentiles above — the engine-
+        # cumulative scheduler counter would fold warmup traffic in
+        "preemptions_per_request": sum(
+            outs[rid].preemptions for rid in ids) / max(1, n_requests),
     }
 
 
@@ -383,6 +425,14 @@ def _serve_main(preset: str, watchdog=None):
         # nominal serving north star: 1k decode tok/s on one chip (no
         # published single-v5e continuous-batching baseline exists)
         "vs_baseline": round(r["decode_tok_s"] / 1000.0, 4),
+        # per-request latency trajectory (observability/request_trace.py):
+        # the SLO-scheduling roadmap item tunes priority classes against
+        # exactly these percentiles, so BENCH_*.json must carry them
+        "queue_wait_p50_s": round(r["queue_wait_p50_s"], 5),
+        "queue_wait_p99_s": round(r["queue_wait_p99_s"], 5),
+        "tpot_p50_s": round(r["tpot_p50_s"], 5),
+        "tpot_p99_s": round(r["tpot_p99_s"], 5),
+        "preemptions_per_request": round(r["preemptions_per_request"], 3),
     }), flush=True)
 
 

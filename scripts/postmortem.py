@@ -1,0 +1,114 @@
+"""Merge rank-local flight-recorder dumps into one ordered fleet view.
+
+Each rank's failure artifact (``postmortem-<rank>.json``, written by
+``observability/flight_recorder.py`` on watchdog fire / supervisor abort /
+uncaught exception / SIGTERM) timestamps its events with that process's
+monotonic clock — incomparable across hosts. Every dump therefore carries a
+paired anchor (wall time + perf counter at dump time); this tool maps each
+event onto the shared wall axis via
+
+    wall(event) = anchor.wall_time_s - (anchor.perf_ns - event.ts_ns) / 1e9
+
+and prints one merged, monotonically ordered timeline with per-rank
+provenance, plus a per-rank header (reason, event count, drops, in-flight
+requests). ``--json`` additionally writes the merged document for tooling.
+
+Usage:
+  python scripts/postmortem.py postmortem-0.json postmortem-1.json
+  python scripts/postmortem.py out/postmortem-*.json --json merged.json --tail 80
+"""
+
+import argparse
+import json
+
+
+def load_dump(path):
+    with open(path) as f:
+        doc = json.load(f)
+    for key in ("rank", "anchor", "events"):
+        if key not in doc:
+            raise ValueError(f"{path}: not a flight-recorder dump (no {key!r})")
+    return doc
+
+
+def _wall(anchor, ts_ns):
+    return anchor["wall_time_s"] - (anchor["perf_ns"] - ts_ns) / 1e9
+
+
+def merge_dumps(paths):
+    """Load + merge dumps; returns ``{"ranks": [...], "events": [...]}`` with
+    events carrying ``wall_s`` (shared axis) and ``rank``, sorted ascending —
+    i.e. one monotonic fleet timeline."""
+    ranks = []
+    merged = []
+    for path in paths:
+        doc = load_dump(path)
+        anchor = doc["anchor"]
+        ranks.append({
+            "path": path,
+            "rank": doc["rank"],
+            "reason": doc.get("reason", ""),
+            "events": len(doc["events"]),
+            "dropped": doc.get("dropped", 0),
+        })
+        for ev in doc["events"]:
+            merged.append({
+                "wall_s": _wall(anchor, ev["ts_ns"]),
+                "rank": doc["rank"],
+                "kind": ev["kind"],
+                "cid": ev.get("cid", ""),
+                "payload": ev.get("payload"),
+            })
+    merged.sort(key=lambda e: e["wall_s"])
+    return {"ranks": ranks, "events": merged}
+
+
+def format_timeline(doc, tail=0):
+    """Human-readable fleet view: header per rank, then the ordered events
+    (``--tail N`` keeps only the last N — the seconds before the failure)."""
+    lines = []
+    for r in sorted(doc["ranks"], key=lambda r: r["rank"]):
+        lines.append(
+            f"# rank {r['rank']}: {r['reason'] or '<no reason>'} — "
+            f"{r['events']} events ({r['dropped']} dropped) [{r['path']}]"
+        )
+    events = doc["events"]
+    if tail > 0:
+        skipped = max(0, len(events) - tail)
+        if skipped:
+            lines.append(f"# ... {skipped} earlier events elided (--tail)")
+        events = events[-tail:]
+    t0 = events[0]["wall_s"] if events else 0.0
+    for ev in events:
+        extra = ""
+        if ev["cid"]:
+            extra += f" cid={ev['cid']}"
+        if ev["payload"]:
+            extra += " " + json.dumps(ev["payload"], sort_keys=True,
+                                      default=str)
+        lines.append(
+            f"[+{ev['wall_s'] - t0:10.4f}s] rank{ev['rank']} "
+            f"{ev['kind']}{extra}"
+        )
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="+", help="postmortem-<rank>.json files")
+    ap.add_argument("--json", default="",
+                    help="also write the merged document here")
+    ap.add_argument("--tail", type=int, default=0,
+                    help="print only the last N merged events")
+    args = ap.parse_args()
+    doc = merge_dumps(args.dumps)
+    print(format_timeline(doc, tail=args.tail))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f)
+        print(f"# merged {len(args.dumps)} dumps, {len(doc['events'])} "
+              f"events -> {args.json}")
+
+
+if __name__ == "__main__":
+    main()

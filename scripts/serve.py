@@ -15,7 +15,8 @@ Run:
       --temperature 0.8 --top-p 0.9
 
 Env knobs (flags win): VEOMNI_SERVE_SLOTS, VEOMNI_SERVE_BLOCK,
-VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS. VEOMNI_METRICS_PORT serves
+VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS, VEOMNI_SERVE_OUT
+(post-mortem dump dir, default CWD). VEOMNI_METRICS_PORT serves
 Prometheus /metrics + /healthz while the pump runs (docs/observability.md).
 """
 
@@ -88,15 +89,24 @@ def main():
         num_slots=args.slots, block_size=args.block_size,
         max_model_len=args.max_model_len, log_every_steps=args.log_steps,
     ))
-    # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz for the pump loop
-    # (the engine feeds the same registry the trainer exports through)
+    # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz + /debug/flight +
+    # /debug/requests (per-request timelines) for the pump loop (the engine
+    # feeds the same registry the trainer exports through)
     from veomni_tpu.observability.exporter import maybe_start_from_env
+    from veomni_tpu.observability.flight_recorder import (
+        configure_flight_recorder,
+    )
 
+    # post-mortems (watchdog / crash) land somewhere deliberate, not
+    # whatever CWD the operator launched from
+    configure_flight_recorder(
+        dump_dir=os.environ.get("VEOMNI_SERVE_OUT", ".")
+    )
     exporter = maybe_start_from_env(health_fn=lambda: {
         "healthy": True,
         "queue_depth": engine.scheduler.queue_depth,
         "num_running": engine.scheduler.num_running,
-    })
+    }, requests_fn=engine.tracer.snapshot)
 
     sampling = SamplingParams(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
@@ -112,13 +122,22 @@ def main():
         ap.error("nothing to do: pass --prompt-ids and/or --synthetic N")
 
     reqs = [Request(prompt_ids=p, sampling=sampling) for p in prompts]
-    for ev in engine.generate(reqs):
-        line = {"request_id": ev.request_id, "index": ev.index,
-                "token": ev.token}
-        if ev.finished:
-            line["finished"] = ev.finish_reason
-        print(json.dumps(line), flush=True)
-    outs = engine.run()  # no-op drain; collects final outputs
+    try:
+        for ev in engine.generate(reqs):
+            line = {"request_id": ev.request_id, "index": ev.index,
+                    "token": ev.token}
+            if ev.finished:
+                line["finished"] = ev.finish_reason
+            print(json.dumps(line), flush=True)
+        outs = engine.run()  # no-op drain; collects final outputs
+    except BaseException as e:
+        # same contract as trainer.train(): a pump that dies mid-decode
+        # leaves its request/event history in a post-mortem, not in the void
+        from veomni_tpu.observability.flight_recorder import dump_postmortem
+
+        dump_postmortem(f"exception:{type(e).__name__}",
+                        extra={"error": str(e)[:2000]})
+        raise
     print(json.dumps({"metrics": engine.metrics()}), flush=True)
     if exporter is not None:
         exporter.stop()

@@ -191,6 +191,12 @@ class TrainingArguments:
     # dump the host span buffer as chrome-trace JSON here at train end
     # ("" = off; merge across hosts with scripts/merge_chrome_trace.py)
     observability_chrome_trace: str = ""
+    # always-on flight recorder (observability/flight_recorder.py): bounded
+    # ring of structured events (step lifecycle, checkpoint commits,
+    # supervisor verdicts, retries, fault hits) dumped to
+    # output_dir/postmortem-<rank>.json on watchdog fire / supervisor abort
+    # / uncaught exception / SIGTERM. Ring size in events; 0 disables.
+    observability_flight_events: int = 4096
     enable_profiling: bool = False
     # VEOMNI_PROFILE_START / VEOMNI_PROFILE_END env vars override the window
     profile_start_step: int = 3

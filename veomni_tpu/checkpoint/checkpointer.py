@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from veomni_tpu.observability.flight_recorder import record as flight_record
 from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.observability.spans import span
 from veomni_tpu.resilience.faults import fault_point
@@ -245,6 +246,7 @@ class Checkpointer:
                 # in the background, so the full-tree CRC read doesn't stall
                 # this save boundary (joined at the next wait()/load())
                 if self._inflight_step is not None:
+                    flight_record("ckpt.commit", cid=str(self._inflight_step))
                     self._start_manifest(self._inflight_step)
                     self._inflight_step = None
             step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
@@ -257,6 +259,7 @@ class Checkpointer:
         reg = get_registry()
         reg.counter("ckpt.saves").inc()
         reg.counter("ckpt.saved_bytes").inc(_tree_bytes(train_state))
+        flight_record("ckpt.save", cid=str(step), async_save=self.async_save)
         # dedupe only records a SUCCESSFUL dispatch (on failure the raise
         # above leaves the set untouched, so a later attempt of this step —
         # e.g. the train-end final save — isn't silently skipped)
@@ -266,6 +269,7 @@ class Checkpointer:
         self._quarantined.discard(step)
         self._inflight_step = step if self.async_save else None
         if not self.async_save:  # sync: committed right here
+            flight_record("ckpt.commit", cid=str(step))
             self._write_manifest(step)
         logger.info_rank0("checkpoint save dispatched: step %d -> %s", step, path)
         self._prune()
@@ -284,6 +288,7 @@ class Checkpointer:
             # on disk when it returns, so the inflight digest runs inline
             self._join_manifest()
             if self._inflight_step is not None:
+                flight_record("ckpt.commit", cid=str(self._inflight_step))
                 self._write_manifest(self._inflight_step)
             self._inflight_step = None
 
@@ -423,6 +428,7 @@ class Checkpointer:
         # fresh generation, not be skipped as "already dispatched"
         self._saved_steps.discard(step)
         get_registry().counter("integrity.ckpt_quarantined").inc()
+        flight_record("ckpt.quarantine", cid=str(step), reason=reason[:200])
         logger.error("QUARANTINING checkpoint step %d: %s", step, reason)
         if jax.process_index() != 0:
             return  # rename is rank 0's job; the in-memory set covers this rank
@@ -567,6 +573,11 @@ class Checkpointer:
                         # dirs that don't exist
                         reg = get_registry()
                         reg.counter("ckpt.restore_fallbacks").inc()
+                        flight_record(
+                            "ckpt.fallback", cid=str(cand),
+                            to=candidates[i + 1],
+                            corrupt=isinstance(e, CheckpointCorruptError),
+                        )
                         if isinstance(e, CheckpointCorruptError):
                             reg.counter("integrity.ckpt_fallbacks").inc()
                         logger.warning_rank0(
@@ -607,6 +618,7 @@ class Checkpointer:
         reg = get_registry()
         reg.counter("ckpt.restores").inc()
         reg.counter("ckpt.restored_bytes").inc(_tree_bytes(restored))
+        flight_record("ckpt.restore", cid=str(step))
         extra = None
         extra_path = os.path.join(step_dir, "extra_state.json")
         if os.path.exists(extra_path):
@@ -641,8 +653,10 @@ class Checkpointer:
         self._join_manifest()
         # same contract as wait(): a final async save committed by this
         # close must not leave the newest — most likely to be restored —
-        # generation without its manifest
+        # generation without its manifest (or without its ckpt.commit flight
+        # event — a post-mortem must not show it saved-but-never-committed)
         if self._inflight_step is not None:
+            flight_record("ckpt.commit", cid=str(self._inflight_step))
             self._write_manifest(self._inflight_step)
             self._inflight_step = None
         self._ckptr.close()

@@ -19,15 +19,31 @@ full profiler:
                  percentage, live device-memory gauges, and a recompile
                  detector over the ``TRACE_COUNTS`` machinery.
 * ``exporter`` — optional stdlib-only HTTP daemon serving ``/metrics``
-                 (Prometheus text) and ``/healthz`` (resilience supervisor
-                 state), shared by the trainer and ``serving.InferenceEngine``.
+                 (Prometheus text), ``/healthz`` (resilience supervisor
+                 state), ``/debug/flight`` (flight-recorder tail) and
+                 ``/debug/requests`` (in-flight request timelines), shared
+                 by the trainer and ``serving.InferenceEngine``.
+* ``flight_recorder`` — always-on bounded ring of structured events from
+                 every hot subsystem, dumped to ``postmortem-<rank>.json``
+                 on watchdog fire / supervisor abort / uncaught exception /
+                 SIGTERM (``scripts/postmortem.py`` merges ranks).
+* ``request_trace`` — per-request lifecycle timelines through the serving
+                 engine (queue-wait / TPOT histograms, per-slot chrome
+                 trace).
 
 ``callback.ObservabilityCallback`` (imported lazily by the trainer — it
-depends on ``trainer.callbacks``) ties the four together in the train loop.
+depends on ``trainer.callbacks``) ties them together in the train loop.
 See ``docs/observability.md``.
 """
 
 from veomni_tpu.observability.exporter import MetricsExporter, render_prometheus
+from veomni_tpu.observability.flight_recorder import (
+    FlightRecorder,
+    configure_flight_recorder,
+    dump_postmortem,
+    get_flight_recorder,
+    record,
+)
 from veomni_tpu.observability.goodput import (
     GoodputTracker,
     RecompileDetector,
@@ -41,6 +57,7 @@ from veomni_tpu.observability.metrics import (
     get_registry,
     set_registry,
 )
+from veomni_tpu.observability.request_trace import RequestTimeline, RequestTracer
 from veomni_tpu.observability.spans import (
     disable_spans,
     dump_chrome_trace,
@@ -51,16 +68,23 @@ from veomni_tpu.observability.spans import (
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "GoodputTracker",
     "Histogram",
     "MetricsExporter",
     "MetricsRegistry",
     "RecompileDetector",
+    "RequestTimeline",
+    "RequestTracer",
+    "configure_flight_recorder",
     "disable_spans",
     "dump_chrome_trace",
+    "dump_postmortem",
     "enable_spans",
+    "get_flight_recorder",
     "get_registry",
+    "record",
     "render_prometheus",
     "set_registry",
     "span",

@@ -49,6 +49,8 @@ class ObservabilityCallback(Callback):
         self.registry = get_registry()
         if t.observability_spans:
             enable_spans()
+        # (the flight recorder's ring size + dump dir are wired in train()'s
+        # prologue, BEFORE any callback can raise — not here)
         if t.observability_jsonl:
             path = os.path.join(
                 t.output_dir, f"metrics_rank{self.registry.rank()}.jsonl"

@@ -1,11 +1,14 @@
-"""Stdlib-only Prometheus exporter + health endpoint.
+"""Stdlib-only Prometheus exporter + health and debug endpoints.
 
 One daemon thread, zero dependencies: ``/metrics`` renders the registry in
 Prometheus text exposition format 0.0.4; ``/healthz`` serves a JSON health
 document (the trainer wires it to the resilience supervisor's state — a
-scraper or k8s probe sees rollbacks/aborts without log scraping). Usable by
-both the trainer (``train.observability_port`` / ``VEOMNI_METRICS_PORT``)
-and ``serving.InferenceEngine`` (``scripts/serve.py``).
+scraper or k8s probe sees rollbacks/aborts without log scraping);
+``/debug/flight`` returns the flight recorder's recent events (``?n=``
+bounds the tail) and ``/debug/requests`` the serving engine's in-flight
+request timelines (``requests_fn``). Usable by both the trainer
+(``train.observability_port`` / ``VEOMNI_METRICS_PORT``) and
+``serving.InferenceEngine`` (``scripts/serve.py``).
 """
 
 from __future__ import annotations
@@ -76,11 +79,15 @@ class MetricsExporter:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
                  registry: Optional[MetricsRegistry] = None,
-                 health_fn: Optional[Callable[[], Dict]] = None):
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 requests_fn: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.registry = registry  # None -> resolve the global lazily
         self.health_fn = health_fn
+        # serving wires RequestTracer.snapshot here; the trainer leaves it
+        # None and /debug/requests reports an empty document
+        self.requests_fn = requests_fn
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -103,16 +110,40 @@ class MetricsExporter:
 
             def do_GET(self):
                 try:
-                    if self.path.split("?")[0] == "/metrics":
+                    route, _, query = self.path.partition("?")
+                    if route == "/metrics":
                         body = render_prometheus(exporter.registry).encode()
                         self._send(200, body,
                                    "text/plain; version=0.0.4; charset=utf-8")
-                    elif self.path.split("?")[0] == "/healthz":
+                    elif route == "/healthz":
                         doc = {"healthy": True}
                         if exporter.health_fn is not None:
                             doc = dict(exporter.health_fn())
                         code = 200 if doc.get("healthy", True) else 503
                         self._send(code, json.dumps(doc).encode(),
+                                   "application/json")
+                    elif route == "/debug/flight":
+                        from veomni_tpu.observability.flight_recorder import (
+                            get_flight_recorder,
+                        )
+
+                        limit = 200
+                        for part in query.split("&"):
+                            if part.startswith("n="):
+                                try:  # a typo'd ?n= must not read as a 500
+                                    # 0 = the whole ring, same convention as
+                                    # FlightRecorder.events(limit=0)
+                                    limit = max(0, int(part[2:]))
+                                except ValueError:
+                                    pass
+                        doc = get_flight_recorder().snapshot(limit)
+                        self._send(200, json.dumps(doc, default=str).encode(),
+                                   "application/json")
+                    elif route == "/debug/requests":
+                        doc = {"inflight": [], "finished": []}
+                        if exporter.requests_fn is not None:
+                            doc = dict(exporter.requests_fn())
+                        self._send(200, json.dumps(doc, default=str).encode(),
                                    "application/json")
                     else:
                         self._send(404, b"not found", "text/plain")
@@ -159,11 +190,14 @@ def resolve_port(config_port: int = 0) -> Optional[int]:
 
 def maybe_start_from_env(registry: Optional[MetricsRegistry] = None,
                          health_fn: Optional[Callable[[], Dict]] = None,
-                         config_port: int = 0) -> Optional[MetricsExporter]:
+                         config_port: int = 0,
+                         requests_fn: Optional[Callable[[], Dict]] = None,
+                         ) -> Optional[MetricsExporter]:
     """Start an exporter iff configured; returns it (caller owns stop())."""
     port = resolve_port(config_port)
     if port is None:
         return None
-    exp = MetricsExporter(port=port, registry=registry, health_fn=health_fn)
+    exp = MetricsExporter(port=port, registry=registry, health_fn=health_fn,
+                          requests_fn=requests_fn)
     exp.start()
     return exp

@@ -30,12 +30,20 @@ from collections import deque
 from typing import Optional
 
 from veomni_tpu.observability.metrics import get_registry
-from veomni_tpu.utils.logging import _process_index
+from veomni_tpu.utils.logging import _process_index, get_logger
+
+logger = get_logger(__name__)
 
 _enabled = False
 _profiler_active = False
 _epoch_ns: Optional[int] = None
 _events: deque = deque(maxlen=100_000)  # (name, t0_ns, dur_ns, tid)
+_dropped = 0  # ring evictions: a chrome trace missing its head is truncated,
+_warned_dropped = False  # not short — say so once (rank 0) + count forever
+# serializes the full-ring check + append + drop accounting: spans exit on
+# several threads (prefetch worker, commit thread), and an unlocked
+# check-then-act would undercount evictions right at the full boundary
+_ring_lock = threading.Lock()
 _tid_lock = threading.Lock()
 _tids: dict = {}  # thread ident -> small stable int
 
@@ -86,13 +94,68 @@ class _Span:
             self._annot.__exit__(*exc)
             self._annot = None
         get_registry().histogram(f"span.{self.name}").observe(dur_ns * 1e-9)
-        _events.append((self.name, self._t0, dur_ns, _tid()))
+        ev = (self.name, self._t0, dur_ns, _tid())
+        global _dropped, _warned_dropped
+        evicted = warn = False
+        with _ring_lock:
+            if len(_events) == _events.maxlen:
+                # once full (steady state on a long run) EVERY exit evicts:
+                # only the bookkeeping ints live under the lock — registry
+                # lookup and logging happen after release so concurrent
+                # span exits don't serialize behind I/O
+                _dropped += 1
+                evicted = True
+                if not _warned_dropped:
+                    _warned_dropped = True
+                    warn = True
+            _events.append(ev)
+        if evicted:
+            _note_dropped(1, warn)
         return False
+
+
+def _note_dropped(n: int, warn: bool) -> None:
+    """``n`` events were just evicted (full-ring append, or a shrink via
+    ``enable_spans``); the caller already bumped ``_dropped`` and claimed the
+    one-time warning under ``_ring_lock``. This mirrors the loss into the
+    ``span.dropped`` counter and warns ONCE (rank 0) — without this a
+    truncated chrome trace reads as a short run, not a long one missing its
+    head. Deliberately called OUTSIDE the ring lock."""
+    get_registry().counter("span.dropped").inc(n)
+    if warn:
+        logger.warning_rank0(
+            "span ring buffer full (%d events): oldest spans are being "
+            "dropped — a chrome-trace dump will be missing its HEAD, not its "
+            "tail. Raise enable_spans(max_events=...) or dump earlier; "
+            "`span.dropped` counts the loss from here on.",
+            _events.maxlen,
+        )
 
 
 def span(name: str):
     """Time a host phase. Returns the shared no-op when tracing is off."""
     return _Span(name) if _enabled else _NULL
+
+
+def dropped_events() -> int:
+    """Span-ring evictions so far (mirrors the ``span.dropped`` counter)."""
+    return _dropped
+
+
+def chrome_epoch_ns() -> Optional[int]:
+    """The ts=0 anchor of span chrome traces (None until first enable).
+    Other chrome exporters (request_trace) offset against this so their
+    tracks line up with the span tracks in one viewer."""
+    return _epoch_ns
+
+
+def live_span_events(limit: int = 0):
+    """Most recent ``limit`` raw span tuples ``(name, t0_ns, dur_ns, tid)``
+    (0 = all). The flight recorder embeds this tail in post-mortems so host
+    phases and recorder events share one timebase."""
+    with _ring_lock:  # a concurrent span exit mutates the deque mid-list()
+        evs = list(_events)
+    return evs[-limit:] if limit > 0 else evs
 
 
 def spans_enabled() -> bool:
@@ -107,7 +170,21 @@ def enable_spans(max_events: int = 100_000) -> None:
     if _epoch_ns is None:
         _epoch_ns = time.perf_counter_ns()
     if _events.maxlen != max_events:
-        _events = deque(_events, maxlen=max_events)
+        global _dropped, _warned_dropped
+        warn = False
+        with _ring_lock:
+            before = len(_events)
+            _events = deque(_events, maxlen=max_events)
+            # shrinking evicts the oldest entries: count them, same
+            # invariant as a full-ring append
+            evicted = before - len(_events)
+            if evicted:
+                _dropped += evicted
+                if not _warned_dropped:
+                    _warned_dropped = True
+                    warn = True
+        if evicted:
+            _note_dropped(evicted, warn)
     _enabled = True
 
 
@@ -124,7 +201,11 @@ def set_profiler_active(active: bool) -> None:
 
 
 def clear_events() -> None:
-    _events.clear()
+    global _dropped, _warned_dropped
+    with _ring_lock:
+        _events.clear()
+        _dropped = 0
+        _warned_dropped = False
 
 
 def dump_chrome_trace(path: str) -> int:
@@ -133,12 +214,18 @@ def dump_chrome_trace(path: str) -> int:
     naturally). Returns the number of span events written."""
     epoch = _epoch_ns if _epoch_ns is not None else time.perf_counter_ns()
     rank = _process_index()
-    events = list(_events)
+    with _ring_lock:  # a concurrent span exit mutates the deque mid-list()
+        events = list(_events)
     trace = [{
         "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
-        "args": {"name": f"veomni host spans (rank {rank})"},
+        # dropped rides along so a viewer of a truncated trace can see HOW
+        # truncated (satellite of the one-time warning above)
+        "args": {"name": f"veomni host spans (rank {rank})",
+                 "dropped_events": _dropped},
     }]
-    for ident, t in sorted(_tids.items(), key=lambda kv: kv[1]):
+    with _tid_lock:  # a thread registering its first span mutates the dict
+        tids = sorted(_tids.items(), key=lambda kv: kv[1])
+    for ident, t in tids:
         trace.append({
             "name": "thread_name", "ph": "M", "pid": rank, "tid": t,
             "args": {"name": f"thread-{ident}"},

@@ -317,6 +317,11 @@ def fault_point(name: str,
         logger.warning_rank0(
             "fault injected: point=%s mode=%s hit=%d", name, spec.mode, hit
         )
+        # chaos drills must be legible in a post-mortem: an injected fault
+        # that later kills the run should never read as organic rot
+        from veomni_tpu.observability.flight_recorder import record
+
+        record("fault.injected", cid=name, mode=spec.mode, hit=hit)
         if spec.mode == "exception":
             raise InjectedFault(
                 spec.message or f"injected fault at {name} (hit {hit})"

@@ -63,13 +63,17 @@ def retry_call(
         try:
             return fn(*args, **kwargs)
         except policy.retry_on as e:
-            # failure path only: the registry import (observability-layer;
-            # retry is leaf) and the qualname fallback stay off the success
-            # path — this wraps the innermost record-fetch loop
+            # failure path only: the registry/recorder imports
+            # (observability-layer; retry is leaf) and the qualname fallback
+            # stay off the success path — this wraps the innermost
+            # record-fetch loop
+            from veomni_tpu.observability.flight_recorder import record
             from veomni_tpu.observability.metrics import get_registry
 
             if what is None:
                 what = description or getattr(fn, "__qualname__", repr(fn))
+            record("retry.attempt", cid=what, attempt=attempt + 1,
+                   error=f"{type(e).__name__}: {e}"[:200])
             if attempt >= policy.retries:
                 get_registry().counter("retry.exhausted").inc()
                 logger.error(

@@ -37,6 +37,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from veomni_tpu.observability.flight_recorder import record as flight_record
 from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.resilience.faults import fault_point
 from veomni_tpu.utils.logging import get_logger
@@ -142,6 +143,9 @@ class TrainSupervisor:
             return "ok"
         self.anomalies += 1
         get_registry().counter("resilience.anomalies").inc()
+        flight_record("supervisor.anomaly", cid=str(step),
+                      injected=injected, consecutive=self.consecutive + 1,
+                      total=self.anomalies)
         self.consecutive += 1
         if self.consecutive == 1:
             self.consec_start = step
@@ -167,12 +171,16 @@ class TrainSupervisor:
         self.last_verdict = worse_verdict(self.last_verdict, v)
         if v == "skip":
             get_registry().counter("resilience.skips").inc()
+        flight_record("supervisor.verdict", cid=v,
+                      anomalies=self.anomalies, consecutive=self.consecutive)
         return v
 
     # ------------------------------------------------------------ lifecycle
     def note_rollback(self, to_step: int) -> None:
         self.rollbacks += 1
         get_registry().counter("resilience.rollbacks").inc()
+        flight_record("supervisor.rollback", cid=str(to_step),
+                      rollback=self.rollbacks)
         self.consecutive = 0
         self.consec_start = None
         self._inflight.clear()  # futures from the abandoned trajectory
@@ -186,6 +194,7 @@ class TrainSupervisor:
     def note_stall(self, stack_dump: str) -> None:
         self.stalls += 1
         get_registry().counter("resilience.stalls").inc()
+        flight_record("supervisor.stall", cid=str(self.stalls))
 
     def stats(self) -> Dict[str, Any]:
         return {

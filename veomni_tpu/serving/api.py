@@ -54,3 +54,10 @@ class RequestOutput:
     finished: bool = False
     finish_reason: str = ""
     ttft_s: Optional[float] = None  # wall time submit -> first token
+    # per-request lifecycle rollup (observability/request_trace.py): total
+    # time spent waiting for a decode slot (initial + every post-preemption
+    # re-admission wait), decode time-per-output-token, and how often the
+    # scheduler preempted this request — the "why was request X slow" triple
+    queue_wait_s: Optional[float] = None
+    tpot_s: Optional[float] = None
+    preemptions: int = 0

@@ -36,6 +36,7 @@ from veomni_tpu.models import decode as decode_mod
 from veomni_tpu.models.config import TransformerConfig
 from veomni_tpu.models.decode import supports_cached_decode
 from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.observability.request_trace import RequestTracer
 from veomni_tpu.observability.spans import span
 from veomni_tpu.serving.api import (
     Request,
@@ -94,7 +95,13 @@ class InferenceEngine:
         self.k_pool = jnp.zeros(shape, cfg.dtype)
         self.v_pool = jnp.zeros(shape, cfg.dtype)
         self.blocks = KVBlockManager(ec.num_blocks, ec.block_size)
-        self.scheduler = Scheduler(ec.num_slots, self.blocks)
+        # per-request lifecycle tracing (request_trace.py): the scheduler
+        # reports queued/admitted/preempted, the engine reports prefill/
+        # first-token/finished — together they feed serve.queue_wait_s and
+        # serve.tpot_s and the /debug/requests timelines
+        self.tracer = RequestTracer(ec.num_slots)
+        self.scheduler = Scheduler(ec.num_slots, self.blocks,
+                                   tracer=self.tracer)
 
         # prefill is the SAME jitted program greedy_generate uses (shared
         # prompt buckets, shared TRACE_COUNTS["prefill"])
@@ -288,6 +295,7 @@ class InferenceEngine:
             jnp.full((1,), sp.top_k, jnp.int32),
             jnp.full((1,), sp.top_p, jnp.float32),
         )[0])
+        self.tracer.on_prefill_done(seq.seq_id)
         if seq.first_token_time is None:
             seq.first_token_time = time.perf_counter()
             ttft = seq.first_token_time - seq.submit_time
@@ -295,6 +303,7 @@ class InferenceEngine:
             self._ttft_sum += ttft
             self._ttft_n += 1
             self._m_ttft.observe(ttft)
+            self.tracer.on_first_token(seq.seq_id)
         seq.prefill_len = pt
         seq.pos = pt  # the pending token's write position
         return [self._emit(seq, first)]
@@ -363,6 +372,15 @@ class InferenceEngine:
             self.scheduler.finish(seq)
             out.finished = True
             out.finish_reason = reason
+            tl = self.tracer.on_finished(seq.seq_id, reason,
+                                         len(seq.generated))
+            if tl is not None:
+                # surface the lifecycle rollup on the output the caller
+                # already holds (bench/SLO tooling reads these, not the
+                # tracer)
+                out.queue_wait_s = tl.queue_wait_s
+                out.tpot_s = tl.tpot_s
+                out.preemptions = tl.preemptions
         return StreamEvent(
             request_id=seq.seq_id, token=token,
             index=len(seq.generated) - 1, finished=finished,

@@ -15,7 +15,11 @@ Policy (vLLM-style, recompute preemption):
   already-emitted tokens are never re-emitted.
 
 The scheduler is pure host bookkeeping — it owns no device state and is
-unit-testable without building a model.
+unit-testable without building a model. When a
+:class:`~veomni_tpu.observability.request_trace.RequestTracer` is attached
+(the engine does), the scheduler reports its transitions — queued, admitted
+(with slot), preempted — so every request carries a lifecycle timeline; the
+engine reports the rest (prefill-done, first token, finished).
 """
 
 from __future__ import annotations
@@ -60,7 +64,8 @@ class SequenceState:
 
 
 class Scheduler:
-    def __init__(self, num_slots: int, block_manager: KVBlockManager):
+    def __init__(self, num_slots: int, block_manager: KVBlockManager,
+                 tracer: Optional[Any] = None):
         if num_slots < 1:
             raise ValueError("need at least one decode slot")
         self.blocks = block_manager
@@ -68,6 +73,9 @@ class Scheduler:
         self.slots: List[Optional[SequenceState]] = [None] * num_slots
         self.preemption_count = 0
         self._admit_counter = 0
+        # optional RequestTracer (duck-typed: anything with on_queued /
+        # on_admitted / on_preempted) — None keeps the scheduler trace-free
+        self.tracer = tracer
 
     # ---------------------------------------------------------------- queries
     @property
@@ -89,6 +97,8 @@ class Scheduler:
     # ------------------------------------------------------------ transitions
     def add(self, seq: SequenceState) -> None:
         self.waiting.append(seq)
+        if self.tracer is not None:
+            self.tracer.on_queued(seq.seq_id)
 
     def admit(self) -> List[SequenceState]:
         """Fill free slots from the waiting queue (FIFO, head-of-line).
@@ -114,6 +124,8 @@ class Scheduler:
             self._admit_counter += 1
             self.slots[slot] = head
             admitted.append(head)
+            if self.tracer is not None:
+                self.tracer.on_admitted(head.seq_id, slot)
         return admitted
 
     def ensure_decode_capacity(self) -> List[SequenceState]:
@@ -145,6 +157,8 @@ class Scheduler:
         seq.preemptions += 1
         self.preemption_count += 1
         self.waiting.appendleft(seq)
+        if self.tracer is not None:
+            self.tracer.on_preempted(seq.seq_id)
 
     def finish(self, seq: SequenceState) -> None:
         self.blocks.free_seq(seq.seq_id)

@@ -25,6 +25,11 @@ from veomni_tpu.data.data_loader import build_dataloader
 from veomni_tpu.data.data_transform import build_data_transform
 from veomni_tpu.data.dataset import build_dataset
 from veomni_tpu.models import build_foundation_model, build_tokenizer
+from veomni_tpu.observability.flight_recorder import (
+    configure_flight_recorder,
+    dump_postmortem,
+    record as flight_record,
+)
 from veomni_tpu.observability.spans import span
 from veomni_tpu.optim import build_lr_scheduler, build_optimizer
 from veomni_tpu.parallel import init_parallel_state, use_parallel_state
@@ -620,6 +625,21 @@ class BaseTrainer:
         if pf is not None:
             pf.close()
 
+    def _close_callbacks(self):
+        """Exception-safe teardown for resource-holding callbacks (live
+        exporter thread, active jax.profiler trace, jsonl handles) — runs on
+        BOTH the loop's exit paths and a startup failure in
+        ``on_train_begin`` (where earlier callbacks may already hold
+        resources the later, raising one never will release)."""
+        for cb in self.callbacks:
+            try:
+                cb.close()
+            except Exception as e:
+                logger.warning_rank0(
+                    "callback %s close() failed: %s",
+                    type(cb).__name__, e,
+                )
+
     def _rollback(self, ctl, sup):
         """Supervisor escalation: restore the latest committed checkpoint
         (params + optimizer + rank-local data cursor) and replay the
@@ -678,16 +698,44 @@ class BaseTrainer:
         from veomni_tpu.utils.helper import Watchdog
 
         arm_from_env()  # VEOMNI_FAULT_PLAN (tests/chaos drills); no-op else
+        # dump-dir wiring BEFORE any callback can raise: a startup failure
+        # (EnvironMeterCallback precedes ObservabilityCallback in the hook
+        # order) must still land its post-mortem in output_dir, not the
+        # launcher's CWD
+        configure_flight_recorder(
+            max_events=t.observability_flight_events, dump_dir=t.output_dir,
+            fresh=True,  # this run's history starts here, not a prior run's
+        )
         ctl = TrainerControlState(train_steps=self.train_steps)
         sup = TrainSupervisor(SupervisorPolicy.from_train_args(t))
         # the observability callback wires /healthz to the supervisor state
         self._supervisor = sup
         with use_parallel_state(self.parallel_state):
-            self._fire("on_train_begin", ctl)
-            # prefetcher construction AFTER on_train_begin: auto-resume
-            # restores the dataloader cursor there, and the thread starts
-            # pulling at construction
-            data_iter = self._start_data_iter()
+            try:
+                self._fire("on_train_begin", ctl)
+                flight_record("train.begin", cid=str(ctl.global_step),
+                              train_steps=self.train_steps)
+                # prefetcher construction AFTER on_train_begin: auto-resume
+                # restores the dataloader cursor there, and the thread starts
+                # pulling at construction
+                data_iter = self._start_data_iter()
+            except BaseException as e:
+                # startup failures (auto-resume hitting all-generations-
+                # corrupt, a dead data path) must produce a post-mortem too
+                # — the quarantine/fallback event history is exactly what a
+                # CheckpointCorruptError artifact needs. The dump dir was
+                # wired in the prologue above, before any callback ran.
+                dump_postmortem(
+                    f"exception:{type(e).__name__}",
+                    extra={"error": str(e)[:2000],
+                           "global_step": ctl.global_step},
+                )
+                # the loop's finally below is never reached from here, but
+                # callbacks that ran before the raising one may already hold
+                # resources (exporter thread, profiler trace)
+                self._close_prefetcher()
+                self._close_callbacks()
+                raise
             # SIGTERM = cluster preemption notice: finish the current step,
             # take one final synchronous checkpoint, return (exit 0) so the
             # restarted job resumes bit-exactly
@@ -724,6 +772,12 @@ class BaseTrainer:
                             # the globally-sharded array (single-controller)
                             with span("data.ship"):
                                 batch = self._ship_batch(batch_np)
+                            # flight-recorder step lifecycle: dispatch is
+                            # recorded BEFORE the jitted call and end AFTER
+                            # the callbacks, so a post-mortem of a hang shows
+                            # the wedged step as dispatched-but-never-ended
+                            flight_record("step.dispatch",
+                                          cid=str(ctl.global_step + 1))
                             with span("step.dispatch"):
                                 self.train_state, metrics = self.train_step(
                                     self.train_state, batch
@@ -773,6 +827,8 @@ class BaseTrainer:
                                 ctl.resilience = sup.stats()
                             with span("host.callbacks"):
                                 self._fire("on_step_end", ctl)
+                            flight_record("step.end", cid=str(ctl.global_step),
+                                          synced=ctl.synced)
                             if verdict == "rollback":
                                 data_iter = self._rollback(ctl, sup)
                             elif verdict == "abort":
@@ -788,6 +844,16 @@ class BaseTrainer:
                                 "preemption stop at step %d: taking the final "
                                 "checkpoint, then exiting cleanly",
                                 ctl.global_step,
+                            )
+                            # the pod is about to disappear: the post-mortem
+                            # is the only record of the final seconds (the
+                            # graceful checkpoint covers STATE, not events)
+                            flight_record("shutdown.request",
+                                          cid=str(ctl.global_step),
+                                          signum=shutdown.signum)
+                            dump_postmortem(
+                                "sigterm",
+                                extra={"global_step": ctl.global_step},
                             )
                             break
                         if ctl.should_stop:
@@ -815,18 +881,23 @@ class BaseTrainer:
                     # handler mid-save. A repeated TERM just re-sets the flag.
                     ctl.resilience = sup.stats()
                     self._fire("on_train_end", ctl)
+                    flight_record("train.end", cid=str(ctl.global_step))
+            except BaseException as e:
+                # uncaught exception escaping train() (supervisor abort,
+                # RollbackImpossible, a data-path blowup, KeyboardInterrupt):
+                # the stack trace says where it died, the post-mortem says
+                # what the run was doing on the way there
+                dump_postmortem(
+                    f"exception:{type(e).__name__}",
+                    extra={"error": str(e)[:2000],
+                           "global_step": ctl.global_step},
+                )
+                raise
             finally:
                 self._close_prefetcher()
                 # exception path skips on_train_end (an abort must not run
                 # the final-checkpoint hooks) but resource-holding callbacks
                 # still need teardown: an active jax.profiler trace or a
                 # live exporter thread must not leak past a crashed run
-                for cb in self.callbacks:
-                    try:
-                        cb.close()
-                    except Exception as e:
-                        logger.warning_rank0(
-                            "callback %s close() failed: %s",
-                            type(cb).__name__, e,
-                        )
+                self._close_callbacks()
         return ctl

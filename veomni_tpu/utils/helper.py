@@ -106,14 +106,22 @@ class Watchdog:
     Arms at :meth:`start`; :meth:`pet` resets the deadline (call once per unit
     of expected progress — a train step, a bench phase). If ``timeout_s``
     elapses with no pet, the dog dumps every thread's stack via
-    :func:`dump_thread_stacks`, invokes ``on_stall(stack_dump)`` once per
-    stall, and — unless ``exit_code`` is None — hard-exits the process
+    :func:`dump_thread_stacks`, writes a flight-recorder post-mortem
+    (``postmortem-<rank>.json`` — the stack dump alone loses the event
+    history; the path lands in :attr:`last_postmortem_path`), invokes
+    ``on_stall(stack_dump)`` once per stall, and — unless ``exit_code`` is
+    None — hard-exits the process
     (``os._exit``; a wedged backend can't be timeout-killed politely, see
     BENCH_NOTES.md). With ``exit_code=None`` the run is left alive: the stall
     may be a bounded hiccup (slow shared fs) the retry layer absorbs, and the
     dump is the observability artifact either way. Re-arms after firing, so a
     long stall produces periodic dumps rather than one.
     """
+
+    # the post-mortem write gets its own deadline: when the stall IS a hung
+    # filesystem, blocking on the dump would wedge the watchdog thread
+    # before on_stall/exit_code ever run
+    DUMP_DEADLINE_S = 15.0
 
     def __init__(self, timeout_s: float, *, on_stall=None, exit_code=None,
                  description: str = ""):
@@ -125,6 +133,7 @@ class Watchdog:
         self.description = description
         self.stall_count = 0
         self.last_dump: str = ""
+        self.last_postmortem_path: str = ""
         self._pet_event = threading.Event()
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -157,6 +166,7 @@ class Watchdog:
 
     def _watch(self) -> None:
         import os as _os
+        import threading
 
         while not self._done.is_set():
             self._pet_event.clear()
@@ -172,6 +182,50 @@ class Watchdog:
                 f" ({self.description})" if self.description else "",
                 self.last_dump,
             )
+            # the stack dump says WHERE each thread is; the flight recorder
+            # says WHAT the run was doing in the seconds before. Dump BEFORE
+            # on_stall so the callback (bench's stall JSON) can reference
+            # the artifact path — which means THIS stall must be put on the
+            # ring here, not by on_stall, or the artifact it triggers is the
+            # one dump with no record of it. Never fatal — dump() is
+            # exception-proof — and never unbounded: if the stall IS a hung
+            # shared fs, the dump's own writes into it would otherwise wedge
+            # THIS thread before on_stall/exit_code run, hanging the driver
+            # the watchdog exists to unhang. So the file I/O happens in a
+            # side thread joined with a deadline.
+            try:
+                from veomni_tpu.observability.flight_recorder import (
+                    dump_postmortem,
+                    record,
+                )
+
+                record("watchdog.stall", cid=str(self.stall_count),
+                       timeout_s=self.timeout_s,
+                       where=self.description or "")
+                path_box: list = []
+                dumper = threading.Thread(
+                    target=lambda: path_box.append(dump_postmortem(
+                        f"watchdog:{self.description or 'stall'}",
+                        extra={"stall_count": self.stall_count,
+                               "timeout_s": self.timeout_s},
+                    )),
+                    name="veomni-watchdog-dump", daemon=True,
+                )
+                dumper.start()
+                dumper.join(timeout=self.DUMP_DEADLINE_S)
+                self.last_postmortem_path = (
+                    (path_box[0] or "") if path_box else ""
+                )
+                if dumper.is_alive():
+                    logger.error(
+                        "watchdog: post-mortem dump still blocked after "
+                        "%.3gs (hung filesystem?) — continuing without it",
+                        self.DUMP_DEADLINE_S,
+                    )
+            except Exception as e:
+                # e.g. Thread.start() under thread exhaustion — exactly a
+                # pathological stall state; say the dump was attempted
+                logger.error("watchdog: post-mortem dump not started: %s", e)
             if self.on_stall is not None:
                 try:
                     self.on_stall(self.last_dump)
