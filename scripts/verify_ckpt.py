@@ -3,14 +3,23 @@
 Walks a checkpoint directory (the trainer's ``<output_dir>/checkpoints``),
 prints a per-generation VerifyReport — OK / CORRUPT (with the per-file
 missing/truncated/mismatch classification) / UNVERIFIABLE (no manifest) /
-UNCOMMITTED — plus any already-quarantined ``*.corrupt`` corpses, and exits
-non-zero if anything is corrupt. Pure stdlib + ``resilience/integrity.py``:
-no JAX backend is touched, so it is safe to run next to a live job.
+UNCOMMITTED — plus each generation's saved topology (mesh axis sizes, world
+size, jax versions — recorded even under ``ckpt_verify=off``) and any
+already-quarantined ``*.corrupt`` corpses, and exits non-zero if anything is
+corrupt. With ``--target-world-size`` every generation additionally gets an
+``ELASTIC-OK`` / ``INCOMPATIBLE`` / ``UNKNOWN`` verdict: could a run on that
+many processes restore it (same topology, or a data-parallel resize whose
+per-rank cursor sidecars are complete and mergeable)? Exit codes: 1 =
+corruption found, 3 = intact but elastically incompatible with the target
+world size (so a scripted pre-resize gate can fail on either). Pure stdlib +
+``resilience/integrity.py`` + ``resilience/elastic.py``: no JAX backend is
+touched, so it is safe to run next to a live job.
 
 Run:
   python scripts/verify_ckpt.py /path/to/output_dir/checkpoints
   python scripts/verify_ckpt.py --mode size /path/to/checkpoints
   python scripts/verify_ckpt.py --step 1200 /path/to/checkpoints
+  python scripts/verify_ckpt.py --target-world-size 8 /path/to/checkpoints
 """
 
 import argparse
@@ -19,12 +28,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from veomni_tpu.resilience.elastic import classify_restore  # noqa: E402
 from veomni_tpu.resilience.integrity import (  # noqa: E402
     MANIFEST_NAME,
     QUARANTINE_DIR_RE,
     STEP_DIR_RE,
     VERIFY_MODES,
     is_committed_dir,
+    list_rank_sidecars,
+    read_topology,
     verify_manifest,
 )
 
@@ -32,10 +44,37 @@ _STEP_RE = STEP_DIR_RE
 _CORRUPT_RE = QUARANTINE_DIR_RE
 
 
-def verify_tree(ckpt_dir: str, mode: str, step: int = -1):
-    """Returns (rows: [(step, status, detail)], corpses: [dirname],
-    n_corrupt). Newest generation first — that is the one ``latest_step()``
-    would hand a resuming run."""
+def _topology_line(topo) -> str:
+    if not topo:
+        return "topology: unrecorded (pre-elastic checkpoint)"
+    mesh = topo.get("mesh") or {}
+    mesh_s = (
+        "x".join(f"{k}={v}" for k, v in mesh.items()) if mesh else "unknown"
+    )
+    return (
+        f"topology: world_size={topo.get('world_size', '?')} "
+        f"devices={topo.get('device_count', '?')} mesh[{mesh_s}] "
+        f"jax={topo.get('jax', '?')}/{topo.get('jaxlib', '?')}"
+    )
+
+
+def _elastic_verdict(step_dir: str, topo, target_world: int) -> str:
+    rank_files = list_rank_sidecars(step_dir)
+    verdict, reason = classify_restore(
+        topo, target_world, rank_files=rank_files or None
+    )
+    label = {
+        "ok": "ELASTIC-OK", "elastic": "ELASTIC-OK",
+        "incompatible": "INCOMPATIBLE", "unknown": "UNKNOWN",
+    }[verdict]
+    return f"{label} for world_size={target_world}: {reason}"
+
+
+def verify_tree(ckpt_dir: str, mode: str, step: int = -1,
+                target_world: int = 0):
+    """Returns (rows: [(step, status, [detail lines])], corpses: [dirname],
+    n_corrupt, n_incompatible). Newest generation first — that is the one
+    ``latest_step()`` would hand a resuming run."""
     steps, corpses = [], []
     for d in sorted(os.listdir(ckpt_dir)):
         m = _STEP_RE.match(d)
@@ -47,23 +86,37 @@ def verify_tree(ckpt_dir: str, mode: str, step: int = -1):
         steps = [s for s in steps if s == step]
     rows = []
     n_corrupt = 0
+    n_incompatible = 0
     for s in sorted(steps, reverse=True):
         step_dir = os.path.join(ckpt_dir, f"global_step_{s}")
         if not is_committed_dir(step_dir):
-            rows.append((s, "UNCOMMITTED", "no train_state payload (crashed "
-                         "save debris; startup cleanup removes this)"))
+            rows.append((s, "UNCOMMITTED", ["no train_state payload (crashed "
+                         "save debris; startup cleanup removes this)"]))
             continue
+        topo = read_topology(step_dir)
+        detail = []
         report = verify_manifest(step_dir, mode=mode)
         if report is None:
-            rows.append((s, "UNVERIFIABLE", f"no readable {MANIFEST_NAME} "
-                         "(pre-integrity checkpoint, or crash before the "
-                         "manifest write)"))
+            rows.append((s, "UNVERIFIABLE", detail))
+            detail.append(
+                f"no readable {MANIFEST_NAME} with digests (pre-integrity "
+                "checkpoint, ckpt_verify=off at save time, or crash before "
+                "the manifest write)"
+            )
         elif report.passed:
-            rows.append((s, "OK", report.summary()))
+            rows.append((s, "OK", detail))
+            detail.append(report.summary())
         else:
             n_corrupt += 1
-            rows.append((s, "CORRUPT", report.summary()))
-    return rows, corpses, n_corrupt
+            rows.append((s, "CORRUPT", detail))
+            detail.append(report.summary())
+        detail.append(_topology_line(topo))
+        if target_world > 0:
+            verdict_line = _elastic_verdict(step_dir, topo, target_world)
+            if verdict_line.startswith("INCOMPATIBLE"):
+                n_incompatible += 1
+            detail.append(verdict_line)
+    return rows, corpses, n_corrupt, n_incompatible
 
 
 def main(argv=None) -> int:
@@ -73,22 +126,39 @@ def main(argv=None) -> int:
                     help="size = existence+bytes; full = re-digest every file (default)")
     ap.add_argument("--step", type=int, default=-1,
                     help="verify only this generation (default: all)")
+    ap.add_argument("--target-world-size", type=int, default=0,
+                    help="also print an ELASTIC-OK/INCOMPATIBLE verdict per "
+                         "generation: could a run on this many processes "
+                         "restore it (train.ckpt_elastic)?")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.ckpt_dir):
         print(f"error: {args.ckpt_dir} is not a directory", file=sys.stderr)
         return 2
-    rows, corpses, n_corrupt = verify_tree(args.ckpt_dir, args.mode, args.step)
+    rows, corpses, n_corrupt, n_incompat = verify_tree(
+        args.ckpt_dir, args.mode, args.step, args.target_world_size
+    )
     if not rows and not corpses:
         print(f"{args.ckpt_dir}: no checkpoint generations found")
         return 2
     for s, status, detail in rows:
-        print(f"global_step_{s}: {status}\n    {detail}")
+        print(f"global_step_{s}: {status}")
+        for line in detail:
+            print(f"    {line}")
     for d in sorted(corpses):
         print(f"{d}: QUARANTINED (left on disk for post-mortem; aged out "
               "beyond max_ckpt_to_keep)")
-    print(f"\n{len(rows)} generation(s) checked (mode={args.mode}): "
-          f"{n_corrupt} corrupt, {len(corpses)} previously quarantined")
-    return 1 if n_corrupt else 0
+    tail = f"{n_corrupt} corrupt, {len(corpses)} previously quarantined"
+    if args.target_world_size > 0:
+        tail += (f", {n_incompat} elastically incompatible with "
+                 f"world_size={args.target_world_size}")
+    print(f"\n{len(rows)} generation(s) checked (mode={args.mode}): {tail}")
+    if n_corrupt:
+        return 1
+    # a scripted pre-resize gate must be able to fail on incompatibility
+    # alone (distinct code: 3 = intact but not restorable at that world)
+    if args.target_world_size > 0 and n_incompat:
+        return 3
+    return 0
 
 
 if __name__ == "__main__":

@@ -97,10 +97,17 @@ def test_capacity_overflow_training_stays_finite(tmp_path):
     trainer.checkpointer.close()
 
 
-def test_resume_after_topology_change_warns_and_continues(tmp_path):
-    """A checkpoint whose per-rank extra state doesn't cover this rank
-    (process count changed between save and resume) must warn about the
-    dataloader cursor and still restore the train state + continue."""
+def test_resume_after_torn_sidecar_set_falls_back_and_continues(tmp_path):
+    """A generation whose per-rank extra state doesn't cover this rank
+    (here: rank 0's sidecar renamed to rank 7 — a torn set no world size
+    explains) must NOT silently restore with an empty dataloader cursor
+    (the pre-elastic behavior, which repeats/skips that rank's samples).
+    With a digest manifest the integrity gate already quarantines the
+    missing-file generation; this test removes the manifest (an off-mode /
+    pre-integrity checkpoint) so the TOPOLOGY gate is the layer that
+    refuses: a pinned-step load raises `ElasticRestoreError`, and the
+    restore walk falls back to the previous intact generation."""
+    from veomni_tpu.resilience import ElasticRestoreError
     from veomni_tpu.trainer import TextTrainer
 
     _write_data(tmp_path / "data.jsonl")
@@ -111,16 +118,30 @@ def test_resume_after_topology_change_warns_and_continues(tmp_path):
     trainer.checkpointer.close()
 
     # simulate "saved by a different topology": this rank's extra-state file
-    # is absent, another rank's is present
+    # is absent, another rank's is present — and no digest manifest exists
+    # to catch the missing file first
     step_dir = os.path.join(args.train.output_dir, "checkpoints", "global_step_4")
     os.rename(
         os.path.join(step_dir, "extra_state_rank0.json"),
         os.path.join(step_dir, "extra_state_rank7.json"),
     )
+    os.remove(os.path.join(step_dir, "manifest.json"))
 
     args2 = _args(tmp_path)
     args2.train.train_steps = 6
     trainer2 = TextTrainer(args2)
+    # a pinned-step load of the torn generation surfaces the error directly
+    import jax
+
+    abstract = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        trainer2.abstract_state, trainer2.state_shardings,
+    )
+    # without topology metadata a lone rank-7 sidecar reads as a world-8
+    # save missing ranks 0-6 — either way, unmergeable and refused
+    with pytest.raises(ElasticRestoreError, match="sidecar"):
+        trainer2.checkpointer.load(abstract, step=4)
+
     import logging
 
     records = []
@@ -133,7 +154,9 @@ def test_resume_after_topology_change_warns_and_continues(tmp_path):
     finally:
         target.removeHandler(handler)
     assert restored
-    assert any("topology" in r.getMessage() for r in records)
+    assert any("onto this topology" in r.getMessage() for r in records)
+    # the torn step-4 generation was refused; the walk landed on step 2
+    assert int(extra["global_step"]) == 2
     # training continues from the restored params
     ctl = trainer2.train()
     assert ctl.global_step == 6

@@ -165,6 +165,14 @@ class TrainingArguments:
     # quarantined (global_step_N.corrupt) and restore falls back to the
     # next-newest committed-and-verified one.
     ckpt_verify: str = "size"
+    # elastic restore (resilience/elastic.py): allow resuming a checkpoint
+    # saved on a different data-parallel topology (mesh/world resize) —
+    # global arrays reshard onto the target NamedShardings and the per-rank
+    # data cursors + skip-budget accounting merge/split deterministically.
+    # Model-parallel degree changes (tp/ep/ulysses/cp/pp) stay refused with
+    # an actionable error. Off (default): any topology mismatch errors
+    # instead of silently restoring partial cursor state.
+    ckpt_elastic: bool = False
     # poison-record tolerance for streaming data: how many distinct
     # undecodable/invalid (shard, record) pairs may be skipped before the
     # run fails fast with full provenance. 0 = fail on the first one.

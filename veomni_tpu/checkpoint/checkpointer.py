@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
@@ -25,6 +25,14 @@ import orbax.checkpoint as ocp
 from veomni_tpu.observability.flight_recorder import record as flight_record
 from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.observability.spans import span
+from veomni_tpu.resilience.elastic import (
+    ElasticRestoreError,
+    capture_topology,
+    classify_restore,
+    merge_rank_states,
+    mesh_incompat_reason,
+    split_rank_state,
+)
 from veomni_tpu.resilience.faults import fault_point
 from veomni_tpu.resilience.integrity import (
     QUARANTINE_DIR_RE,
@@ -32,6 +40,8 @@ from veomni_tpu.resilience.integrity import (
     VERIFY_MODES,
     CheckpointCorruptError,
     is_committed_dir,
+    list_rank_sidecars,
+    read_topology,
     verify_manifest,
     write_manifest,
 )
@@ -76,7 +86,7 @@ class Checkpointer:
 
     def __init__(self, ckpt_dir: str, *, async_save: bool = True, max_to_keep: int = 0,
                  io_retries: int = 3, retry_base_s: float = 0.05,
-                 verify_mode: str = "size"):
+                 verify_mode: str = "size", elastic: bool = False):
         if verify_mode not in VERIFY_MODES:
             raise ValueError(
                 f"unknown ckpt verify mode {verify_mode!r}; choose from {VERIFY_MODES}"
@@ -86,6 +96,20 @@ class Checkpointer:
         self.async_save = async_save
         self.max_to_keep = max_to_keep
         self.verify_mode = verify_mode
+        # elastic restore (train.ckpt_elastic / resilience/elastic.py):
+        # allow restoring a checkpoint saved on a different data-parallel
+        # topology — arrays reshard via the target NamedShardings, per-rank
+        # cursor sidecars merge/split. Off (default): a topology mismatch is
+        # an actionable error, never a silent partial cursor restore.
+        self.elastic = elastic
+        # source-topology docs for the manifest, captured from the state
+        # tree at each save dispatch. Keyed BY STEP: the previous async
+        # step's manifest is written from inside the NEXT save(), which has
+        # already captured its own doc — and rank_state_files can differ
+        # between saves, so "latest" would stamp the wrong census onto the
+        # prior generation
+        self._topology: Optional[Dict[str, Any]] = None
+        self._step_topology: Dict[int, Dict[str, Any]] = {}
         self._retry_policy = RetryPolicy(retries=io_retries, base_delay_s=retry_base_s)
         self._saved_steps: set = set()
         self._inflight_step: Optional[int] = None
@@ -230,6 +254,21 @@ class Checkpointer:
         # the previous async commit failed, the error raises here, belongs to
         # the previous step, and must evict that step — not be swallowed by
         # this step's retry loop
+        # source topology for the manifest (mesh axis sizes, world size —
+        # resilience/elastic.py): captured from the state tree's shardings
+        # here, at dispatch, so the commit-time manifest writer (possibly a
+        # daemon thread) never touches jax device state itself.
+        # rank_state_files records how many cursor sidecars this save
+        # writes: the restore gate checks the on-disk set against it, so
+        # losing ALL sidecars to rot is as detectable as losing one (the
+        # directory listing alone cannot tell "all lost" from "none saved")
+        self._topology = dict(
+            capture_topology(train_state),
+            rank_state_files=(
+                jax.process_count() if rank_state is not None else 0
+            ),
+        )
+        self._step_topology[step] = self._topology
         # the span is the single timing source (histogram ``span.ckpt.save``
         # + goodput checkpoint attribution + chrome trace): async saves
         # measure the host-blocking dispatch (serialize-with-previous +
@@ -300,7 +339,12 @@ class Checkpointer:
         host-blocking async save exists to avoid. Serialized: any previous
         digest is joined first, so manifest fault hits stay deterministic."""
         self._join_manifest()
-        if self.verify_mode == "off" or jax.process_index() != 0:
+        if jax.process_index() != 0:
+            return
+        if self.verify_mode == "off":
+            # no digests to compute — the topology-only manifest is an O(1)
+            # write, so it runs inline instead of on a thread
+            self._write_manifest(step)
             return
         t = threading.Thread(
             target=self._write_manifest, args=(step,),
@@ -323,17 +367,25 @@ class Checkpointer:
 
         ``verify_mode == 'off'`` skips the digest entirely: "trust the
         bytes" must not cost a full-tree read of every committed byte per
-        save (inline for sync saves!) to record CRCs nothing will consume.
-        ``size`` mode still records them — its manifests feed the operator
-        CLI's out-of-band ``--mode full`` sweep, not just its own gate."""
-        if self.verify_mode == "off" or jax.process_index() != 0:
+        save (inline for sync saves!) to record CRCs nothing will consume —
+        but the SOURCE TOPOLOGY (mesh axis sizes, world size, jax versions;
+        ``resilience/elastic.py``) is still recorded, an O(1) write, so
+        every generation stays diagnosable and elastically restorable.
+        ``size`` mode still records digests — its manifests feed the
+        operator CLI's out-of-band ``--mode full`` sweep, not just its own
+        gate."""
+        if jax.process_index() != 0:
             return
         step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
         if not self._is_committed(step):
             return
         try:
             with span("ckpt.manifest"):
-                write_manifest(step_dir)
+                write_manifest(
+                    step_dir,
+                    topology=self._step_topology.pop(step, self._topology),
+                    digests=self.verify_mode != "off",
+                )
             # drill point: a corrupt-mode fault spec here damages the
             # just-committed generation AFTER its digests were recorded —
             # exactly the storage-rot timeline the verify gate exists for.
@@ -560,6 +612,13 @@ class Checkpointer:
                 try:
                     return self.load(abstract_state, step=cand)
                 except Exception as e:
+                    if getattr(e, "config_error", False):
+                        # config-class topology error (elastic knob off on a
+                        # resized world, model-parallel degree change):
+                        # walking past it could land on a stale PRE-resize
+                        # generation and silently lose every step since —
+                        # strictly worse than this actionable error
+                        raise
                     last_err = e
                     all_corrupt = all_corrupt and isinstance(
                         e, CheckpointCorruptError
@@ -605,10 +664,30 @@ class Checkpointer:
         self.wait()
         step_dir = os.path.join(self.ckpt_dir, f"global_step_{step}")
         path = os.path.join(step_dir, "train_state")
+        # cheap topology classification FIRST: mismatches no verification
+        # changes (model-parallel degree change; data-parallel resize with
+        # elastic OFF) raise here on metadata alone — rank 0 classifies and
+        # broadcasts ONE verdict on multi-process runs (see _classify_step)
+        # — so the walk never pays a full-CRC verify per generation to
+        # rediscover a config error
+        verdict, reason, rank_files = self._classify_step(
+            step_dir, abstract_state
+        )
         # verification gates the restore: Orbax must never be handed bytes
         # the manifest condemns (its own failure modes on corrupt input are
-        # not guaranteed to be loud)
+        # not guaranteed to be loud). It also keeps quarantine precedence
+        # over a sidecar-based "incompatible" verdict: a missing rank
+        # sidecar is often just storage rot the digest manifest condemns,
+        # and that generation must be quarantined, not merely refused
         self._verify_gate(step)
+        if verdict == "incompatible":
+            raise ElasticRestoreError(
+                f"checkpoint step {step} cannot be restored onto this "
+                f"topology: {reason}"
+            )
+        rank_extra, elastic_event = self._materialize_rank_state(
+            step, step_dir, verdict, reason, rank_files
+        )
         with span("ckpt.restore"):
             restored = retry_call(
                 self._dispatch_restore, path, abstract_state,
@@ -624,29 +703,182 @@ class Checkpointer:
         if os.path.exists(extra_path):
             with open(extra_path) as f:
                 extra = json.load(f)
-        rank_path = os.path.join(
-            step_dir, f"extra_state_rank{jax.process_index()}.json"
-        )
-        if os.path.exists(rank_path):
-            with open(rank_path) as f:
-                rank_extra = json.load(f)
+        if rank_extra is not None:
             if extra is None:
                 extra = {}
             extra.update(rank_extra)
-        elif any(f.startswith("extra_state_rank") for f in os.listdir(step_dir)):
-            # the checkpoint HAS per-rank files, just not for this rank
-            # (process count changed between save and resume). Plain
-            # per-process warning: this condition only occurs on ranks > 0
-            # when the process count GREW, so rank0-gated logging would
-            # never print.
-            logger.warning(
-                "no per-rank extra state for process %d of %d (topology "
-                "changed?); dataloader resume may repeat or skip rank-local "
-                "samples",
-                jax.process_index(), jax.process_count(),
+        if elastic_event is not None:
+            # counted only AFTER the array restore landed: a restore that
+            # reshards its cursors but then fails (and falls back) must not
+            # read as a completed topology crossing in /healthz
+            reg.counter("ckpt.elastic_restores").inc()
+            flight_record("ckpt.reshard", cid=str(step), **elastic_event)
+            logger.warning_rank0(
+                "ELASTIC restore of checkpoint step %d: %s",
+                step, elastic_event["reason"],
             )
         logger.info_rank0("checkpoint restored from step %d", step)
         return restored, extra
+
+    # -------------------------------------------------------------- elastic
+    def _reshard_rank_state(self, step_dir: str, rank_files: List[int],
+                            world: int, rank: int) -> Dict[str, Any]:
+        """One elastic merge/split attempt (the retried unit): read EVERY
+        saved rank's sidecar, fold them into the world-size-agnostic doc,
+        and derive this rank's cursor on the new world size
+        (``resilience/elastic.py``). Deterministic on every rank — all
+        processes read the same files and the merge/split is pure."""
+        fault_point("ckpt.reshard", context={"dir": step_dir})
+        states: Dict[int, Optional[Dict[str, Any]]] = {}
+        for r in rank_files:
+            with open(os.path.join(step_dir, f"extra_state_rank{r}.json")) as f:
+                states[r] = json.load(f)
+        return split_rank_state(merge_rank_states(states), world, rank)
+
+    _VERDICT_CODES = {"none": 0, "ok": 1, "unknown": 2, "elastic": 3,
+                      "incompatible": 4}
+
+    def _classify_local(
+        self, step_dir: str, abstract_state,
+    ) -> "tuple[str, str, List[int], bool]":
+        """``(verdict, reason, rank sidecar list, config_error)`` from
+        metadata alone (manifest topology + directory listing; never the
+        payload bytes). ``config_error`` marks the mismatches no amount of
+        verification changes: a model-parallel degree change, or a
+        data-parallel resize with ``elastic`` OFF — the knob error names
+        the fix instead of the pre-elastic silent behavior (grown ranks
+        left with empty cursors repeating/skipping samples, shrunk worlds
+        dropping the missing ranks' records)."""
+        rank_files = list_rank_sidecars(step_dir)
+        saved_topo = read_topology(step_dir)
+        if not rank_files and saved_topo is None:
+            return "none", "", rank_files, False  # pre-cursor checkpoint
+        current = capture_topology(abstract_state)
+        verdict, reason = classify_restore(
+            saved_topo, jax.process_count(),
+            target_mesh=current.get("mesh"),
+            rank_files=rank_files or None,
+            target_device_count=current.get("device_count"),
+        )
+        if verdict == "incompatible" and mesh_incompat_reason(
+            (saved_topo or {}).get("mesh"), current.get("mesh")
+        ):
+            # config-class subtype: a model-parallel degree change applies
+            # to the run as a whole (the walk aborts), unlike
+            # per-generation damage such as a torn sidecar set — the check
+            # itself lives once, inside classify_restore; this call only
+            # subtypes its verdict
+            return "incompatible", (
+                f"checkpoint in {step_dir} cannot be restored onto this "
+                f"topology: {reason}"
+            ), rank_files, True
+        if verdict == "elastic" and not self.elastic:
+            return "elastic", (
+                f"checkpoint in {step_dir} was saved on a different "
+                f"topology ({reason}) and elastic restore is OFF. Set "
+                f"train.ckpt_elastic=true to reshard the arrays and "
+                f"merge/split the per-rank data cursors onto this topology, "
+                f"or resume on the saved one."
+            ), rank_files, True
+        return verdict, reason, rank_files, False
+
+    def _classify_step(
+        self, step_dir: str, abstract_state,
+    ) -> "tuple[str, str, List[int]]":
+        """Topology classification with ONE verdict for the whole
+        collective: on multi-process runs rank 0 classifies and broadcasts
+        — same altitude as ``_verify_gate``, and for the same reason: two
+        ranks classifying from independent directory listings on a lagging
+        shared fs could split between restoring a generation and falling
+        back past it, wedging the Orbax restore collective instead of
+        failing over cleanly. Config-class mismatches raise here (walk
+        aborts); a sidecar-based ``incompatible`` verdict is RETURNED so
+        the verify gate keeps quarantine precedence (a missing sidecar is
+        often storage rot the digest manifest condemns)."""
+        multi = jax.process_count() > 1
+        verdict, reason, rank_files, config = "none", "", [], False
+        if not multi or jax.process_index() == 0:
+            verdict, reason, rank_files, config = self._classify_local(
+                step_dir, abstract_state
+            )
+        if multi:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            vec = multihost_utils.broadcast_one_to_all(np.asarray(
+                [self._VERDICT_CODES[verdict], int(config), len(rank_files)],
+                np.int32,
+            ))
+            config = bool(vec[1])
+            if jax.process_index() != 0:
+                verdict = {v: k for k, v in self._VERDICT_CODES.items()}[
+                    int(vec[0])
+                ]
+                # rank 0's verdict came with rank 0's listing: derive the
+                # file set from the broadcast count so a lagging local
+                # listing can't silently shrink the merge input (a file
+                # rank 0 saw but this rank can't read yet fails LOUDLY in
+                # the retried reshard read, not silently)
+                rank_files = list(range(int(vec[2])))
+                reason = (
+                    "classified on rank 0 (one verdict for the whole "
+                    "collective; config-level mismatches include a "
+                    "model-parallel degree change or train.ckpt_elastic "
+                    "off on a resized world) — see rank 0's log for detail"
+                )
+        if config:
+            err = ElasticRestoreError(reason)
+            err.config_error = True  # applies to the run, not one generation
+            raise err
+        return verdict, reason, rank_files
+
+    def _materialize_rank_state(
+        self, step: int, step_dir: str, verdict: str, reason: str,
+        rank_files: List[int],
+    ) -> "tuple[Optional[Dict[str, Any]], Optional[Dict[str, Any]]]":
+        """``(per-rank extra state, elastic event-or-None)`` for this
+        process. Same topology: this rank's own sidecar, byte-exact. An
+        ``elastic`` verdict (knob already checked in ``_classify_step``):
+        merge/split of all saved sidecars — the returned event is counted
+        by ``load()`` only once the array restore lands, so a resize whose
+        restore then fails never reads as a completed topology crossing."""
+        if verdict == "none":
+            return None, None
+        if verdict in ("ok", "unknown"):
+            if verdict == "unknown":
+                logger.warning_rank0(
+                    "checkpoint step %d: %s", step, reason,
+                )
+            return self._read_own_sidecar(step_dir), None
+        # verdict == "elastic"
+        world = jax.process_count()
+        rank = jax.process_index()
+        if not rank_files:
+            # mesh-only resize with no cursor sidecars: arrays reshard via
+            # the target NamedShardings; there is no cursor to bridge
+            resolved = None
+        else:
+            resolved = retry_call(
+                self._reshard_rank_state, step_dir, rank_files, world, rank,
+                policy=self._retry_policy,
+                description=f"elastic cursor reshard (step {step})",
+            )
+        event = {
+            "saved_world": len(rank_files)
+            or (read_topology(step_dir) or {}).get("world_size"),
+            "world": world,
+            "reason": reason[:200],
+        }
+        return resolved, event
+
+    def _read_own_sidecar(self, step_dir: str) -> Optional[Dict[str, Any]]:
+        rank_path = os.path.join(
+            step_dir, f"extra_state_rank{jax.process_index()}.json"
+        )
+        if not os.path.exists(rank_path):
+            return None
+        with open(rank_path) as f:
+            return json.load(f)
 
     def close(self):
         self._ckptr.wait_until_finished()

@@ -124,7 +124,10 @@ class DistributedDataloader:
 
     # ----------------------------------------------------------------- state
     def state_dict(self) -> Dict[str, Any]:
-        state = {"epoch": self._epoch, "cursor": self._cursor, "seed": self.seed}
+        state = {"epoch": self._epoch, "cursor": self._cursor, "seed": self.seed,
+                 # elastic-merge metadata (resilience/elastic.py): which rank
+                 # of which world this cursor belongs to
+                 "dp_rank": self.dp_rank, "dp_size": self.dp_size}
         if hasattr(self.dataset, "state_dict"):
             state["dataset"] = self.dataset.state_dict()
         if hasattr(self.collate_fn, "state_dict"):

@@ -23,6 +23,7 @@ with :class:`PrefetcherClosed` instead of absorbing a preemption deadline.
 
 from __future__ import annotations
 
+import copy
 import queue
 import threading
 import time
@@ -32,6 +33,17 @@ from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.resilience.faults import fault_point
 
 _SENTINEL = object()
+
+
+def _snapshot(state: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Deep copy of a loader cursor snapshot. The worker thread keeps
+    iterating (and the loader keeps mutating its internal maps — e.g. the
+    streaming dataset's globally-keyed ``consumed`` table) after the
+    snapshot is taken; a shared reference would let run-ahead contaminate
+    the cursor a checkpoint later serializes, silently breaking both exact
+    resume and the elastic merge that trusts per-rank snapshots to be
+    mutually consistent."""
+    return copy.deepcopy(state) if state is not None else None
 
 
 class PrefetcherClosed(RuntimeError):
@@ -55,7 +67,7 @@ class BackgroundPrefetcher:
         self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._closed = False
-        self._consumed_state: Optional[Dict[str, Any]] = (
+        self._consumed_state: Optional[Dict[str, Any]] = _snapshot(
             dataloader.state_dict() if hasattr(dataloader, "state_dict") else None
         )
         self._finished: Optional[BaseException | type] = None
@@ -90,7 +102,7 @@ class BackgroundPrefetcher:
                     batch = next(it)
                 except StopIteration:
                     break
-                snap = (
+                snap = _snapshot(
                     self.dataloader.state_dict()
                     if hasattr(self.dataloader, "state_dict")
                     else None

@@ -261,8 +261,24 @@ class StreamingShardDataset:
         self._epoch = 0
         self._shard_pos = 0
         self._rec_pos = 0
+        # globally-keyed within-epoch progress: {corpus-relative shard key ->
+        # records consumed this epoch, as a PREFIX of the shard's
+        # (seed, epoch, sid)-permuted record order}. The permutation is a
+        # pure global function and (with shards >= ranks) each shard is
+        # consumed by exactly one rank, so this map — unlike the rank-local
+        # (shard_pos, rec_pos) cursor — is world-size-transferable: an
+        # elastic N->M resume unions the ranks' maps and every new rank
+        # skips the consumed prefix of whatever shards its own assignment
+        # holds (resilience/elastic.py).
+        self._consumed: Dict[str, int] = {}
 
     # -- index helpers ------------------------------------------------------
+    def _shard_key(self, shard: str) -> str:
+        """Corpus-root-relative shard key (same keying as the poison-skip
+        history: relocatable with the corpus, distinct across same-named
+        shards in different directories)."""
+        return os.path.relpath(shard, self._skip_root)
+
     def _reader(self, shard: str):
         r = self._readers.get(shard)
         if r is None:
@@ -303,7 +319,7 @@ class StreamingShardDataset:
         Re-encounters of an already-recorded pair — post-resume replay, or
         the dataloader's ``__len__`` probe touching the same record training
         later reads — consume NO budget, so replay accounting is exact."""
-        key = (os.path.relpath(err.shard, self._skip_root), int(err.record))
+        key = (self._shard_key(err.shard), int(err.record))
         if key in self._skipped_set:
             logger.warning(
                 "re-skipping known poison record %s[%d] (replay)",
@@ -357,7 +373,17 @@ class StreamingShardDataset:
         my = self._my_shards(self._epoch)
         while self._shard_pos < len(my):
             shard = my[self._shard_pos]
+            key = self._shard_key(shard)
             order = self._rec_order(shard, self._epoch)
+            # an elastic restore sets shard_pos/rec_pos to 0 and hands every
+            # rank the merged consumed map: skip this shard's already-
+            # consumed prefix (same-rank resumes: consumed[key] == rec_pos,
+            # so the max is a no-op; legacy states have no map at all)
+            self._rec_pos = max(
+                self._rec_pos, min(self._consumed.get(key, 0), len(order))
+            )
+            # _rec_order already opened the shard (the permutation needs its
+            # length), so this is a cache hit even for fully-consumed shards
             reader = self._reader(shard)
             while self._rec_pos < len(order):
                 try:
@@ -365,28 +391,77 @@ class StreamingShardDataset:
                 except ShardRecordError as e:
                     self._note_poison(e)  # raises once the budget is spent
                     self._rec_pos += 1
+                    self._consumed[key] = self._rec_pos
                     continue
                 self._rec_pos += 1
+                self._consumed[key] = self._rec_pos
                 yield self.transform(row) if self.transform else row
             self._rec_pos = 0
             self._shard_pos += 1
         self._shard_pos = 0
         self._epoch += 1
+        self._consumed = {}  # per-epoch progress; the new epoch starts clean
 
     def state_dict(self) -> Dict[str, Any]:
         return {
             "epoch": self._epoch,
             "shard_pos": self._shard_pos,
             "rec_pos": self._rec_pos,
+            # globally-keyed progress (copied: the prefetch thread snapshots
+            # this between batches while iteration keeps mutating the map)
+            "consumed": dict(self._consumed),
             # list-of-lists (JSON-stable) in skip order: restoring makes the
             # resumed run replay the identical skips with identical budget
             "skipped": [[s, r] for s, r in self._skipped],
+            # elastic-merge metadata (resilience/elastic.py): which rank of
+            # which world wrote this, and whether records (not shards) were
+            # strided over ranks — the one assignment that is NOT
+            # prefix-mergeable across a world resize
+            "dp_rank": self.dp_rank,
+            "dp_size": self.dp_size,
+            "stride_records": bool(self._stride_records),
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
+        if (state.get("elastic") and state.get("consumed")
+                and self._stride_records):
+            # an elastically-merged mid-epoch cursor arriving at a dataset
+            # that strides RECORDS over ranks (fewer shards than ranks):
+            # this rank's _rec_order is a strided subsequence, so clamping
+            # the global consumed-prefix counts against it would silently
+            # repeat records on some ranks and skip them on others — the
+            # exact corruption elastic restore exists to prevent. The saved
+            # side of this check lives in elastic._merge_streaming; only
+            # the dataset knows the TARGET regime.
+            from veomni_tpu.resilience.elastic import ElasticRestoreError
+
+            raise ElasticRestoreError(
+                f"elastic streaming resume onto {self.dp_size} ranks with "
+                f"only {len(self.shards)} shard(s): the record-strided "
+                f"assignment is not prefix-addressable, so the merged "
+                f"mid-epoch cursor cannot be applied. Resume on at most "
+                f"{len(self.shards)} ranks, resume from an epoch-boundary "
+                f"checkpoint, or re-shard the corpus into >= world_size "
+                f"shards."
+            )
         self._epoch = int(state.get("epoch", 0))
         self._shard_pos = int(state.get("shard_pos", 0))
         self._rec_pos = int(state.get("rec_pos", 0))
+        consumed = {
+            str(k): int(v) for k, v in (state.get("consumed") or {}).items()
+        }
+        if consumed:
+            # keep only THIS rank's assignment: an elastically-merged map
+            # carries every rank's entries, but foreign ones are never
+            # consulted here — re-serializing them into later checkpoints
+            # would go stale as their owners advance, triggering false
+            # consumed-count-conflict alarms on the NEXT resize (and sidecar
+            # size would grow with the corpus, not this rank's share)
+            mine = {
+                self._shard_key(s) for s in self._my_shards(self._epoch)
+            }
+            consumed = {k: v for k, v in consumed.items() if k in mine}
+        self._consumed = consumed
         self._skipped = [(str(s), int(r)) for s, r in state.get("skipped", [])]
         self._skipped_set = set(self._skipped)
 

@@ -13,7 +13,11 @@ JAX pjit and TPUv4", PAPERS.md). This package supplies the three legs:
                    fallback) and poison-record provenance for streaming data;
 * ``supervisor`` — train-loop anomaly supervision (device-side finite-loss
                    flag -> skip-step -> checkpoint rollback -> abort), a hang
-                   watchdog, and SIGTERM/preemption-safe graceful shutdown.
+                   watchdog, and SIGTERM/preemption-safe graceful shutdown;
+* ``elastic``    — universal checkpoint topology: source-mesh metadata in
+                   every manifest, a restore compatibility gate, and
+                   world-size-aware merge/split of the per-rank data cursors
+                   so a run saved on N processes resumes on M.
 """
 
 from veomni_tpu.resilience.faults import (
@@ -25,12 +29,20 @@ from veomni_tpu.resilience.faults import (
     fault_point,
     fired_faults,
 )
+from veomni_tpu.resilience.elastic import (
+    ElasticRestoreError,
+    capture_topology,
+    classify_restore,
+    merge_rank_states,
+    split_rank_state,
+)
 from veomni_tpu.resilience.integrity import (
     CheckpointCorruptError,
     ShardRecordError,
     VerifyReport,
     crc32_file,
     read_manifest,
+    read_topology,
     verify_manifest,
     write_manifest,
 )
@@ -46,6 +58,7 @@ from veomni_tpu.resilience.supervisor import (
 __all__ = [
     "AnomalyBudgetExceeded",
     "CheckpointCorruptError",
+    "ElasticRestoreError",
     "FaultAction",
     "GracefulShutdown",
     "InjectedFault",
@@ -56,13 +69,18 @@ __all__ = [
     "TrainSupervisor",
     "VerifyReport",
     "arm_from_env",
+    "capture_topology",
+    "classify_restore",
     "configure_faults",
     "crc32_file",
     "disarm_faults",
     "fault_point",
     "fired_faults",
+    "merge_rank_states",
     "read_manifest",
+    "read_topology",
     "retry_call",
+    "split_rank_state",
     "verify_manifest",
     "write_manifest",
 ]

@@ -14,6 +14,10 @@ Fault points wired through the stack:
 ``ckpt.manifest`` right after rank 0 writes a committed generation's
                 integrity manifest (context: the step dir) — the ``corrupt``
                 drill point for storage rot on checkpoint payloads
+``ckpt.reshard`` inside each elastic sidecar merge/split attempt (reading
+                every saved rank's ``extra_state_rank*.json`` and deriving
+                this rank's cursor on the new world size; retried, fires per
+                attempt) — drills the topology-change restore path
 ``data.fetch``  streaming shard record reads (retried, fires per attempt)
                 AND the prefetch worker's per-batch pull (NOT retried: an
                 exception there exercises the worker->consumer error
@@ -74,8 +78,8 @@ logger = get_logger(__name__)
 
 ENV_PLAN = "VEOMNI_FAULT_PLAN"
 
-KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "data.fetch",
-                "data.record", "step.loss")
+KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "ckpt.reshard",
+                "data.fetch", "data.record", "step.loss")
 
 _MODES = ("exception", "nan", "hang", "corrupt")
 

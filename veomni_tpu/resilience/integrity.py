@@ -37,6 +37,22 @@ MANIFEST_VERSION = 1
 # e.g. the quarantine collision suffix can never leave the two disagreeing
 STEP_DIR_RE = re.compile(r"^global_step_(\d+)$")
 QUARANTINE_DIR_RE = re.compile(r"^global_step_(\d+)\.corrupt(\.\d+)?$")
+#: per-process cursor sidecar naming — shared by the checkpointer's elastic
+#: restore gate and the operator CLI's ELASTIC-OK verdict (same
+#: single-definition rule as the regexes above: the two must never disagree
+#: on which files make a rank set complete)
+RANK_SIDECAR_RE = re.compile(r"^extra_state_rank(\d+)\.json$")
+
+
+def list_rank_sidecars(step_dir: str) -> List[int]:
+    """Sorted ranks with an ``extra_state_rank{N}.json`` sidecar in
+    ``step_dir``."""
+    out = []
+    for fname in os.listdir(step_dir):
+        m = RANK_SIDECAR_RE.match(fname)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
 
 #: payload subdir whose existence IS the commit marker (Orbax renames its
 #: tmp dir here atomically on commit) — same single-definition rule as the
@@ -176,22 +192,35 @@ def write_manifest(
     step_dir: str,
     subtrees: Tuple[str, ...] = (TRAIN_STATE_DIR,),
     include_sidecars: bool = True,
+    topology: Optional[Dict[str, Any]] = None,
+    digests: bool = True,
 ) -> str:
     """Digest ``step_dir``'s payload subtrees (+ ``extra_state*.json``
     sidecars) into ``step_dir/manifest.json``. Atomic: written to a tmp name
     then renamed, so a crashed writer can never leave a half manifest that
-    later condemns a healthy checkpoint."""
+    later condemns a healthy checkpoint.
+
+    ``topology`` (see ``resilience/elastic.py``) rides along so an elastic
+    restore — or an operator with ``scripts/verify_ckpt.py`` — can tell what
+    mesh/world wrote the generation. ``digests=False`` records ONLY the
+    topology (``files`` stays empty, an O(1) write): ``ckpt_verify=off``
+    must not cost a full-tree CRC read per save, but the checkpoint should
+    still be diagnosable; :func:`verify_manifest` treats a digest-free
+    manifest as unverifiable, never as verified-clean."""
     files: Dict[str, Dict[str, Any]] = {}
-    for sub in subtrees:
-        root = os.path.join(step_dir, sub)
-        if os.path.isdir(root):
-            files.update(digest_tree(root, base=step_dir))
-    if include_sidecars:
-        for fname in sorted(os.listdir(step_dir)):
-            if fname.startswith("extra_state") and fname.endswith(".json"):
-                crc, size = crc32_file(os.path.join(step_dir, fname))
-                files[fname] = {"size": size, "crc32": f"{crc:08x}"}
-    doc = {"version": MANIFEST_VERSION, "files": files}
+    if digests:
+        for sub in subtrees:
+            root = os.path.join(step_dir, sub)
+            if os.path.isdir(root):
+                files.update(digest_tree(root, base=step_dir))
+        if include_sidecars:
+            for fname in sorted(os.listdir(step_dir)):
+                if fname.startswith("extra_state") and fname.endswith(".json"):
+                    crc, size = crc32_file(os.path.join(step_dir, fname))
+                    files[fname] = {"size": size, "crc32": f"{crc:08x}"}
+    doc: Dict[str, Any] = {"version": MANIFEST_VERSION, "files": files}
+    if topology is not None:
+        doc["topology"] = topology
     path = os.path.join(step_dir, MANIFEST_NAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -219,6 +248,17 @@ def read_manifest(step_dir: str) -> Optional[Dict[str, Any]]:
     return doc
 
 
+def read_topology(step_dir: str) -> Optional[Dict[str, Any]]:
+    """The topology document recorded at save time (mesh axis sizes, world
+    size, jax versions — see ``resilience/elastic.py``), or None for
+    pre-elastic checkpoints / unreadable manifests."""
+    doc = read_manifest(step_dir)
+    if doc is None:
+        return None
+    topo = doc.get("topology")
+    return topo if isinstance(topo, dict) else None
+
+
 def verify_manifest(step_dir: str, mode: str = "size") -> Optional[VerifyReport]:
     """Check ``step_dir`` against its manifest. Returns None when ``mode``
     is ``off`` or no (readable) manifest exists — "unverifiable" must stay
@@ -231,6 +271,12 @@ def verify_manifest(step_dir: str, mode: str = "size") -> Optional[VerifyReport]
         raise ValueError(f"unknown verify mode {mode!r}; choose from {VERIFY_MODES}")
     doc = read_manifest(step_dir)
     if doc is None:
+        return None
+    if not doc["files"]:
+        # topology-only manifest (written under ckpt_verify=off so the
+        # generation stays diagnosable): no digests were recorded, so the
+        # generation is UNVERIFIABLE — an empty file table must never read
+        # as "verified clean"
         return None
     t0 = time.perf_counter()
     report = VerifyReport(root=step_dir, mode=mode, total=len(doc["files"]))

@@ -219,6 +219,9 @@ class TrainSupervisor:
             "ckpt_quarantined": int(reg.counter("integrity.ckpt_quarantined").value),
             "ckpt_fallbacks": int(reg.counter("integrity.ckpt_fallbacks").value),
             "data_skipped": int(reg.counter("integrity.data_skipped").value),
+            # a probe should see that this run crossed a topology boundary
+            # (resharded arrays + merged cursors) without log scraping
+            "elastic_restores": int(reg.counter("ckpt.elastic_restores").value),
             **self.stats(),
         }
 

@@ -430,6 +430,7 @@ class BaseTrainer:
             io_retries=t.resilience_io_retries,
             retry_base_s=t.resilience_retry_base_s,
             verify_mode=t.ckpt_verify,
+            elastic=t.ckpt_elastic,
         )
 
     def _inner_loss_fn(self, model):
@@ -659,6 +660,12 @@ class BaseTrainer:
         # save that landed inside the window (detection lags by the
         # in-flight depth) would make the rewind a no-op — the cursor must
         # back up past the anomalous batches so the replay re-runs them.
+        # Elastic-safe: the walk goes through the same topology gate as any
+        # restore (checkpoint/checkpointer.py::_classify_step +
+        # _materialize_rank_state), so a rollback target saved pre-resize
+        # (an elastically-resumed run rolling back past its own resize
+        # point) reshards cursors instead of silently restoring the wrong
+        # world's state.
         # max_step (not a pinned step) keeps the checkpointer's verify-and-
         # fall-back walk in play: a rollback must never restore from a
         # generation that fails manifest verification, so a corrupt target
