@@ -81,6 +81,12 @@ BENCH_PRESETS = {
     # 3.4G vs AdamW's 9.6G f32 state
     "qwen3_1p7b": dict(hidden_size=2048, intermediate_size=6144,
                        num_hidden_layers=28, param_dtype="bfloat16"),
+    # CPU-runnable smoke point (JAX_PLATFORMS=cpu BENCH_SERVE=1 ...): the
+    # serve bench's engine/cache accounting is host-side, so prefix-cache
+    # hit rates and prefill-step counts measured here transfer to the real
+    # presets — only the kernel timings don't
+    "qwen3_smoke": dict(hidden_size=256, intermediate_size=512,
+                        num_hidden_layers=2, param_dtype="float32"),
 }
 
 
@@ -308,13 +314,22 @@ def run_serve_bench(
     max_new_tokens: int = 64,
     preset: str = "qwen3_0p6b",
     remat_policy: str = "dots",
+    shared_prefix: int = 0,
+    prefill_chunk: int = 0,
+    prefix_cache: bool = True,
 ) -> dict:
     """Continuous-batching inference throughput: N requests with a cycled
     prompt-length mix through the serving engine. Returns decode tokens/s
     (steady-state, measured after the first token of the last-admitted
     request wherever possible — here simply total generated / wall) and
     mean TTFT. Single-chip, random weights: measures the engine + kernels,
-    not checkpoint IO."""
+    not checkpoint IO.
+
+    ``shared_prefix`` > 0 makes every prompt open with the same
+    ``shared_prefix``-token system prompt (the millions-of-users-share-a-
+    system-prompt workload) and ALSO drives the same timed request set
+    through a cache-off engine, so the JSON line carries TTFT p50/p99 and
+    prefill step counts with the prefix cache on vs off."""
     import jax
     import jax.numpy as jnp
 
@@ -332,37 +347,62 @@ def run_serve_bench(
     params = model.family.init_params(jax.random.PRNGKey(0), cfg)
 
     max_len = max(prompt_lens) + max_new_tokens
-    eng = InferenceEngine(params, cfg, EngineConfig(
-        num_slots=num_slots, block_size=block_size, max_model_len=max_len,
-    ))
     rng = np.random.default_rng(0)
+    prefix = [int(t) for t in rng.integers(1, cfg.vocab_size, shared_prefix)]
 
-    def make_requests(n):
-        return [
-            Request(
-                prompt_ids=[int(t) for t in rng.integers(
-                    1, cfg.vocab_size, prompt_lens[i % len(prompt_lens)]
-                )],
-                sampling=SamplingParams(max_new_tokens=max_new_tokens),
-            )
-            for i in range(n)
-        ]
+    def make_prompts(n, seed):
+        r = np.random.default_rng(seed)
+        prompts = []
+        for i in range(n):
+            want = prompt_lens[i % len(prompt_lens)]
+            # at least one unique token per request so every prompt still
+            # has an uncached suffix to run (and requests stay distinct)
+            suffix = max(1, want - shared_prefix)
+            prompts.append(prefix[: max(0, want - suffix)] + [
+                int(t) for t in r.integers(1, cfg.vocab_size, suffix)
+            ])
+        return prompts
 
-    # warmup through the SAME engine (the decode-step jit cache is
-    # per-engine), one length class at a time: a solo run walks that class's
-    # whole block-allocation trajectory, so every power-of-two context
-    # bucket the timed run can hit (nbb is always pow2 of SOME running
-    # seq's allocation) is compiled before t0 — batch-mixed warmup would
-    # let the longest prompt mask the smaller buckets
-    for req in make_requests(len(prompt_lens)):
-        eng.run([req])
-    eng.metrics()  # reset the throughput window
+    def drive(engine_cfg, warm_prompts, timed_prompts):
+        eng = InferenceEngine(params, cfg, engine_cfg)
+        # warmup through the SAME engine (the decode-step jit cache is
+        # per-engine), one length class at a time: a solo run walks that
+        # class's whole block-allocation trajectory, so every power-of-two
+        # context bucket the timed run can hit (nbb is always pow2 of SOME
+        # running seq's allocation) is compiled before t0 — batch-mixed
+        # warmup would let the longest prompt mask the smaller buckets.
+        # With the prefix cache on this also pre-caches the shared prefix,
+        # so the timed window measures the steady state.
+        for p in warm_prompts:
+            eng.run([Request(prompt_ids=p, sampling=SamplingParams(
+                max_new_tokens=max_new_tokens))])
+        m0 = eng.metrics()  # reset the throughput window
 
-    timed = make_requests(n_requests)
-    t0 = time.perf_counter()
-    ids = [eng.submit(r) for r in timed]
-    outs = eng.run()
-    dt = time.perf_counter() - t0
+        timed = [Request(prompt_ids=p, sampling=SamplingParams(
+            max_new_tokens=max_new_tokens)) for p in timed_prompts]
+        t0 = time.perf_counter()
+        ids = [eng.submit(r) for r in timed]
+        outs = eng.run()
+        dt = time.perf_counter() - t0
+        m1 = eng.metrics(reset_window=False)
+        # warmup-proof deltas across the timed window; prompt_tokens counts
+        # every (re)admission's recompute prompt, so the token fraction is
+        # bounded by 1 even under preemption storms
+        delta = {k: m1[k] - m0[k]
+                 for k in ("prefill_chunks", "cached_tokens",
+                           "prompt_tokens")}
+        return eng, ids, outs, dt, delta
+
+    def _pctl(vals, q):
+        return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+
+    engine_cfg = EngineConfig(
+        num_slots=num_slots, block_size=block_size, max_model_len=max_len,
+        prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+    )
+    warm = make_prompts(len(prompt_lens), seed=1)
+    timed_prompts = make_prompts(n_requests, seed=2)
+    eng, ids, outs, dt, delta = drive(engine_cfg, warm, timed_prompts)
     total = sum(len(outs[rid].token_ids) for rid in ids)
     ttfts = [outs[rid].ttft_s for rid in ids if outs[rid].ttft_s is not None]
 
@@ -370,15 +410,14 @@ def run_serve_bench(
     # outputs carry the request_trace rollup, so warmup traffic in the
     # process-global histograms can't skew these) — the numbers the
     # SLO-scheduling roadmap item regresses against
-    def _pctl(vals, q):
-        return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
-
     waits = [outs[rid].queue_wait_s for rid in ids
              if outs[rid].queue_wait_s is not None]
     tpots = [outs[rid].tpot_s for rid in ids if outs[rid].tpot_s is not None]
-    return {
+    result = {
         "decode_tok_s": total / dt,
         "ttft_mean_s": sum(ttfts) / max(1, len(ttfts)),
+        "ttft_p50_s": _pctl(ttfts, 50),
+        "ttft_p99_s": _pctl(ttfts, 99),
         "total_tokens": total,
         "dt": dt,
         "num_slots": num_slots,
@@ -387,6 +426,9 @@ def run_serve_bench(
         "prompt_lens": list(prompt_lens),
         "max_new_tokens": max_new_tokens,
         "preset": preset,
+        "shared_prefix": shared_prefix,
+        "prefill_chunk": prefill_chunk,
+        "prefix_cache": prefix_cache,
         "preemptions": eng.scheduler.preemption_count,
         "queue_wait_p50_s": _pctl(waits, 50),
         "queue_wait_p99_s": _pctl(waits, 99),
@@ -396,7 +438,35 @@ def run_serve_bench(
         # cumulative scheduler counter would fold warmup traffic in
         "preemptions_per_request": sum(
             outs[rid].preemptions for rid in ids) / max(1, n_requests),
+        # prefix-cache effectiveness over the timed window. Two distinct
+        # views: hit RATE is request-weighted (share of timed requests
+        # whose latest admission matched cached blocks), the token FRAC is
+        # token-weighted over every (re)admission's recompute prompt
+        # (warmup-proof engine-counter delta, bounded by 1 even when
+        # preemption re-admissions inflate cached_tokens per request)
+        "prefix_hit_rate": sum(
+            1 for rid in ids if outs[rid].cached_tokens > 0
+        ) / max(1, len(ids)),
+        "cached_tokens_frac": (
+            delta["cached_tokens"] / max(1.0, delta["prompt_tokens"])
+        ),
+        "prefill_chunks": delta["prefill_chunks"],
     }
+    if shared_prefix > 0 and prefix_cache:
+        # the same request set through a cache-off engine: the on-vs-off
+        # TTFT/prefill-step comparison the ROADMAP's serving item regresses
+        _, ids2, outs2, _, delta_off = drive(
+            EngineConfig(num_slots=num_slots, block_size=block_size,
+                         max_model_len=max_len, prefix_cache=False,
+                         prefill_chunk=prefill_chunk),
+            warm, timed_prompts,
+        )
+        off_ttfts = [outs2[rid].ttft_s for rid in ids2
+                     if outs2[rid].ttft_s is not None]
+        result["nocache_ttft_p50_s"] = _pctl(off_ttfts, 50)
+        result["nocache_ttft_p99_s"] = _pctl(off_ttfts, 99)
+        result["nocache_prefill_chunks"] = delta_off["prefill_chunks"]
+    return result
 
 
 def _serve_main(preset: str, watchdog=None):
@@ -405,6 +475,13 @@ def _serve_main(preset: str, watchdog=None):
         int(x) for x in
         os.environ.get("BENCH_SERVE_PROMPT_LENS", "64,128,256").split(",")
     )
+    shared_prefix = int(os.environ.get("BENCH_SERVE_SHARED_PREFIX", 0))
+    # chunked prefill defaults ON for the shared-prefix workload: without
+    # chunks, cache-on and cache-off both run one prefill step per request
+    # and the on-vs-off step-count comparison is vacuous
+    prefill_chunk = int(os.environ.get(
+        "BENCH_SERVE_PREFILL_CHUNK", 64 if shared_prefix > 0 else 0
+    ))
     r = run_serve_bench(
         num_slots=int(os.environ.get("BENCH_SERVE_SLOTS", 4)),
         block_size=int(os.environ.get("BENCH_SERVE_BLOCK", 16)),
@@ -412,10 +489,14 @@ def _serve_main(preset: str, watchdog=None):
         prompt_lens=lens,
         max_new_tokens=int(os.environ.get("BENCH_SERVE_NEW_TOKENS", 64)),
         preset=preset,
+        shared_prefix=shared_prefix,
+        prefill_chunk=prefill_chunk,
+        prefix_cache=os.environ.get("BENCH_SERVE_PREFIX_CACHE", "1")
+        not in ("0", ""),
     )
     if watchdog is not None:
         watchdog.stop()
-    print(json.dumps({
+    line = {
         "metric": "serve_decode_tokens_per_sec",
         "value": round(r["decode_tok_s"], 1),
         "unit": f"decode tokens/s ({r['preset']} bf16, slots={r['num_slots']}, "
@@ -433,7 +514,23 @@ def _serve_main(preset: str, watchdog=None):
         "tpot_p50_s": round(r["tpot_p50_s"], 5),
         "tpot_p99_s": round(r["tpot_p99_s"], 5),
         "preemptions_per_request": round(r["preemptions_per_request"], 3),
-    }), flush=True)
+        # prefix-cache effectiveness (serving/prefix_cache.py): timed-window
+        # hit rate + prefill step count, with TTFT percentiles on vs off
+        # when the shared-prefix workload is active
+        "shared_prefix": r["shared_prefix"],
+        "prefill_chunk": r["prefill_chunk"],
+        "prefix_cache": r["prefix_cache"],
+        "prefix_hit_rate": round(r["prefix_hit_rate"], 4),
+        "cached_tokens_frac": round(r["cached_tokens_frac"], 4),
+        "prefill_chunks": r["prefill_chunks"],
+        "ttft_p50_s": round(r["ttft_p50_s"], 5),
+        "ttft_p99_s": round(r["ttft_p99_s"], 5),
+    }
+    if "nocache_ttft_p50_s" in r:
+        line["nocache_ttft_p50_s"] = round(r["nocache_ttft_p50_s"], 5)
+        line["nocache_ttft_p99_s"] = round(r["nocache_ttft_p99_s"], 5)
+        line["nocache_prefill_chunks"] = r["nocache_prefill_chunks"]
+    print(json.dumps(line), flush=True)
 
 
 def main():

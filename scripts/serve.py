@@ -15,9 +15,12 @@ Run:
       --temperature 0.8 --top-p 0.9
 
 Env knobs (flags win): VEOMNI_SERVE_SLOTS, VEOMNI_SERVE_BLOCK,
-VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS, VEOMNI_SERVE_OUT
-(post-mortem dump dir, default CWD). VEOMNI_METRICS_PORT serves
-Prometheus /metrics + /healthz while the pump runs (docs/observability.md).
+VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS, VEOMNI_SERVE_PREFIX_CACHE
+(1 default; 0 disables prompt-block sharing), VEOMNI_SERVE_PREFILL_CHUNK
+(tokens prefilled per engine tick, 0 = whole prompt at once),
+VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD). VEOMNI_METRICS_PORT
+serves Prometheus /metrics + /healthz while the pump runs; /debug/requests
+rows carry each request's cached_tokens (docs/observability.md).
 """
 
 import argparse
@@ -73,6 +76,17 @@ def main():
                     default=int(os.environ.get("VEOMNI_SERVE_MAX_LEN", 2048)))
     ap.add_argument("--log-steps", type=int,
                     default=int(os.environ.get("VEOMNI_SERVE_LOG_STEPS", 0)))
+    ap.add_argument("--prefix-cache", type=int, choices=(0, 1),
+                    default=int(os.environ.get("VEOMNI_SERVE_PREFIX_CACHE",
+                                               1)),
+                    help="share prompt KV blocks across requests (radix "
+                         "prefix cache; 0 restores exclusive blocks)")
+    ap.add_argument("--prefill-chunk", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_PREFILL_CHUNK",
+                                               0)),
+                    help="max tokens prefilled per engine tick (0 = whole "
+                         "prompt at once; bounds how long a long arrival "
+                         "stalls running decodes)")
     args = ap.parse_args()
 
     import numpy as np
@@ -88,6 +102,8 @@ def main():
     engine = InferenceEngine(params, cfg, EngineConfig(
         num_slots=args.slots, block_size=args.block_size,
         max_model_len=args.max_model_len, log_every_steps=args.log_steps,
+        prefix_cache=bool(args.prefix_cache),
+        prefill_chunk=args.prefill_chunk,
     ))
     # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz + /debug/flight +
     # /debug/requests (per-request timelines) for the pump loop (the engine
@@ -147,6 +163,7 @@ def main():
             "request_id": rid, "tokens": o.token_ids,
             "finish_reason": o.finish_reason,
             "ttft_s": round(o.ttft_s, 4) if o.ttft_s is not None else None,
+            "cached_tokens": o.cached_tokens,
         }), flush=True)
 
 

@@ -182,6 +182,28 @@ def _paged_layer(hidden, lp, cfg: TransformerConfig, cos, sin, k_pool, v_pool,
     return _layer_tail(hidden, attn, lp, cfg, is_moe), k_pool, v_pool
 
 
+def _paged_prefill_layer(hidden, lp, cfg: TransformerConfig, cos, sin,
+                         k_pool, v_pool, block_tables, write_blocks,
+                         write_offs, valid_mask, is_moe):
+    """One decoder layer over a prefill **chunk** against the paged pool:
+    T chunk rows of a single sequence (B==1). Every chunk row's k/v is
+    scattered to its (block, offset) BEFORE attending — the same
+    write-before-attend invariant as the contiguous path, so a chunk row
+    can attend to earlier rows of its own chunk as well as the cached
+    prefix. Rows past the real chunk length are routed to the reserved
+    null block 0 (garbage no live query can see)."""
+    x = _norm(hidden, lp["input_layernorm"], cfg)
+    q, k_new, v_new = _qkv(x, lp, cfg, cos, sin)
+    k_pool = k_pool.at[write_blocks, write_offs].set(k_new[0])
+    v_pool = v_pool.at[write_blocks, write_offs].set(v_new[0])
+    nrep, scale = _attn_params(cfg)
+    attn = ops.paged_prefill_attend(
+        q, k_pool, v_pool, block_tables, valid_mask,
+        num_rep=nrep, scale=scale, sinks=lp.get("sinks"),
+    )
+    return _layer_tail(hidden, attn, lp, cfg, is_moe), k_pool, v_pool
+
+
 def _layer_meta(cfg: TransformerConfig):
     """Per-layer static arrays: window sizes [L] (0 = full) and local-rope
     flags [L]; plus the (possibly two-segment) stacked param trees."""
@@ -295,6 +317,110 @@ def _paged_walk(compute, cfg: TransformerConfig, hidden, pools, block_tables,
         k_all = k_all.at[sl].set(k_seg)
         v_all = v_all.at[sl].set(v_seg)
     return hidden, (k_all, v_all)
+
+
+def _paged_prefill_walk(compute, cfg: TransformerConfig, hidden, pools,
+                        block_tables, positions, chunk_len, cos_g, sin_g,
+                        cos_l, sin_l):
+    """Chunk-prefill analogue of ``_paged_walk``: scan all layers (dense
+    segment then MoE segment) threading the block pools, with T chunk
+    queries instead of one decode query per slot.
+
+    pools: (k [L,NB,BS,hkv,d], v); block_tables [1,nb] (null-padded);
+    positions [CB] are the chunk rows' absolute write/query positions
+    (``start + arange(CB)``); chunk_len (traced) is the real chunk length.
+    Block-table order is sequence order, so gathered context index j sits
+    at absolute position j and the causal/window masks are identical to
+    the contiguous prefill's."""
+    windows, local_flags = _layer_meta(cfg)
+    k_all, v_all = pools
+    bs = k_all.shape[2]  # [L, NB, BS, hkv, d]
+    nb = block_tables.shape[1]
+    ctx = nb * bs
+    kpos = jnp.arange(ctx)[None, None]  # [1,1,ctx]
+    qpos = positions[None, :, None]  # [1,CB,1]
+    valid_base = kpos <= qpos
+    cb = positions.shape[0]
+    real = jnp.arange(cb) < chunk_len  # rows actually in this chunk
+    # rows past chunk_len (bucket padding) write their garbage into the
+    # null block; real rows land at (table[pos // bs], pos % bs). The clip
+    # keeps the table gather in bounds for padded rows whose position
+    # overruns the table — they are rerouted to block 0 anyway.
+    blk_idx = jnp.clip(positions // bs, 0, nb - 1)
+    write_blocks = jnp.where(real, block_tables[0][blk_idx], 0)
+    write_offs = positions % bs
+
+    L = cfg.num_hidden_layers
+    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
+    segments = []
+    if k_dense:
+        segments.append(("dense_layers", 0, k_dense, False))
+    segments.append(("layers", k_dense, L - k_dense, cfg.is_moe))
+
+    for name, offset, count, is_moe_seg in segments:
+        tree = compute[name]
+
+        def body(carry, xs):
+            hidden, = carry
+            lp, k_p, v_p, win, loc = xs
+            cos = jnp.where(loc, cos_l, cos_g)
+            sin = jnp.where(loc, sin_l, sin_g)
+            in_window = jnp.where(win > 0, qpos - kpos < win, True)
+            mask = valid_base & in_window
+            hidden, k_p, v_p = _paged_prefill_layer(
+                hidden, lp, cfg, cos, sin, k_p, v_p, block_tables,
+                write_blocks, write_offs, mask, is_moe_seg,
+            )
+            return (hidden,), (k_p, v_p)
+
+        sl = slice(offset, offset + count)
+        (hidden,), (k_seg, v_seg) = jax.lax.scan(
+            body, (hidden,),
+            (tree, k_all[sl], v_all[sl], windows[sl], local_flags[sl]),
+        )
+        k_all = k_all.at[sl].set(k_seg)
+        v_all = v_all.at[sl].set(v_seg)
+    return hidden, (k_all, v_all)
+
+
+def paged_prefill_step(params, cfg: TransformerConfig, pools, block_table,
+                       start_pos, tokens, chunk_len, chunk_bucket: int):
+    """Prefill one chunk of ONE sequence against the paged block pool.
+
+    tokens [CB] int32 (the chunk's token ids, zero-padded past
+    ``chunk_len``); block_table [nb] int32 covering the sequence's whole
+    allocation (null-padded); ``start_pos``/``chunk_len`` are traced,
+    ``chunk_bucket`` (== CB) is the static compile bucket. Writes the
+    chunk's KV rows at absolute positions [start_pos, start_pos+chunk_len)
+    and attends each row over the full prefix — cached blocks included —
+    via the block table. Returns (logits of the last real chunk row
+    [1,V] f32, pools); intermediate chunks ignore the logits, the final
+    chunk's sample the first generated token."""
+    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    positions = start_pos + jnp.arange(chunk_bucket, dtype=jnp.int32)
+    cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions[None])
+    hidden = compute["embed_tokens"][tokens[None]]
+    if cfg.embed_scale:
+        hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    hidden, pools = _paged_prefill_walk(
+        compute, cfg, hidden, pools, block_table[None], positions,
+        chunk_len, cos_g, sin_g, cos_l, sin_l,
+    )
+    last = jax.lax.dynamic_slice_in_dim(hidden, chunk_len - 1, 1, axis=1)
+    logits = _logits(params, compute, cfg, last)
+    return logits[:, 0].astype(jnp.float32), pools
+
+
+def copy_block(pools, src, dst):
+    """Copy-on-write: duplicate one pool block's rows (all layers) from
+    ``src`` to ``dst`` so a sequence can overwrite its divergence row
+    without corrupting the shared cached block. The engine jits this with
+    the pools donated; src/dst are traced scalars — one compile total."""
+    k_pool, v_pool = pools
+    return (
+        k_pool.at[:, dst].set(k_pool[:, src]),
+        v_pool.at[:, dst].set(v_pool[:, src]),
+    )
 
 
 def paged_decode_step(params, cfg: TransformerConfig, pools, block_tables,
@@ -496,7 +622,8 @@ _JIT_CACHE_MAX = 8
 # trace-time counters (python side effects run once per compile, never on
 # cache hits): tests assert the bucket scheme keeps these flat across
 # distinct prompt lengths (each retrace on TPU costs 20-40s)
-TRACE_COUNTS = {"prefill": 0, "decode": 0, "paged_decode": 0}
+TRACE_COUNTS = {"prefill": 0, "decode": 0, "paged_decode": 0,
+                "paged_prefill": 0}
 
 
 def _bucket_pow2(n: int, floor: int = 16) -> int:

@@ -61,6 +61,7 @@ class RequestTimeline:
     finish_reason: str = ""
     tokens: int = 0
     tpot_s: Optional[float] = None
+    cached_tokens: int = 0  # prompt positions served from the prefix cache
 
     def mark(self, stage: str, t: Optional[float] = None,
              **detail: Any) -> float:
@@ -106,6 +107,7 @@ class RequestTimeline:
             "queue_wait_s": queue_wait,
             "preemptions": self.preemptions,
             "tokens": self.tokens,
+            "cached_tokens": self.cached_tokens,
         }
         if self._wait_since is not None and now is not None:
             doc["waiting"] = True
@@ -165,11 +167,16 @@ class RequestTracer:
             tl._open_slot = (slot, t)
         _flight_record("serve.admitted", cid=request_id, slot=slot)
 
-    def on_prefill_done(self, request_id: str) -> None:
+    def on_prefill_done(self, request_id: str,
+                        cached_tokens: int = 0) -> None:
+        """``cached_tokens`` = prompt positions served from the prefix cache
+        on this admission (lands on the timeline mark AND the rollup doc, so
+        ``/debug/requests`` answers "did request X hit the cache?")."""
         with self._lock:
             tl = self._inflight.get(request_id)
             if tl is not None:
-                tl.mark("prefill_done")
+                tl.mark("prefill_done", cached_tokens=cached_tokens)
+                tl.cached_tokens = cached_tokens
 
     def on_first_token(self, request_id: str) -> None:
         with self._lock:

@@ -29,6 +29,7 @@ group_gemm = _group_gemm.group_gemm
 cache_attend = _paged_attention.cache_attend
 gather_block_kv = _paged_attention.gather_block_kv
 paged_attend = _paged_attention.paged_attend
+paged_prefill_attend = _paged_attention.paged_prefill_attend
 
 __all__ = [
     "KERNEL_REGISTRY",
@@ -46,4 +47,5 @@ __all__ = [
     "cache_attend",
     "gather_block_kv",
     "paged_attend",
+    "paged_prefill_attend",
 ]

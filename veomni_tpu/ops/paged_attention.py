@@ -110,3 +110,41 @@ def paged_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
         q, k_pool, v_pool, block_tables, valid_mask,
         num_rep=num_rep, scale=scale, sinks=sinks,
     )
+
+
+@KERNEL_REGISTRY.register("paged_prefill_attention", "xla_gather")
+def _paged_prefill_attend_xla(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    valid_mask,
+    *,
+    num_rep: int = 1,
+    scale: float,
+    sinks: Optional[jax.Array] = None,
+):
+    k_ctx, v_ctx = gather_block_kv(k_pool, v_pool, block_tables)
+    return cache_attend(
+        q, k_ctx, v_ctx, valid_mask, num_rep=num_rep, scale=scale, sinks=sinks
+    )
+
+
+def paged_prefill_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
+                         num_rep: int = 1, scale: float,
+                         sinks: Optional[jax.Array] = None):
+    """Chunked-prefill attention: a T-token chunk of ONE sequence attends
+    over its whole context (already-cached prefix blocks + the chunk's own
+    freshly written rows) through the block table.
+
+    q [1,T,hq,d] + pool [NB,BS,hkv,d] + block_tables [1,nb] -> [1,T,hq,d].
+    valid_mask [1,T,nb*BS] in gathered (== absolute) positions — the causal
+    mask caps each chunk row at its own absolute position, so the math is
+    identical to a monolithic prefill over the same context. Registered as
+    its own op (impl ``xla_gather``) so a fused Pallas prefill kernel can
+    later replace the gather without touching the decode op's pin."""
+    inner = resolve_op("paged_prefill_attention")
+    return inner(
+        q, k_pool, v_pool, block_tables, valid_mask,
+        num_rep=num_rep, scale=scale, sinks=sinks,
+    )

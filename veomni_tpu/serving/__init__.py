@@ -1,8 +1,10 @@
 """Continuous-batching inference engine with a paged KV cache.
 
 Layering: ``api`` (request/response dataclasses) -> ``kv_block_manager``
-(host block accounting) -> ``scheduler`` (admission/preemption policy) ->
-``engine`` (jitted prefill-into-blocks + batched paged decode). See
+(host block accounting: shared refcounted blocks) -> ``prefix_cache``
+(radix tree sharing prompt KV blocks across requests) -> ``scheduler``
+(admission/preemption policy, cache-aware) -> ``engine`` (jitted chunked
+prefill over cached prefixes + batched paged decode). See
 ``docs/serving.md`` for the architecture and the compile-count story.
 """
 
@@ -14,12 +16,14 @@ from veomni_tpu.serving.api import (
 )
 from veomni_tpu.serving.engine import EngineConfig, InferenceEngine
 from veomni_tpu.serving.kv_block_manager import KVBlockManager
+from veomni_tpu.serving.prefix_cache import PrefixCache
 from veomni_tpu.serving.scheduler import Scheduler, SequenceState
 
 __all__ = [
     "EngineConfig",
     "InferenceEngine",
     "KVBlockManager",
+    "PrefixCache",
     "Request",
     "RequestOutput",
     "SamplingParams",

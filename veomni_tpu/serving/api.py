@@ -61,3 +61,7 @@ class RequestOutput:
     queue_wait_s: Optional[float] = None
     tpot_s: Optional[float] = None
     preemptions: int = 0
+    # prompt positions served from the prefix cache on the LATEST admission
+    # (0 with the cache off or on a cold miss) — cached_tokens/len(prompt_ids)
+    # is this request's share of the engine's serve.prefix_hit_rate
+    cached_tokens: int = 0

@@ -2,31 +2,42 @@
 
 Execution model (one ``step()`` tick):
 
-1. **Admit + prefill**: free slots are filled FIFO from the waiting queue;
-   each admission runs the *existing* jitted prefill from
-   ``models/decode.py`` over the power-of-two prompt bucket, scatters the
-   resulting contiguous cache into this sequence's pool blocks
-   (``scatter_prompt_cache``), and samples the first token — so prefill of
-   new arrivals interleaves with decode of running ones.
-2. **Capacity**: every running sequence is grown to cover its next write
-   position; when blocks run out the scheduler preempts LIFO (recompute).
-3. **Batched decode**: one jitted ``paged_decode_step`` over the fixed slot
+1. **Admit**: free slots are filled FIFO from the waiting queue; admission
+   matches each prompt against the prefix cache (when enabled) and
+   allocates only the uncached suffix's blocks — shared prompt blocks are
+   referenced, not recomputed.
+2. **Prefill (chunked)**: every admitted-but-unfinished prefill advances by
+   ONE chunk per tick, so a long arriving prompt never blocks the running
+   requests' next token for more than a chunk's worth of work. The chunk
+   attends over the already-cached prefix through the sequence's block
+   table (``paged_prefill_step``); the final chunk's logits sample the
+   first token. With chunking off the whole uncached suffix is one chunk,
+   and with the cache off too the path is the original monolithic prefill
+   (``models/decode.py``'s jit + ``scatter_prompt_cache``) — byte-identical
+   to the pre-cache engine.
+3. **Capacity**: every decoding sequence is grown to cover its next write
+   position; when blocks run out, cached (refcount-0) blocks are evicted
+   LRU first, and only a truly dry pool preempts LIFO (recompute).
+4. **Batched decode**: one jitted ``paged_decode_step`` over the fixed slot
    batch — per-slot positions, block tables, PRNG keys and sampling params.
    The gathered-context width (``nbb * block_size``, ``nbb`` the
    power-of-two bucket of the widest running block table) is the only shape
    that varies, so the compile count is bounded by the bucket count — never
    by request count or arrival pattern (``TRACE_COUNTS["paged_decode"]``).
+   Chunked prefill adds one more bucketed program
+   (``TRACE_COUNTS["paged_prefill"]``) over (chunk bucket, table bucket).
 
 Shapes the XLA programs see: slot batch ``S`` (static per engine), prompt
-buckets (power-of-two), context buckets (power-of-two blocks). Everything
-else — arrivals, lengths, finishes, preemptions — is host bookkeeping.
+and chunk buckets (power-of-two), context buckets (power-of-two blocks).
+Everything else — arrivals, lengths, finishes, preemptions, cache hits —
+is host bookkeeping.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Optional, Union
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +56,7 @@ from veomni_tpu.serving.api import (
     StreamEvent,
 )
 from veomni_tpu.serving.kv_block_manager import KVBlockManager
+from veomni_tpu.serving.prefix_cache import PrefixCache
 from veomni_tpu.serving.scheduler import Scheduler, SequenceState
 from veomni_tpu.utils.helper import host_floats
 from veomni_tpu.utils.logging import get_logger
@@ -61,10 +73,20 @@ class EngineConfig:
     max_model_len: int = 2048  # prompt + generated ceiling per request
     num_blocks: int = 0  # 0 -> 1 + num_slots * blocks(max_model_len)
     log_every_steps: int = 0  # 0 disables periodic metric logging
+    # share full prompt blocks across requests (radix prefix cache over the
+    # block pool; refcounted, LRU-evicted under pressure). OFF restores the
+    # pre-cache engine exactly: exclusive blocks, monolithic prefill.
+    prefix_cache: bool = True
+    # prefill at most this many tokens per step() tick (0 = the whole
+    # uncached suffix in one go). Bounds how long a newly arrived long
+    # prompt can stall every running request's next token.
+    prefill_chunk: int = 0
 
     def __post_init__(self):
         if self.block_size < 1 or (self.block_size & (self.block_size - 1)):
             raise ValueError("block_size must be a power of two")
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 disables)")
         if self.num_blocks <= 0:
             per_seq = -(-self.max_model_len // self.block_size)
             self.num_blocks = 1 + self.num_slots * per_seq
@@ -74,8 +96,9 @@ class InferenceEngine:
     """Continuous-batching generation over a fixed slot batch.
 
     ``submit()`` enqueues, ``step()`` advances every in-flight request by
-    one token, ``generate()`` streams events, ``run()`` drains to
-    completion. Single-threaded by design: callers own the pump loop."""
+    one token (and every in-flight prefill by one chunk), ``generate()``
+    streams events, ``run()`` drains to completion. Single-threaded by
+    design: callers own the pump loop."""
 
     def __init__(self, params, cfg: TransformerConfig,
                  config: Optional[EngineConfig] = None):
@@ -95,13 +118,17 @@ class InferenceEngine:
         self.k_pool = jnp.zeros(shape, cfg.dtype)
         self.v_pool = jnp.zeros(shape, cfg.dtype)
         self.blocks = KVBlockManager(ec.num_blocks, ec.block_size)
+        self.prefix_cache = (
+            PrefixCache(self.blocks) if ec.prefix_cache else None
+        )
         # per-request lifecycle tracing (request_trace.py): the scheduler
         # reports queued/admitted/preempted, the engine reports prefill/
         # first-token/finished — together they feed serve.queue_wait_s and
         # serve.tpot_s and the /debug/requests timelines
         self.tracer = RequestTracer(ec.num_slots)
         self.scheduler = Scheduler(ec.num_slots, self.blocks,
-                                   tracer=self.tracer)
+                                   tracer=self.tracer,
+                                   prefix_cache=self.prefix_cache)
 
         # prefill is the SAME jitted program greedy_generate uses (shared
         # prompt buckets, shared TRACE_COUNTS["prefill"])
@@ -111,16 +138,29 @@ class InferenceEngine:
         )
         self._sample = jax.jit(decode_mod.sample_tokens)
         self._decode_step = self._build_decode_step()
+        self._prefill_chunk_step = self._build_prefill_chunk_step()
+        # copy-on-write block duplication: src/dst are traced scalars, so
+        # this compiles exactly once per engine
+        self._cow = jax.jit(
+            lambda k, v, src, dst: decode_mod.copy_block((k, v), src, dst),
+            donate_argnums=(0, 1),
+        )
 
         self._outputs: Dict[str, RequestOutput] = {}
         self._req_counter = 0
         self._step_counter = 0
-        # metrics: TTFT accumulators + a decode-throughput window
+        # metrics: TTFT accumulators (lifetime + window) + a
+        # decode-throughput window + prefix-cache totals
         self._ttft_sum = 0.0
         self._ttft_n = 0
+        self._win_ttft_sum = 0.0
+        self._win_ttft_n = 0
         self._total_generated = 0
         self._window_tokens = 0
         self._window_t0 = time.perf_counter()
+        self._prompt_tokens_total = 0
+        self._cached_tokens_total = 0
+        self._prefill_chunks_total = 0
         # observability registry: same surface the trainer exports through,
         # so one /metrics endpoint covers both (docs/observability.md)
         reg = get_registry()
@@ -132,6 +172,9 @@ class InferenceEngine:
         self._m_kv = reg.gauge("serve.kv_utilization")
         self._m_preempt = reg.gauge("serve.preemptions")
         self._m_tps = reg.gauge("serve.decode_tokens_per_sec")
+        self._m_hit_rate = reg.gauge("serve.prefix_hit_rate")
+        self._m_cached_tokens = reg.counter("serve.cached_tokens")
+        self._m_chunks = reg.counter("serve.prefill_chunks")
 
     # ------------------------------------------------------------ jit plumbing
     def _build_decode_step(self):
@@ -151,6 +194,19 @@ class InferenceEngine:
             return nxt, split[:, 0], k_pool, v_pool
 
         return jax.jit(impl, donate_argnums=(1, 2))
+
+    def _build_prefill_chunk_step(self):
+        cfg = self.cfg
+
+        def impl(params, k_pool, v_pool, table, start, tokens, chunk_len,
+                 chunk_bucket):
+            decode_mod.TRACE_COUNTS["paged_prefill"] += 1  # trace-time only
+            return decode_mod.paged_prefill_step(
+                params, cfg, (k_pool, v_pool), table, start, tokens,
+                chunk_len, chunk_bucket,
+            )
+
+        return jax.jit(impl, static_argnums=(7,), donate_argnums=(1, 2))
 
     # ----------------------------------------------------------------- intake
     def submit(self, request: Union[Request, Iterable[int]],
@@ -203,17 +259,26 @@ class InferenceEngine:
         return self.scheduler.has_work
 
     def step(self) -> List[StreamEvent]:
-        """One engine tick: admit+prefill, secure blocks, batched decode.
-        Returns every token event produced this tick."""
+        """One engine tick: admit, advance every in-flight prefill by one
+        chunk, secure blocks, batched decode. Returns every token event
+        produced this tick."""
         events: List[StreamEvent] = []
         for seq in self.scheduler.admit():
+            self._start_prefill(seq)
+        # one chunk per prefilling sequence per tick: decode of running
+        # requests interleaves between chunks, so a long prompt's TTFT cost
+        # to everyone else is bounded by a chunk, not by the prompt
+        prefilling = [s for _, s in self.scheduler.running() if s.prefilling]
+        for seq in prefilling:
             with span("serve.prefill"):
-                events.extend(self._prefill_seq(seq))
+                events.extend(self._prefill_tick(seq))
         self.scheduler.ensure_decode_capacity()
-        if self.scheduler.num_running:
+        decodable = [(i, s) for i, s in self.scheduler.running()
+                     if not s.prefilling]
+        if decodable:
             with span("serve.decode"):
-                events.extend(self._decode_tick())
-        elif not events and self.scheduler.has_work:
+                events.extend(self._decode_tick(decodable))
+        elif not events and not prefilling and self.scheduler.has_work:
             raise RuntimeError(
                 "scheduler stalled: waiting requests but nothing running "
                 "and nothing admissible (pool misconfigured?)"
@@ -267,7 +332,41 @@ class InferenceEngine:
         return self._outputs.pop(request_id, None)
 
     # --------------------------------------------------------------- internals
-    def _prefill_seq(self, seq: SequenceState) -> List[StreamEvent]:
+    def _start_prefill(self, seq: SequenceState) -> None:
+        """Per-admission bookkeeping: prefix-cache accounting and the
+        copy-on-write device copy for a fully-cached prompt's divergence
+        block (the copy MUST land before any chunk writes into it)."""
+        p = len(seq.recompute_prompt)
+        self._prompt_tokens_total += p
+        if seq.cached_tokens:
+            self._cached_tokens_total += seq.cached_tokens
+            self._m_cached_tokens.inc(seq.cached_tokens)
+        self._m_hit_rate.set(
+            self._cached_tokens_total / max(1, self._prompt_tokens_total)
+        )
+        out = self._outputs.get(seq.seq_id)
+        if out is not None:
+            out.cached_tokens = seq.cached_tokens
+        if seq.cow_src is not None:
+            dst = self.blocks.table(seq.seq_id)[-1]
+            self.k_pool, self.v_pool = self._cow(
+                self.k_pool, self.v_pool,
+                jnp.int32(seq.cow_src), jnp.int32(dst),
+            )
+            # the source was pinned at admission so claiming fresh blocks
+            # could not evict it before this copy; release it now
+            self.blocks.release_block(seq.cow_src)
+            seq.cow_src = None
+
+    def _prefill_tick(self, seq: SequenceState) -> List[StreamEvent]:
+        """Advance one sequence's prefill by one chunk. The legacy
+        monolithic path (cache miss + chunking off) is kept verbatim so a
+        cache-off engine is byte-identical to the pre-cache one."""
+        if seq.cached_tokens == 0 and self.config.prefill_chunk <= 0:
+            return self._prefill_monolithic(seq)
+        return self._prefill_chunk(seq)
+
+    def _prefill_monolithic(self, seq: SequenceState) -> List[StreamEvent]:
         bs = self.config.block_size
         prompt = seq.recompute_prompt
         pt = len(prompt)
@@ -286,6 +385,41 @@ class InferenceEngine:
             (self.k_pool, self.v_pool), caches,
             jnp.asarray(ids, jnp.int32),
         )
+        self._prefill_chunks_total += 1
+        self._m_chunks.inc()
+        return self._finish_prefill(seq, logits)
+
+    def _prefill_chunk(self, seq: SequenceState) -> List[StreamEvent]:
+        bs = self.config.block_size
+        prompt = seq.recompute_prompt
+        p = len(prompt)
+        start = seq.prefill_pos
+        budget = self.config.prefill_chunk or (p - start)
+        clen = min(budget, p - start)
+        cb = decode_mod._bucket_pow2(clen, floor=max(16, bs))
+        tokens = jnp.zeros((cb,), jnp.int32).at[:clen].set(
+            jnp.asarray(prompt[start:start + clen], jnp.int32)
+        )
+        ids = self.blocks.table(seq.seq_id)
+        nbb = decode_mod._bucket_pow2(len(ids), floor=1)
+        table = np.zeros(nbb, np.int32)  # null-block padded
+        table[: len(ids)] = ids
+        logits, (self.k_pool, self.v_pool) = self._prefill_chunk_step(
+            self.params, self.k_pool, self.v_pool, jnp.asarray(table),
+            jnp.int32(start), tokens, jnp.int32(clen), cb,
+        )
+        seq.prefill_pos = start + clen
+        self._prefill_chunks_total += 1
+        self._m_chunks.inc()
+        if seq.prefill_pos < p:
+            return []  # more chunks next tick; decode interleaves meanwhile
+        return self._finish_prefill(seq, logits)
+
+    def _finish_prefill(self, seq: SequenceState,
+                        logits) -> List[StreamEvent]:
+        """Shared prefill tail: sample the first token from the last prompt
+        row's logits, publish the full prompt blocks to the prefix cache,
+        and flip the sequence into the decode batch."""
         sp = seq.request.sampling
         rng, sub = jax.random.split(seq.rng)
         seq.rng = np.asarray(rng)
@@ -295,23 +429,33 @@ class InferenceEngine:
             jnp.full((1,), sp.top_k, jnp.int32),
             jnp.full((1,), sp.top_p, jnp.float32),
         )[0])
-        self.tracer.on_prefill_done(seq.seq_id)
+        pt = len(seq.recompute_prompt)
+        seq.prefill_len = pt
+        seq.pos = pt  # the pending token's write position
+        seq.prefill_pos = pt
+        seq.prefilling = False
+        # prompt blocks become shareable the moment they hold real KV: a
+        # staggered arrival with the same system prompt hits immediately
+        self.scheduler.cache_insert(seq)
+        self.tracer.on_prefill_done(seq.seq_id,
+                                    cached_tokens=seq.cached_tokens)
         if seq.first_token_time is None:
             seq.first_token_time = time.perf_counter()
             ttft = seq.first_token_time - seq.submit_time
             self._outputs[seq.seq_id].ttft_s = ttft
             self._ttft_sum += ttft
             self._ttft_n += 1
+            self._win_ttft_sum += ttft
+            self._win_ttft_n += 1
             self._m_ttft.observe(ttft)
             self.tracer.on_first_token(seq.seq_id)
-        seq.prefill_len = pt
-        seq.pos = pt  # the pending token's write position
         return [self._emit(seq, first)]
 
-    def _decode_tick(self) -> List[StreamEvent]:
+    def _decode_tick(
+        self, running: List[Tuple[int, SequenceState]]
+    ) -> List[StreamEvent]:
         ec = self.config
         bs = ec.block_size
-        running = self.scheduler.running()
         # power-of-two bucket of the widest block table: the decode step's
         # only varying shape, so compile count is O(log2 blocks-per-seq)
         nbb = decode_mod._bucket_pow2(
@@ -390,9 +534,10 @@ class InferenceEngine:
     # ---------------------------------------------------------------- metrics
     def metrics(self, reset_window: bool = True) -> Dict[str, float]:
         """Host-float engine metrics; feed them straight into any
-        logger/meter sink. ``decode_tokens_per_sec`` is measured over the
-        window since the last resetting call (pass ``reset_window=False``
-        for a peek that leaves another consumer's window intact)."""
+        logger/meter sink. ``decode_tokens_per_sec`` and ``ttft_avg_s`` are
+        measured over the window since the last resetting call (pass
+        ``reset_window=False`` for a peek that leaves another consumer's
+        window intact); ``ttft_avg_lifetime_s`` never resets."""
         now = time.perf_counter()
         dt = max(now - self._window_t0, 1e-9)
         m = {
@@ -402,13 +547,23 @@ class InferenceEngine:
             "preemptions": float(self.scheduler.preemption_count),
             "generated_tokens": float(self._total_generated),
             "decode_tokens_per_sec": self._window_tokens / dt,
+            "prefix_hit_rate": (
+                self._cached_tokens_total / max(1, self._prompt_tokens_total)
+            ),
+            "cached_tokens": float(self._cached_tokens_total),
+            "prompt_tokens": float(self._prompt_tokens_total),
+            "prefill_chunks": float(self._prefill_chunks_total),
         }
+        if self._win_ttft_n:
+            m["ttft_avg_s"] = self._win_ttft_sum / self._win_ttft_n
         if self._ttft_n:
-            m["ttft_avg_s"] = self._ttft_sum / self._ttft_n
+            m["ttft_avg_lifetime_s"] = self._ttft_sum / self._ttft_n
         if reset_window:
             # the resetting caller owns the throughput window; mirror its
             # reading to the exporter gauge
             self._m_tps.set(m["decode_tokens_per_sec"])
             self._window_tokens = 0
             self._window_t0 = now
+            self._win_ttft_sum = 0.0
+            self._win_ttft_n = 0
         return host_floats(m)

@@ -7,12 +7,24 @@ Policy (vLLM-style, recompute preemption):
   can hold their (re)compute prompt plus one block of headroom. The head is
   never skipped — out-of-order admission would make greedy outputs depend
   on pool pressure, which would break token-parity guarantees.
+- **Prefix-cache-aware admission**: with a
+  :class:`~veomni_tpu.serving.prefix_cache.PrefixCache` attached, admission
+  matches the recompute prompt against the radix tree first and charges
+  only the **uncached suffix** — matched full blocks are shared by
+  reference. A prompt whose every full block is cached would have nothing
+  left to run (the engine still needs the last token's logits), so its
+  divergence block is taken **copy-on-write**: the last matched block is
+  pinned as a copy source, a fresh replacement is allocated, and only the
+  final token is recomputed.
 - **LIFO recompute preemption**: when a running sequence needs a block and
-  the pool is dry, the most recently admitted running sequence is evicted —
-  its blocks are freed and it is requeued at the FRONT of the waiting queue
-  with ``prompt + generated-so-far`` as its recompute prompt. Greedy
-  decoding is deterministic, so recompute resumes the exact token stream;
-  already-emitted tokens are never re-emitted.
+  the pool is dry (free list AND evictable cached blocks — eviction always
+  reclaims cached blocks before a preemption fires), the most recently
+  admitted running sequence is evicted. Its full blocks are **inserted into
+  the prefix cache** before its references drop, so re-admission is a
+  near-free cache hit instead of a full re-prefill; it is requeued at the
+  FRONT of the waiting queue with ``prompt + generated-so-far`` as its
+  recompute prompt. Greedy decoding is deterministic, so recompute resumes
+  the exact token stream; already-emitted tokens are never re-emitted.
 
 The scheduler is pure host bookkeeping — it owns no device state and is
 unit-testable without building a model. When a
@@ -47,6 +59,11 @@ class SequenceState:
     preemptions: int = 0
     submit_time: float = field(default_factory=time.perf_counter)
     first_token_time: Optional[float] = None
+    # chunked-prefill / prefix-cache state for the CURRENT admission
+    prefilling: bool = False  # admitted, prefill not finished (chunks left)
+    prefill_pos: int = 0  # next uncomputed position (rows [0, here) valid)
+    cached_tokens: int = 0  # positions served from the prefix cache
+    cow_src: Optional[int] = None  # pinned copy-on-write source block
 
     @property
     def seq_id(self) -> str:
@@ -62,13 +79,21 @@ class SequenceState:
     def last_token(self) -> int:
         return self.generated[-1]
 
+    @property
+    def kv_valid_len(self) -> int:
+        """Cache rows [0, here) hold real KV: up to the write position when
+        decoding, up to the last completed chunk while mid-prefill."""
+        return self.prefill_pos if self.prefilling else self.pos
+
 
 class Scheduler:
     def __init__(self, num_slots: int, block_manager: KVBlockManager,
-                 tracer: Optional[Any] = None):
+                 tracer: Optional[Any] = None,
+                 prefix_cache: Optional[Any] = None):
         if num_slots < 1:
             raise ValueError("need at least one decode slot")
         self.blocks = block_manager
+        self.cache = prefix_cache
         self.waiting: Deque[SequenceState] = deque()
         self.slots: List[Optional[SequenceState]] = [None] * num_slots
         self.preemption_count = 0
@@ -102,23 +127,52 @@ class Scheduler:
 
     def admit(self) -> List[SequenceState]:
         """Fill free slots from the waiting queue (FIFO, head-of-line).
-        Admission allocates the recompute prompt's blocks and requires one
-        extra free block of headroom so a fresh admission isn't preempted on
-        its very first decode step just to grow someone else."""
+        Admission matches the recompute prompt against the prefix cache,
+        shares the matched blocks, and allocates only the uncached suffix —
+        plus one extra free block of headroom so a fresh admission isn't
+        preempted on its very first decode step just to grow someone else."""
         admitted = []
         for slot in range(len(self.slots)):
             if self.slots[slot] is not None or not self.waiting:
                 continue
             head = self.waiting[0]
-            n_blocks = self.blocks.blocks_for(len(head.recompute_prompt))
+            prompt = head.recompute_prompt
+            p = len(prompt)
+            n_total = self.blocks.blocks_for(p)
+            shared: List[int] = []
+            cow_src: Optional[int] = None
+            if self.cache is not None:
+                shared = self.cache.match(prompt)
+                if len(shared) * self.blocks.block_size >= p:
+                    # every full block is cached, but the engine still needs
+                    # the LAST token's logits to sample the first generated
+                    # token: recompute only that token, copy-on-write its
+                    # (shared, otherwise-corrupted) divergence block
+                    cow_src = shared[-1]
+                    shared = shared[:-1]
+            n_new = n_total - len(shared)
             # no headroom demanded when the engine is idle: an exact-fit
             # request must admit (it can still grow — the engine validates
             # blocks_for(prompt+max_new) <= pool size at submit)
             headroom = 1 if self.num_running else 0
-            if not self.blocks.can_allocate(n_blocks + headroom):
+            # matched blocks currently sitting in the evictable set leave it
+            # the moment allocate_shared references them, so they must not
+            # double-count as claimable headroom
+            pinned = [b for b in shared if self.blocks.refcount(b) == 0]
+            if cow_src is not None and self.blocks.refcount(cow_src) == 0:
+                pinned.append(cow_src)
+            if self.blocks.num_free - len(pinned) < n_new + headroom:
                 break  # head-of-line: never admit around the queue head
             self.waiting.popleft()
-            self.blocks.allocate(head.seq_id, n_blocks)
+            self.blocks.allocate_shared(head.seq_id, shared, n_new,
+                                        cow_src=cow_src)
+            head.cow_src = cow_src
+            head.cached_tokens = (
+                p - 1 if cow_src is not None
+                else len(shared) * self.blocks.block_size
+            )
+            head.prefill_pos = head.cached_tokens
+            head.prefilling = True
             head.slot = slot
             head.admit_order = self._admit_counter
             self._admit_counter += 1
@@ -129,12 +183,15 @@ class Scheduler:
         return admitted
 
     def ensure_decode_capacity(self) -> List[SequenceState]:
-        """Grow each running sequence to cover its next write position,
-        preempting (LIFO) when the pool runs dry. Returns the preempted
-        sequences (already requeued at the front of the waiting queue)."""
+        """Grow each decoding sequence to cover its next write position,
+        preempting (LIFO) when the pool — free list plus evictable cached
+        blocks — runs dry. Mid-prefill sequences already hold their whole
+        prompt allocation and are skipped for growth (but stay preemptable).
+        Returns the preempted sequences (already requeued at the front of
+        the waiting queue)."""
         preempted: List[SequenceState] = []
         for _, seq in self.running():
-            if seq.slot < 0:  # already preempted within this pass
+            if seq.slot < 0 or seq.prefilling:  # preempted / still prefilling
                 continue
             need = seq.pos // self.blocks.block_size + 1
             while self.blocks.num_allocated(seq.seq_id) < need:
@@ -150,18 +207,46 @@ class Scheduler:
                     break
         return preempted
 
-    def _preempt(self, seq: SequenceState) -> None:
+    def cache_insert(self, seq: SequenceState) -> int:
+        """Register the sequence's full KV blocks in the prefix cache, keyed
+        on the tokens they hold. Called at prefill completion (prompt blocks
+        become shareable immediately) and before releasing blocks on
+        preemption/finish (generated-token blocks stay warm)."""
+        if self.cache is None or self.blocks.num_allocated(seq.seq_id) == 0:
+            return 0
+        n_full = seq.kv_valid_len // self.blocks.block_size
+        if n_full <= 0:
+            return 0
+        tokens = seq.recompute_prompt
+        table = self.blocks.table(seq.seq_id)
+        return self.cache.insert(tokens[: n_full * self.blocks.block_size],
+                                 table[:n_full])
+
+    def _release(self, seq: SequenceState) -> None:
+        """Drop the sequence's block references, caching its full blocks
+        first so they stay warm for re-admission or other requests."""
+        self.cache_insert(seq)
         self.blocks.free_seq(seq.seq_id)
+
+    def _preempt(self, seq: SequenceState) -> None:
+        self._release(seq)
         self.slots[seq.slot] = None
         seq.slot = -1
         seq.preemptions += 1
         self.preemption_count += 1
+        # reset per-admission prefill state: the next admit() re-matches the
+        # (longer) recompute prompt against the cache from scratch
+        seq.prefilling = False
+        seq.prefill_pos = 0
+        seq.cached_tokens = 0
+        seq.cow_src = None
+        seq.pos = 0
         self.waiting.appendleft(seq)
         if self.tracer is not None:
             self.tracer.on_preempted(seq.seq_id)
 
     def finish(self, seq: SequenceState) -> None:
-        self.blocks.free_seq(seq.seq_id)
+        self._release(seq)
         if seq.slot >= 0:
             self.slots[seq.slot] = None
         seq.slot = -1
