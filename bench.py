@@ -151,6 +151,66 @@ def _wait_for_backend(retry_s: float = 120.0):
 # restore traffic in every later record)
 _INTEGRITY_SNAP = {"verify_s": 0.0, "quarantined": 0, "fallbacks": 0}
 
+# previous per-bucket train-step compile times, same delta discipline (a
+# sweep re-compiles the same shape bucket per config; the cumulative census
+# figure would misattribute earlier configs' compiles to this record)
+_CENSUS_SNAP = {}
+
+# drift gate between the analytic FlopsCounter (the MFU denominator) and
+# what XLA actually compiled: outside this band the offline MFU number is
+# suspect (count_flops.py rotted behind a model change, or XLA compiled
+# something structurally different from what the formula assumes). The band
+# is sized to catch layer/vocab/doubling-class rot, not to demand equality:
+# healthy ratios sit ~0.65-1.0 because the XLA census counts work the
+# analytic convention deliberately omits — full masked causal scores (the
+# formula credits seq/2), softmax/CE/norm elementwise, tied-embedding
+# backward scatters.
+FLOPS_RATIO_BAND = (0.6, 1.4)
+
+
+def census_bench_fields(analytic_flops_per_step: float,
+                        census=None, warn=True) -> dict:
+    """Per-bucket XLA cost-census readout for the train-step site.
+
+    ``compile_time_s`` is the per-bucket DELTA since the previous
+    ``run_bench`` (sweep-proof); ``xla_flops_per_step`` is the latest
+    train-step program's whole-mesh FLOPs (census FLOPs are per device);
+    ``analytic_vs_xla_flops_ratio`` is the sanity field — a warning fires
+    outside ``FLOPS_RATIO_BAND`` so the MFU denominator can no longer
+    silently rot as models change. Never raises: a census-blind run (env
+    kill switch, analysis-less backend) reports zeros."""
+    out = {"compile_time_s": {}, "xla_flops_per_step": 0.0,
+           "analytic_vs_xla_flops_ratio": 0.0}
+    try:
+        if census is None:
+            from veomni_tpu.observability.cost import get_cost_census
+
+            census = get_cost_census()
+        for rec in census.programs("train_step"):
+            prev = _CENSUS_SNAP.get(rec.bucket, 0.0)
+            delta = rec.compile_time_s - prev
+            _CENSUS_SNAP[rec.bucket] = rec.compile_time_s
+            if delta > 0:
+                out["compile_time_s"][rec.bucket] = round(delta, 4)
+        rec = census.latest("train_step")
+        if rec is not None and rec.flops:
+            out["xla_flops_per_step"] = rec.flops * rec.num_devices
+            ratio = analytic_flops_per_step / out["xla_flops_per_step"]
+            out["analytic_vs_xla_flops_ratio"] = round(ratio, 4)
+            lo, hi = FLOPS_RATIO_BAND
+            if warn and not (lo <= ratio <= hi):
+                print(
+                    f"# WARNING: analytic FlopsCounter is {ratio:.3f}x the "
+                    f"XLA cost census (band {lo}-{hi}): the reported MFU's "
+                    "denominator disagrees with what XLA compiled — "
+                    "utils/count_flops.py may have rotted behind a model "
+                    "change", file=sys.stderr, flush=True,
+                )
+    except Exception as e:
+        print(f"# cost census unavailable for bench record: {e}",
+              file=sys.stderr, flush=True)
+    return out
+
 
 def _integrity_delta() -> dict:
     from veomni_tpu.observability.metrics import get_registry
@@ -283,10 +343,15 @@ def run_bench(
 
         tokens = micro_bs * seq_len * steps
         tok_per_sec_chip = tokens / dt / n_chips
-        flops = FlopsCounter.from_config(cfg).batch_flops(
+        analytic_per_step = FlopsCounter.from_config(cfg).batch_flops(
             micro_bs * seq_len, seq_len
-        ) * steps
+        )
+        flops = analytic_per_step * steps
         mfu = 100.0 * flops / dt / (get_device_peak_flops() * n_chips)
+        # XLA cost-census cross-check (observability/cost.py): per-bucket
+        # compile time + compiled-program FLOPs, and the drift gate between
+        # the analytic formula above and what XLA actually built
+        census = census_bench_fields(analytic_per_step)
 
         # free state before the caller builds the next config
         del batch
@@ -302,7 +367,11 @@ def run_bench(
                 "recompiles": recompiles,
                 "restore_verify_s": restore_verify_s,
                 "ckpt_quarantined": ckpt_quarantined,
-                "ckpt_fallbacks": ckpt_fallbacks}
+                "ckpt_fallbacks": ckpt_fallbacks,
+                "compile_time_s": census["compile_time_s"],
+                "xla_flops_per_step": census["xla_flops_per_step"],
+                "analytic_vs_xla_flops_ratio":
+                    census["analytic_vs_xla_flops_ratio"]}
 
 
 def run_serve_bench(
@@ -589,6 +658,12 @@ def main():
         "restore_verify_s": round(r["restore_verify_s"], 4),
         "ckpt_quarantined": r["ckpt_quarantined"],
         "ckpt_fallbacks": r["ckpt_fallbacks"],
+        # device cost census (docs/observability.md "Device cost &
+        # capacity"): what XLA compiled, how long it took, and whether the
+        # analytic MFU denominator still agrees with it (FLOPS_RATIO_BAND)
+        "compile_time_s": r["compile_time_s"],
+        "xla_flops_per_step": r["xla_flops_per_step"],
+        "analytic_vs_xla_flops_ratio": r["analytic_vs_xla_flops_ratio"],
     }), flush=True)
 
 

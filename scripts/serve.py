@@ -122,7 +122,10 @@ def main():
         "healthy": True,
         "queue_depth": engine.scheduler.queue_depth,
         "num_running": engine.scheduler.num_running,
-    }, requests_fn=engine.tracer.snapshot)
+    }, requests_fn=engine.tracer.snapshot,
+        # /debug/memory gains the KV pool capacity document (pool bytes +
+        # estimated max-concurrent sequences) next to the buffer census
+        memory_fn=engine.kv_capacity)
 
     sampling = SamplingParams(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
@@ -151,8 +154,16 @@ def main():
         # leaves its request/event history in a post-mortem, not in the void
         from veomni_tpu.observability.flight_recorder import dump_postmortem
 
-        dump_postmortem(f"exception:{type(e).__name__}",
-                        extra={"error": str(e)[:2000]})
+        extra = {"error": str(e)[:2000]}
+        try:
+            # a pool/allocator blowup gets the buffer + cost censuses:
+            # what held HBM and which program asked for more
+            from veomni_tpu.observability.devmem import attach_oom_extra
+
+            attach_oom_extra(e, extra)
+        except Exception as forensic_err:  # even the import must be safe
+            extra["oom_report_error"] = str(forensic_err)
+        dump_postmortem(f"exception:{type(e).__name__}", extra=extra)
         raise
     print(json.dumps({"metrics": engine.metrics()}), flush=True)
     if exporter is not None:

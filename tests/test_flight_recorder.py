@@ -521,10 +521,13 @@ def test_every_emitted_metric_family_is_documented():
     from docs/observability.md fails CI — new metrics can't ship
     undocumented."""
     tokens = _emitted_metric_tokens()
-    # sanity: the scan actually sees the load-bearing families
+    # sanity: the scan actually sees the load-bearing families — including
+    # the cost/devmem observatory modules' registry call sites (PR 10)
     for expected in ("serve.queue_wait_s", "serve.tpot_s", "span.dropped",
                      "integrity.ckpt_quarantined", "resilience.anomalies",
-                     "retry.attempts", "recompiles", "span.", "train."):
+                     "retry.attempts", "recompiles", "span.", "train.",
+                     "cost.", "cost.programs", "cost.compile_s", "mem.",
+                     "serve.kv_pool_bytes", "serve.kv_max_concurrent_seqs"):
         assert expected in tokens, f"scanner lost {expected!r}"
     doc = open(os.path.join(_REPO, "docs", "observability.md")).read()
     missing = sorted(t for t in tokens if t not in doc)

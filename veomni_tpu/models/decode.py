@@ -660,6 +660,19 @@ def _jitted(cfg: TransformerConfig):
             ),
             static_argnums=(5, 6, 7, 8),
         )
+        # cost census (observability/cost.py): per-bucket XLA FLOPs/bytes +
+        # compile wall-time for every prefill/decode specialization —
+        # identity under VEOMNI_COST_CENSUS=0
+        from veomni_tpu.observability.cost import instrument_jit
+
+        prefill = instrument_jit(
+            "prefill", prefill, static_argnums=(3, 4),
+            bucket_fn=lambda a: f"pb{a[3]}_ml{a[4]}",
+        )
+        decode = instrument_jit(
+            "decode", decode, static_argnums=(5, 6, 7, 8),
+            bucket_fn=lambda a: f"b{a[2].shape[0]}_n{a[5]}",
+        )
         while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
             _JIT_CACHE.pop(next(iter(_JIT_CACHE)))
         _JIT_CACHE[key] = (prefill, decode)

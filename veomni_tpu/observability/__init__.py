@@ -30,12 +30,35 @@ full profiler:
 * ``request_trace`` — per-request lifecycle timelines through the serving
                  engine (queue-wait / TPOT histograms, per-slot chrome
                  trace).
+* ``cost``     — compiled-program cost census: every instrumented jit site
+                 records XLA ``cost_analysis``/``memory_analysis`` + compile
+                 wall-time per bucket, feeding continuous per-window MFU /
+                 bandwidth-utilization gauges and ``/debug/cost``.
+* ``devmem``   — live HBM accounting: ``jax.live_arrays()`` buffer census,
+                 high-watermark tracking with a CPU fallback, KV-pool
+                 capacity stats, and the OOM post-mortem payload
+                 (``/debug/memory``).
 
 ``callback.ObservabilityCallback`` (imported lazily by the trainer — it
 depends on ``trainer.callbacks``) ties them together in the train loop.
 See ``docs/observability.md``.
 """
 
+from veomni_tpu.observability.cost import (
+    CostCensus,
+    CostWindow,
+    ProgramCost,
+    get_cost_census,
+    instrument_jit,
+)
+from veomni_tpu.observability.devmem import (
+    attach_oom_extra,
+    buffer_census,
+    is_resource_exhausted,
+    kv_capacity_stats,
+    oom_report,
+    publish_memory_gauges,
+)
 from veomni_tpu.observability.exporter import MetricsExporter, render_prometheus
 from veomni_tpu.observability.flight_recorder import (
     FlightRecorder,
@@ -67,9 +90,12 @@ from veomni_tpu.observability.spans import (
 )
 
 __all__ = [
+    "CostCensus",
+    "CostWindow",
     "Counter",
     "FlightRecorder",
     "Gauge",
+    "ProgramCost",
     "GoodputTracker",
     "Histogram",
     "MetricsExporter",
@@ -77,13 +103,21 @@ __all__ = [
     "RecompileDetector",
     "RequestTimeline",
     "RequestTracer",
+    "attach_oom_extra",
+    "buffer_census",
     "configure_flight_recorder",
     "disable_spans",
     "dump_chrome_trace",
     "dump_postmortem",
     "enable_spans",
+    "get_cost_census",
     "get_flight_recorder",
     "get_registry",
+    "instrument_jit",
+    "is_resource_exhausted",
+    "kv_capacity_stats",
+    "oom_report",
+    "publish_memory_gauges",
     "record",
     "render_prometheus",
     "set_registry",

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 
+from veomni_tpu.observability.cost import CostWindow
 from veomni_tpu.observability.exporter import MetricsExporter, resolve_port
 from veomni_tpu.observability.goodput import (
     GoodputTracker,
@@ -41,6 +42,7 @@ class ObservabilityCallback(Callback):
         self.tracker = None
         self.detector = None
         self.exporter = None
+        self.cost_window = None
         self._chrome_trace_path = ""
         self._armed = False
 
@@ -76,6 +78,11 @@ class ObservabilityCallback(Callback):
             )
             self.exporter.start()
         self.tracker.begin_window()
+        # compiled-program cost census window (observability/cost.py): the
+        # same sync cadence turns census FLOPs/bytes × step counts into the
+        # continuous train.mfu_pct / train.bandwidth_util_pct gauges
+        self.cost_window = CostWindow()
+        self.cost_window.begin()
         self._armed = False
 
     def on_step_end(self, trainer, state):
@@ -89,6 +96,7 @@ class ObservabilityCallback(Callback):
         if not state.synced:
             return
         state.metrics.update(self.tracker.end_window())
+        state.metrics.update(self.cost_window.end())
         state.metrics["recompiles"] = float(self.detector.total_recompiles)
         update_memory_gauges(self.registry)
         payload = host_floats(state.metrics)
@@ -100,6 +108,8 @@ class ObservabilityCallback(Callback):
             return
         if self.tracker is not None:
             state.metrics.update(self.tracker.end_window())
+        if self.cost_window is not None:
+            state.metrics.update(self.cost_window.end())
         payload = host_floats(state.metrics)
         self.registry.set_gauges("train", payload)
         self.registry.export(state.global_step, payload)

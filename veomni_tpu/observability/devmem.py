@@ -1,0 +1,254 @@
+"""Live device-memory accounting: buffer census, watermarks, OOM forensics.
+
+The ``mem.*`` gauges used to be a thin ``memory_stats()`` passthrough that
+silently no-opped on CPU — tier-1 never exercised them, and an OOM left
+nothing but the allocator's error string. This module makes HBM accounting
+a first-class, always-live surface:
+
+* :func:`buffer_census` — every ``jax.live_arrays()`` buffer aggregated by
+  (shape, dtype) with a top-K largest table: *which* arrays are holding
+  HBM right now (params vs optimizer moments vs KV pool vs leaked batch).
+* :func:`publish_memory_gauges` — the ``mem.*`` family, refreshed each
+  sync step: per-device ``bytes_in_use`` where the backend reports it,
+  a ``host_rss_bytes`` RSS reading that is live on every backend (so the
+  gauge path is testable under ``JAX_PLATFORMS=cpu``), the live-buffer
+  total, and a process-lifetime high watermark (backend peak counter when
+  available, else the max observed live-buffer total — the CPU fallback
+  that lets tier-1 drill the whole path).
+* :func:`kv_capacity_stats` — the serving engine's block pool translated
+  into operator units: pool bytes, bytes per block, and how many
+  max-length sequences fit (total and right now).
+* :func:`is_resource_exhausted` / :func:`oom_report` — the OOM post-mortem
+  hook: when a ``RESOURCE_EXHAUSTED`` escapes the train loop or the
+  serving pump, the flight-recorder dump gains the buffer census and the
+  compiled-program cost census — the two tables that answer "what was in
+  HBM and which program asked for more".
+
+Import hygiene: nothing here touches a backend at import time; every jax
+call happens inside a function (see ``tests/test_import_hygiene.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from veomni_tpu.observability.metrics import MetricsRegistry, get_registry
+# single home for the platform-sensitive RSS read (current RSS on Linux,
+# peak-RSS fallback elsewhere) — re-exported here because every consumer of
+# this module's censuses wants it next to them
+from veomni_tpu.utils.helper import host_rss_bytes  # noqa: F401
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# process-lifetime high watermark of live device bytes (CPU fallback path:
+# the backend's own peak_bytes_in_use is preferred when it exists)
+_WATERMARK_LOCK = threading.Lock()
+_WATERMARK = {"bytes": 0.0}
+
+
+def _resident_nbytes(x) -> float:
+    """Bytes this PROCESS actually holds for one jax array: the sum of its
+    addressable shards. ``x.nbytes`` is the GLOBAL logical size — on a
+    multihost run it would overcount a sharded array host-count-fold, and
+    on any run it undercounts replication (a replicated array holds one
+    full copy per local device)."""
+    try:
+        shards = x.addressable_shards
+    except Exception:
+        return float(getattr(x, "nbytes", 0) or 0)
+    total = 0.0
+    for s in shards:
+        try:
+            total += float(s.data.nbytes)
+        except Exception:
+            pass
+    return total if total else float(getattr(x, "nbytes", 0) or 0)
+
+
+def buffer_census(top_k: int = 10) -> Dict[str, Any]:
+    """Aggregate every live jax buffer by (shape, dtype).
+
+    Returns ``{total_bytes, num_arrays, by_dtype: {dtype: {count, bytes}},
+    top: [{shape, dtype, count, bytes}, ...]}`` with ``top`` sorted by
+    aggregate bytes descending, truncated to ``top_k``. Bytes are
+    process-RESIDENT (addressable shards, replication counted per copy);
+    shapes shown are the global logical shapes. Deleted/donated arrays are
+    skipped (their buffers are gone)."""
+    import jax
+
+    groups: Dict[tuple, Dict[str, Any]] = {}
+    by_dtype: Dict[str, Dict[str, float]] = {}
+    total = 0.0
+    n = 0
+    for x in jax.live_arrays():
+        try:
+            if getattr(x, "is_deleted", lambda: False)():
+                continue
+            shape = tuple(x.shape)
+            dtype = str(x.dtype)
+            nbytes = _resident_nbytes(x)
+        except Exception:
+            continue
+        n += 1
+        total += nbytes
+        g = groups.setdefault((shape, dtype), {
+            "shape": list(shape), "dtype": dtype, "count": 0, "bytes": 0.0,
+        })
+        g["count"] += 1
+        g["bytes"] += nbytes
+        d = by_dtype.setdefault(dtype, {"count": 0, "bytes": 0.0})
+        d["count"] += 1
+        d["bytes"] += nbytes
+    top = sorted(groups.values(), key=lambda g: -g["bytes"])[:max(0, top_k)]
+    return {
+        "total_bytes": total,
+        "num_arrays": n,
+        "by_dtype": by_dtype,
+        "top": top,
+    }
+
+
+def live_buffer_bytes() -> float:
+    """Sum of live jax array bytes — the CPU-portable 'bytes in use'."""
+    return buffer_census(top_k=0)["total_bytes"]
+
+
+def reset_watermark() -> None:
+    """Tests only: forget the process-lifetime high watermark."""
+    with _WATERMARK_LOCK:
+        _WATERMARK["bytes"] = 0.0
+
+
+def publish_memory_gauges(registry: Optional[MetricsRegistry] = None
+                          ) -> Dict[str, float]:
+    """Refresh the ``mem.*`` family; returns what was published.
+
+    Per-device ``memory_stats()`` readings (``device{i}_bytes_in_use``,
+    ``device{i}_peak_bytes_in_use``) where the backend has them, plus the
+    always-live fallbacks: ``host_rss_bytes``, ``live_buffer_bytes`` and
+    the high watermark (backend peak preferred; else max observed live
+    total, so the watermark path runs on CPU too)."""
+    from veomni_tpu.utils.helper import live_memory_stats
+
+    reg = registry or get_registry()
+    stats = dict(live_memory_stats())  # includes host_rss_bytes (helper)
+    live = live_buffer_bytes()
+    stats["live_buffer_bytes"] = live
+    # both candidates are whole-process totals: summing the per-device
+    # readings keeps them unit-compatible (a per-device peak compared
+    # against a summed current would under-report the watermark N-fold)
+    peak_total = sum(
+        v for k, v in stats.items() if k.endswith("peak_bytes_in_use")
+    )
+    in_use_total = sum(
+        v for k, v in stats.items()
+        if k.startswith("device") and k.endswith("_bytes_in_use")
+        and "peak" not in k
+    )
+    current = in_use_total if in_use_total else live
+    with _WATERMARK_LOCK:
+        _WATERMARK["bytes"] = max(_WATERMARK["bytes"], current, peak_total)
+        stats["high_watermark_bytes"] = _WATERMARK["bytes"]
+    reg.set_gauges("mem", stats)
+    return stats
+
+
+def kv_capacity_stats(blocks, k_pool=None, v_pool=None,
+                      max_model_len: int = 0) -> Dict[str, float]:
+    """Block-pool capacity in operator units.
+
+    ``blocks`` is a :class:`~veomni_tpu.serving.kv_block_manager.
+    KVBlockManager``; ``k_pool``/``v_pool`` (optional device arrays) size
+    the byte figures. ``max_concurrent_seqs`` is the estimated ceiling on
+    simultaneously-resident sequences, assuming each grows to
+    ``max_model_len`` — the capacity-planning number ("how many users fit
+    in HBM"); ``free_concurrent_seqs`` is the same estimate over the
+    currently free (+ evictable cached) blocks."""
+    pool_bytes = 0.0
+    for p in (k_pool, v_pool):
+        if p is not None:
+            try:
+                pool_bytes += float(p.nbytes)
+            except Exception:
+                pass
+    usable = max(1, blocks.num_blocks - 1)  # block 0 is the null block
+    block_bytes = pool_bytes / blocks.num_blocks if pool_bytes else 0.0
+    per_seq = blocks.blocks_for(max_model_len) if max_model_len else 1
+    return {
+        "pool_bytes": pool_bytes,
+        "block_bytes": block_bytes,
+        "block_size": float(blocks.block_size),
+        "num_blocks": float(blocks.num_blocks),
+        "blocks_free": float(blocks.num_free),
+        "blocks_per_max_len_seq": float(per_seq),
+        "max_concurrent_seqs": float(usable // per_seq),
+        "free_concurrent_seqs": float(blocks.num_free // per_seq),
+    }
+
+
+def debug_memory_doc(memory_fn=None, top_k: int = 10) -> Dict[str, Any]:
+    """``/debug/memory`` body: buffer census + watermark (+ the caller's
+    pool-capacity document when wired — the serving engine passes
+    :func:`kv_capacity_stats`)."""
+    doc: Dict[str, Any] = {"buffer_census": buffer_census(top_k=top_k)}
+    doc["host_rss_bytes"] = host_rss_bytes()
+    with _WATERMARK_LOCK:
+        doc["high_watermark_bytes"] = _WATERMARK["bytes"]
+    if memory_fn is not None:
+        try:
+            doc["pool"] = dict(memory_fn())
+        except Exception as e:  # a broken scrape must not 500 the census
+            doc["pool"] = {"error": str(e)}
+    return doc
+
+
+# ------------------------------------------------------------ OOM forensics
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "Out of memory",
+                "OOM when allocating")
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """Does this exception look like a device allocator failure? Checks the
+    message (XlaRuntimeError carries the grpc-style status name) so fault-
+    injected drills and real allocator errors take the same path."""
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def attach_oom_extra(exc: BaseException,
+                     extra: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge :func:`oom_report` into a post-mortem ``extra`` dict when the
+    exception looks like a device allocator failure; otherwise a no-op.
+    The ONE implementation both dump sites (trainer ``train()`` and the
+    ``scripts/serve.py`` pump) share, so their artifacts can't diverge.
+    Exception-proof: forensics must never mask the original failure."""
+    try:
+        if is_resource_exhausted(exc):
+            extra.update(oom_report())
+    except Exception as forensic_err:
+        extra["oom_report_error"] = str(forensic_err)
+    return extra
+
+
+def oom_report(top_k: int = 10) -> Dict[str, Any]:
+    """The post-mortem payload for an OOM: the buffer census (what held the
+    memory) and the cost census (what each compiled program needs on top).
+    Exception-proof — forensics must never mask the original failure."""
+    out: Dict[str, Any] = {}
+    try:
+        out["buffer_census"] = buffer_census(top_k=top_k)
+    except Exception as e:
+        out["buffer_census"] = {"error": str(e)}
+    try:
+        from veomni_tpu.observability.cost import get_cost_census
+
+        out["cost_census"] = get_cost_census().snapshot()
+    except Exception as e:
+        out["cost_census"] = {"error": str(e)}
+    try:
+        out["host_rss_bytes"] = host_rss_bytes()
+    except Exception:
+        pass
+    return out

@@ -5,8 +5,12 @@ Prometheus text exposition format 0.0.4; ``/healthz`` serves a JSON health
 document (the trainer wires it to the resilience supervisor's state — a
 scraper or k8s probe sees rollbacks/aborts without log scraping);
 ``/debug/flight`` returns the flight recorder's recent events (``?n=``
-bounds the tail) and ``/debug/requests`` the serving engine's in-flight
-request timelines (``requests_fn``). Usable by both the trainer
+bounds the tail); ``/debug/requests`` the serving engine's in-flight
+request timelines (``requests_fn``); ``/debug/memory`` the live buffer
+census + HBM watermark (plus the KV pool capacity document when
+``memory_fn`` is wired — ``scripts/serve.py`` passes the engine's
+``kv_capacity``); and ``/debug/cost`` the compiled-program cost census
+with a scrape-to-scrape live MFU window. Usable by both the trainer
 (``train.observability_port`` / ``VEOMNI_METRICS_PORT``) and
 ``serving.InferenceEngine`` (``scripts/serve.py``).
 """
@@ -80,7 +84,8 @@ class MetricsExporter:
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
                  registry: Optional[MetricsRegistry] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
-                 requests_fn: Optional[Callable[[], Dict]] = None):
+                 requests_fn: Optional[Callable[[], Dict]] = None,
+                 memory_fn: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.registry = registry  # None -> resolve the global lazily
@@ -88,6 +93,9 @@ class MetricsExporter:
         # serving wires RequestTracer.snapshot here; the trainer leaves it
         # None and /debug/requests reports an empty document
         self.requests_fn = requests_fn
+        # serving wires InferenceEngine.kv_capacity here; /debug/memory
+        # serves the buffer census either way
+        self.memory_fn = memory_fn
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -145,6 +153,30 @@ class MetricsExporter:
                             doc = dict(exporter.requests_fn())
                         self._send(200, json.dumps(doc, default=str).encode(),
                                    "application/json")
+                    elif route == "/debug/memory":
+                        from veomni_tpu.observability.devmem import (
+                            debug_memory_doc,
+                        )
+
+                        top_k = 10
+                        for part in query.split("&"):
+                            if part.startswith("k="):
+                                try:  # a typo'd ?k= must not read as a 500
+                                    top_k = max(0, int(part[2:]))
+                                except ValueError:
+                                    pass
+                        doc = debug_memory_doc(exporter.memory_fn,
+                                               top_k=top_k)
+                        self._send(200, json.dumps(doc, default=str).encode(),
+                                   "application/json")
+                    elif route == "/debug/cost":
+                        from veomni_tpu.observability.cost import (
+                            debug_cost_doc,
+                        )
+
+                        doc = debug_cost_doc()
+                        self._send(200, json.dumps(doc, default=str).encode(),
+                                   "application/json")
                     else:
                         self._send(404, b"not found", "text/plain")
                 except Exception as e:  # a broken scrape must not kill us
@@ -192,12 +224,13 @@ def maybe_start_from_env(registry: Optional[MetricsRegistry] = None,
                          health_fn: Optional[Callable[[], Dict]] = None,
                          config_port: int = 0,
                          requests_fn: Optional[Callable[[], Dict]] = None,
+                         memory_fn: Optional[Callable[[], Dict]] = None,
                          ) -> Optional[MetricsExporter]:
     """Start an exporter iff configured; returns it (caller owns stop())."""
     port = resolve_port(config_port)
     if port is None:
         return None
     exp = MetricsExporter(port=port, registry=registry, health_fn=health_fn,
-                          requests_fn=requests_fn)
+                          requests_fn=requests_fn, memory_fn=memory_fn)
     exp.start()
     return exp

@@ -99,13 +99,15 @@ class GoodputTracker:
 
 
 def update_memory_gauges(registry: Optional[MetricsRegistry] = None) -> None:
-    """Publish per-device live buffer bytes as ``mem.*`` gauges (backend
-    permitting — XLA:CPU reports nothing and that's fine)."""
-    from veomni_tpu.utils.helper import live_memory_stats
+    """Publish the ``mem.*`` gauges. Since the device cost & capacity
+    observatory (observability/devmem.py) this is more than a
+    ``memory_stats()`` passthrough: per-device bytes where the backend
+    reports them, plus host RSS, the live-buffer total and a
+    process-lifetime high watermark — live on every backend, so tier-1
+    exercises the whole path under ``JAX_PLATFORMS=cpu``."""
+    from veomni_tpu.observability.devmem import publish_memory_gauges
 
-    reg = registry or get_registry()
-    for k, v in live_memory_stats().items():
-        reg.gauge(f"mem.{k}").set(v)
+    publish_memory_gauges(registry or get_registry())
 
 
 class RecompileDetector:

@@ -81,6 +81,14 @@ class EngineConfig:
     # uncached suffix in one go). Bounds how long a newly arrived long
     # prompt can stall every running request's next token.
     prefill_chunk: int = 0
+    # serving-side recompile detection: after this many step() ticks the
+    # decode/prefill TRACE_COUNTS baselines are armed, and any later bucket
+    # growth emits the trainer's loud rank-0 RECOMPILE warning + the
+    # `recompiles` counter (a serving compile storm was previously
+    # invisible — the trainer's detector deliberately watches only
+    # train_step). 0 disables. The grace window absorbs the legitimate
+    # warmup compiles of the pow2 bucket ladder.
+    recompile_warmup_ticks: int = 256
 
     def __post_init__(self):
         if self.block_size < 1 or (self.block_size & (self.block_size - 1)):
@@ -175,6 +183,29 @@ class InferenceEngine:
         self._m_hit_rate = reg.gauge("serve.prefix_hit_rate")
         self._m_cached_tokens = reg.counter("serve.cached_tokens")
         self._m_chunks = reg.counter("serve.prefill_chunks")
+        # HBM capacity accounting (observability/devmem.py): pool bytes are
+        # static per engine; the concurrent-sequence estimates answer "how
+        # many max-length users fit" (total, and with the blocks free now)
+        self._m_kv_pool_bytes = reg.gauge("serve.kv_pool_bytes")
+        self._m_kv_block_bytes = reg.gauge("serve.kv_block_bytes")
+        self._m_kv_max_seqs = reg.gauge("serve.kv_max_concurrent_seqs")
+        self._m_kv_free_seqs = reg.gauge("serve.kv_free_concurrent_seqs")
+        cap = self.kv_capacity()
+        self._m_kv_pool_bytes.set(cap["pool_bytes"])
+        self._m_kv_block_bytes.set(cap["block_bytes"])
+        self._m_kv_max_seqs.set(cap["max_concurrent_seqs"])
+        self._m_kv_free_seqs.set(cap["free_concurrent_seqs"])
+        # serving-side recompile detection over the decode-bucket trace
+        # counters: armed after the warmup grace window (step()), so a
+        # mid-run compile storm gets the same loud RECOMPILE treatment the
+        # train step has had since PR 4
+        self._recompile_detector = None
+        if ec.recompile_warmup_ticks > 0:
+            from veomni_tpu.observability.goodput import RecompileDetector
+
+            self._recompile_detector = RecompileDetector(
+                [("serve_decode", decode_mod.TRACE_COUNTS)], registry=reg,
+            )
 
     # ------------------------------------------------------------ jit plumbing
     def _build_decode_step(self):
@@ -193,7 +224,14 @@ class InferenceEngine:
             )
             return nxt, split[:, 0], k_pool, v_pool
 
-        return jax.jit(impl, donate_argnums=(1, 2))
+        from veomni_tpu.observability.cost import instrument_jit
+
+        return instrument_jit(
+            "paged_decode", jax.jit(impl, donate_argnums=(1, 2)),
+            # args: (params, k_pool, v_pool, tables, ...) — the table width
+            # bucket is the only varying shape
+            bucket_fn=lambda a: f"s{a[3].shape[0]}_nbb{a[3].shape[1]}",
+        )
 
     def _build_prefill_chunk_step(self):
         cfg = self.cfg
@@ -206,7 +244,15 @@ class InferenceEngine:
                 chunk_len, chunk_bucket,
             )
 
-        return jax.jit(impl, static_argnums=(7,), donate_argnums=(1, 2))
+        from veomni_tpu.observability.cost import instrument_jit
+
+        return instrument_jit(
+            "paged_prefill",
+            jax.jit(impl, static_argnums=(7,), donate_argnums=(1, 2)),
+            static_argnums=(7,),
+            # (chunk bucket, table-width bucket) — the two compile axes
+            bucket_fn=lambda a: f"cb{a[7]}_nbb{a[3].shape[0]}",
+        )
 
     # ----------------------------------------------------------------- intake
     def submit(self, request: Union[Request, Iterable[int]],
@@ -288,6 +334,15 @@ class InferenceEngine:
         self._m_running.set(self.scheduler.num_running)
         self._m_kv.set(self.blocks.utilization())
         self._m_preempt.set(self.scheduler.preemption_count)
+        per_seq = max(1, self.blocks.blocks_for(self.config.max_model_len))
+        self._m_kv_free_seqs.set(self.blocks.num_free // per_seq)
+        det = self._recompile_detector
+        if det is not None:
+            grace = self.config.recompile_warmup_ticks
+            if self._step_counter == grace:
+                det.arm()  # warmup bucket compiles absorbed
+            elif self._step_counter > grace:
+                det.check()
         le = self.config.log_every_steps
         if le and self._step_counter % le == 0:
             # non-resetting read: periodic logging must not clobber the
@@ -532,6 +587,17 @@ class InferenceEngine:
         )
 
     # ---------------------------------------------------------------- metrics
+    def kv_capacity(self) -> Dict[str, float]:
+        """Block-pool capacity in operator units (pool bytes + estimated
+        max-concurrent max-length sequences); the `/debug/memory` pool
+        document (``scripts/serve.py`` wires it to the exporter)."""
+        from veomni_tpu.observability.devmem import kv_capacity_stats
+
+        return kv_capacity_stats(
+            self.blocks, self.k_pool, self.v_pool,
+            max_model_len=self.config.max_model_len,
+        )
+
     def metrics(self, reset_window: bool = True) -> Dict[str, float]:
         """Host-float engine metrics; feed them straight into any
         logger/meter sink. ``decode_tokens_per_sec`` and ``ttft_avg_s`` are

@@ -41,6 +41,17 @@ TRACE_COUNTS: Dict[str, int] = {"train_step": 0, "eval_step": 0}
 LAST_TRACE_SHAPES: Dict[str, Any] = {}
 
 
+def _batch_bucket(batch: Dict[str, Any]) -> str:
+    """Cost-census bucket label for a step batch: the accum/batch/seq shape
+    of the first array leaf (every retrace-relevant shape in a packed text
+    batch). Falls back to a leaf count for exotic batch schemas."""
+    for v in batch.values():
+        shape = getattr(v, "shape", None)
+        if shape:
+            return "x".join(str(int(d)) for d in shape)
+    return f"leaves{len(batch)}"
+
+
 @flax.struct.dataclass
 class TrainState:
     params: Any
@@ -178,13 +189,25 @@ def build_train_step(
         # metrics must be explicitly replicated: fully-replicated globals are
         # host-fetchable on every process (multihost float(metrics[...]))
         replicated = NamedSharding(pstate.mesh, P())
-        return jax.jit(
+        jitted = jax.jit(
             step_fn,
             in_shardings=(state_shardings, batch_shardings),
             out_shardings=(state_shardings, replicated),
             donate_argnums=donate,
         )
-    return jax.jit(step_fn, donate_argnums=donate)
+    else:
+        jitted = jax.jit(step_fn, donate_argnums=donate)
+    # cost census (observability/cost.py): the jit site's compiles flow
+    # through an AOT lower/compile pair that records XLA cost_analysis /
+    # memory_analysis / compile wall-time per batch-shape bucket — the
+    # attribution substrate behind the train.mfu_pct window gauge. Identity
+    # under VEOMNI_COST_CENSUS=0; any census failure falls back to the
+    # plain jit call permanently.
+    from veomni_tpu.observability.cost import instrument_jit
+
+    return instrument_jit(
+        "train_step", jitted, bucket_fn=lambda args: _batch_bucket(args[1])
+    )
 
 
 def build_eval_step(loss_fn: Callable, state_shardings=None, batch_shardings=None):
@@ -196,6 +219,9 @@ def build_eval_step(loss_fn: Callable, state_shardings=None, batch_shardings=Non
         loss_sum, metrics = loss_fn(params, batch)
         return {"loss": loss_sum / jnp.maximum(metrics["ntokens"], 1), **metrics}
 
+    # NOT census-instrumented: the trainer's evaluate() builds (and
+    # instruments) its own eval jit — a second 'eval_step' site here would
+    # collide with it in the census on the same batch-shape buckets
     if state_shardings is not None:
         return jax.jit(
             eval_fn, in_shardings=(state_shardings.params, batch_shardings)

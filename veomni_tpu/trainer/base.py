@@ -580,8 +580,15 @@ class BaseTrainer:
         if not self.args.data.eval_path:
             return None
         if not hasattr(self, "_eval_step"):
-            self._eval_step = jax.jit(
-                lambda params, batch: self._loss_fn(params, batch)
+            # census-instrumented like the train step: eval flops are real
+            # device work and belong in the window MFU (observability/cost)
+            from veomni_tpu.observability.cost import instrument_jit
+            from veomni_tpu.train.train_step import _batch_bucket
+
+            self._eval_step = instrument_jit(
+                "eval_step",
+                jax.jit(lambda params, batch: self._loss_fn(params, batch)),
+                bucket_fn=lambda args: _batch_bucket(args[1]),
             )
         it = iter(self._build_eval_dataloader())
         total, ntok = 0.0, 0.0
@@ -640,6 +647,23 @@ class BaseTrainer:
                     "callback %s close() failed: %s",
                     type(cb).__name__, e,
                 )
+
+    @staticmethod
+    def _postmortem_extra(e: BaseException, global_step: int) -> Dict[str, Any]:
+        """Post-mortem payload for an exception escaping train(). A device
+        allocator failure (RESOURCE_EXHAUSTED) additionally captures the
+        live-buffer census and the compiled-program cost census — the two
+        tables an OOM forensic needs (observability/devmem.py). Must never
+        raise: forensics can't be allowed to mask the original failure."""
+        extra: Dict[str, Any] = {"error": str(e)[:2000],
+                                 "global_step": global_step}
+        try:
+            from veomni_tpu.observability.devmem import attach_oom_extra
+
+            attach_oom_extra(e, extra)
+        except Exception as forensic_err:  # even the import must be safe
+            extra["oom_report_error"] = str(forensic_err)
+        return extra
 
     def _rollback(self, ctl, sup):
         """Supervisor escalation: restore the latest committed checkpoint
@@ -734,8 +758,7 @@ class BaseTrainer:
                 # wired in the prologue above, before any callback ran.
                 dump_postmortem(
                     f"exception:{type(e).__name__}",
-                    extra={"error": str(e)[:2000],
-                           "global_step": ctl.global_step},
+                    extra=self._postmortem_extra(e, ctl.global_step),
                 )
                 # the loop's finally below is never reached from here, but
                 # callbacks that ran before the raising one may already hold
@@ -896,8 +919,7 @@ class BaseTrainer:
                 # what the run was doing on the way there
                 dump_postmortem(
                     f"exception:{type(e).__name__}",
-                    extra={"error": str(e)[:2000],
-                           "global_step": ctl.global_step},
+                    extra=self._postmortem_extra(e, ctl.global_step),
                 )
                 raise
             finally:

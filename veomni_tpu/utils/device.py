@@ -100,6 +100,35 @@ def get_device_peak_flops(dtype: str = "bf16") -> float:
     return 197e12
 
 
+@functools.lru_cache(maxsize=None)
+def get_device_peak_bandwidth() -> float:
+    """Peak HBM bandwidth per chip in bytes/s (published numbers, same
+    table discipline as :func:`get_device_peak_flops`).
+
+    Feeds the roofline machine balance (peak FLOP/s ÷ peak bytes/s) the
+    cost census classifies compiled programs against, and the
+    ``bandwidth_util_pct`` window gauge."""
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    table = {
+        "tpu v2": 700e9,
+        "tpu v3": 900e9,
+        "tpu v4": 1228e9,
+        "tpu v5 lite": 819e9,  # v5e
+        "tpu v5e": 819e9,
+        "tpu v5": 2765e9,  # v5p
+        "tpu v5p": 2765e9,
+        "tpu v6 lite": 1640e9,  # trillium
+        "tpu v6e": 1640e9,
+        "tpu7x": 7400e9,
+    }
+    for key in sorted(table, key=len, reverse=True):
+        if kind.startswith(key):
+            return table[key]
+    if get_device_type() == "cpu":
+        return 1e11  # nominal, keeps bandwidth-utilization math finite
+    return 819e9
+
+
 def mesh_devices_grid(shape: Tuple[int, ...]):
     """Devices reshaped to ``shape`` for building a Mesh; validates count."""
     import numpy as np

@@ -268,14 +268,53 @@ def pretty_bytes(n: float) -> str:
     return f"{n:.2f}PB"
 
 
+def host_rss_bytes() -> float:
+    """Current resident-set size of this process in bytes.
+
+    Reads ``/proc/self/statm`` (Linux — a LIVE value that falls when memory
+    is released) and falls back to ``resource.getrusage`` peak RSS
+    elsewhere (kilobytes on Linux, bytes on macOS). The single home for
+    this platform-sensitive read: ``live_memory_stats`` and
+    ``observability/devmem.py`` both consume it."""
+    try:
+        import os
+
+        with open("/proc/self/statm") as f:
+            pages = float(f.read().split()[1])
+        return pages * float(os.sysconf("SC_PAGE_SIZE"))
+    except Exception:
+        pass
+    try:
+        import resource
+        import sys
+
+        rss = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        return rss if sys.platform == "darwin" else rss * 1024.0
+    except Exception:
+        return 0.0
+
+
 def live_memory_stats() -> Dict[str, float]:
-    """Per-device live buffer bytes (cf. torch.cuda.memory_allocated)."""
+    """Per-device live buffer bytes (cf. torch.cuda.memory_allocated), plus
+    an always-available host RSS reading.
+
+    XLA:CPU's ``memory_stats()`` returns nothing, which used to leave the
+    ``mem.*`` gauge family entirely absent under ``JAX_PLATFORMS=cpu`` —
+    tier-1 never exercised the path. ``host_rss_bytes`` (host memory, not
+    HBM) keeps the family live on every backend."""
     stats = {}
     for i, d in enumerate(jax.local_devices()):
         try:
             ms = d.memory_stats()
             if ms:
                 stats[f"device{i}_bytes_in_use"] = float(ms.get("bytes_in_use", 0))
+                if "peak_bytes_in_use" in ms:
+                    stats[f"device{i}_peak_bytes_in_use"] = float(
+                        ms["peak_bytes_in_use"]
+                    )
         except Exception:
             pass
+    rss = host_rss_bytes()
+    if rss:
+        stats["host_rss_bytes"] = rss
     return stats
