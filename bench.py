@@ -20,6 +20,75 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
+def _bench_out_dir() -> str:
+    """Where this bench process's diagnosis artifacts live (post-mortems,
+    per-rank heartbeats): BENCH_OUT, or a per-PID tempdir (rank is 0 for
+    every bench, so a shared /tmp would collide two concurrent benches).
+    Heartbeats must be written DURING the run (a stall diagnosis needs the
+    beats from before the stall), so the dir exists on healthy runs too —
+    :func:`_cleanup_default_out` reaps it at a clean exit when it holds
+    nothing but heartbeats (a post-mortem is evidence and is kept)."""
+    import tempfile
+
+    return os.environ.get("BENCH_OUT") or os.path.join(
+        tempfile.gettempdir(), f"veomni-bench-pm-{os.getpid()}"
+    )
+
+
+def _cleanup_default_out() -> None:
+    """Reap the per-PID default artifact dir at a CLEAN exit: a healthy
+    bench must not leak one /tmp dir per invocation. Only heartbeat files
+    are removed, and only when BENCH_OUT is unset (an operator-chosen dir
+    is theirs) and nothing else — a post-mortem, a stall artifact — lives
+    there. Never raises."""
+    if os.environ.get("BENCH_OUT"):
+        return
+    d = _bench_out_dir()
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    try:
+        from veomni_tpu.observability.fleet import HEARTBEAT_RE
+
+        if all(HEARTBEAT_RE.match(n) for n in names):
+            for n in names:
+                os.unlink(os.path.join(d, n))
+            os.rmdir(d)
+    except Exception:
+        pass
+
+
+_BEAT_MIN_INTERVAL_S = 1.0
+_LAST_BEAT = {"t": 0.0, "phase": ""}
+
+
+def _beat(global_step: int = 0, phase: str = "init",
+          step_time_s: float = 0.0) -> None:
+    """Progress heartbeat (observability/fleet.py): an atomic rewrite of
+    heartbeat-<rank>.json recording the last phase/step that made progress.
+    When the relay wedges (BENCH_r01–r05: 0 tok/s, no artifact), the stall
+    JSON's heartbeat ages say exactly WHERE progress stopped — init, first
+    compile, or step N — instead of silence. Same-phase beats are throttled
+    to one per second: the per-step call sits inside the bench's TIMED
+    window, and an unthrottled write per step (milliseconds each on a
+    network filesystem) would deflate the very tokens/sec the bench exists
+    to measure — stall diagnosis only needs watchdog-timeout freshness.
+    Never raises."""
+    now = time.monotonic()
+    if phase == _LAST_BEAT["phase"] and \
+            now - _LAST_BEAT["t"] < _BEAT_MIN_INTERVAL_S:
+        return
+    _LAST_BEAT["t"], _LAST_BEAT["phase"] = now, phase
+    try:
+        from veomni_tpu.observability.fleet import write_heartbeat
+
+        write_heartbeat(_bench_out_dir(), global_step=global_step,
+                        phase=phase, step_time_s=step_time_s)
+    except Exception:
+        pass
+
+
 def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_chip"):
     """The axon TPU tunnel can wedge its chip claim (a killed process leaves
     the grant held), after which backend init hangs indefinitely. If the
@@ -32,8 +101,6 @@ def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_ch
     supervisor); caller must ``.stop()`` it before printing the real record
     so the dog never races a measurement out of a block-buffered stdout via
     its os._exit."""
-    import tempfile
-
     from veomni_tpu.observability.flight_recorder import (
         configure_flight_recorder,
     )
@@ -41,17 +108,22 @@ def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_ch
 
     # the bench has no output_dir; without this the dog's post-mortem falls
     # back to the launch CWD (which may be read-only). Default is a
-    # per-PROCESS dir (rank is 0 for every bench, so a shared /tmp would
-    # collide two concurrent benches on one postmortem-0.json), created
-    # lazily by the dump itself so the common no-stall run leaks nothing.
-    # The stall JSON below records the exact path either way.
-    configure_flight_recorder(
-        dump_dir=os.environ.get("BENCH_OUT")
-        or os.path.join(tempfile.gettempdir(),
-                        f"veomni-bench-pm-{os.getpid()}")
-    )
+    # per-PROCESS dir (see _bench_out_dir), created lazily by the dump
+    # itself so the common no-stall run leaks nothing. The stall JSON below
+    # records the exact path either way.
+    configure_flight_recorder(dump_dir=_bench_out_dir())
 
     def on_stall(stack_dump: str):
+        # per-rank heartbeat freshness (observability/fleet.py): the beats
+        # run_bench/_serve_main drop at each phase/step say where progress
+        # stopped — the diagnosis artifact five wedged-relay rounds lacked
+        try:
+            from veomni_tpu.observability.fleet import heartbeat_ages
+
+            beats = heartbeat_ages(_bench_out_dir(),
+                                   stale_after_s=float(timeout_s))
+        except Exception:
+            beats = []
         print(json.dumps({
             "metric": metric,
             "value": 0,
@@ -63,6 +135,12 @@ def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_ch
             # stacks) just before invoking this callback; wd is late-bound
             # and the dog can only fire timeout_s after it is assigned
             "postmortem": wd.last_postmortem_path,
+            # heartbeat age + last-progress step/phase per rank: WHICH rank
+            # stopped making progress, and at what point
+            "heartbeats": beats,
+            "last_progress_step": max(
+                (b.get("global_step", 0) for b in beats), default=0
+            ),
         }), flush=True)
 
     wd = Watchdog(
@@ -262,7 +340,12 @@ def run_bench(
         pins["ulysses"] = "ulysses_async"
         os.environ["VEOMNI_ULYSSES_ASYNC_CHUNKS"] = str(ulysses_async_chunks)
 
+    # first beat BEFORE the chip claim: a wedge inside _wait_for_backend
+    # (the historical relay failure) must read as "stuck at init", not as
+    # an empty heartbeat list
+    _beat(phase="init")
     n_chips = _wait_for_backend()
+    _beat(phase="backend")  # progress marker: chip claim succeeded
     ps = init_parallel_state(ulysses_size=ulysses_size)
 
     with use_parallel_state(ps):
@@ -310,6 +393,7 @@ def run_bench(
         # host fetch (float()) is the only guaranteed synchronization point.
         state, metrics = step(state, batch)
         _ = float(metrics["loss"])
+        _beat(phase="compile")  # progress marker: warmup compile + fetch ran
 
         # utilization accounting for the timed window: goodput split from
         # host spans + recompile count from the train-step trace counter
@@ -323,12 +407,17 @@ def run_bench(
         traces0 = train_step_mod.TRACE_COUNTS["train_step"]
         tracker.begin_window()
         t0 = time.perf_counter()
-        for _ in range(steps):
+        for i in range(steps):
             with span("step.dispatch"):
                 state, metrics = step(state, batch)
+            # last-progress marker for the stall JSON: dispatch is async, so
+            # this says the HOST kept feeding the device up to step i+1 (the
+            # sync fetch below is where a wedged device surfaces)
+            _beat(global_step=i + 1, phase="step")
         with span("sync.fetch"):
             _ = float(metrics["loss"])
         dt = time.perf_counter() - t0
+        _beat(global_step=steps, phase="done", step_time_s=dt / max(1, steps))
         gp = tracker.end_window()
         recompiles = train_step_mod.TRACE_COUNTS["train_step"] - traces0
 
@@ -410,10 +499,13 @@ def run_serve_bench(
         SamplingParams,
     )
 
+    _beat(phase="init")  # before the chip claim: see run_bench
     _wait_for_backend()
+    _beat(phase="backend")  # progress marker: chip claim succeeded
     cfg = bench_config(remat_policy, preset)
     model = build_foundation_model(config=cfg)
     params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    _beat(phase="params")  # progress marker: weights materialized on device
 
     max_len = max(prompt_lens) + max_new_tokens
     rng = np.random.default_rng(0)
@@ -442,9 +534,11 @@ def run_serve_bench(
         # warmup would let the longest prompt mask the smaller buckets.
         # With the prefix cache on this also pre-caches the shared prefix,
         # so the timed window measures the steady state.
-        for p in warm_prompts:
+        for wi, p in enumerate(warm_prompts):
             eng.run([Request(prompt_ids=p, sampling=SamplingParams(
                 max_new_tokens=max_new_tokens))])
+            # warmup compiles are where the relay historically wedges
+            _beat(global_step=wi + 1, phase="serve_warmup")
         m0 = eng.metrics()  # reset the throughput window
 
         timed = [Request(prompt_ids=p, sampling=SamplingParams(
@@ -453,6 +547,7 @@ def run_serve_bench(
         ids = [eng.submit(r) for r in timed]
         outs = eng.run()
         dt = time.perf_counter() - t0
+        _beat(global_step=len(timed), phase="serve_done")
         m1 = eng.metrics(reset_window=False)
         # warmup-proof deltas across the timed window; prompt_tokens counts
         # every (re)admission's recompute prompt, so the token fraction is
@@ -600,6 +695,7 @@ def _serve_main(preset: str, watchdog=None):
         line["nocache_ttft_p99_s"] = round(r["nocache_ttft_p99_s"], 5)
         line["nocache_prefill_chunks"] = r["nocache_prefill_chunks"]
     print(json.dumps(line), flush=True)
+    _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 
 
 def main():
@@ -665,6 +761,7 @@ def main():
         "xla_flops_per_step": r["xla_flops_per_step"],
         "analytic_vs_xla_flops_ratio": r["analytic_vs_xla_flops_ratio"],
     }), flush=True)
+    _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 
 
 if __name__ == "__main__":

@@ -51,7 +51,7 @@ from veomni_tpu.train import build_train_state, build_train_step  # noqa: E402
 from veomni_tpu.train.train_step import resolve_state_shardings  # noqa: E402
 from veomni_tpu.utils.overlap_evidence import (  # noqa: E402
     analyze_scheduled_dump,
-    collective_census,
+    collective_bytes_census,
     compiled_hlo_text,
     overlap_report,
 )
@@ -147,19 +147,25 @@ def main():
         # XLA:CPU lowers collectives synchronously — no start/done pairs
         # exist off-TPU (the latency-hiding scheduler is a TPU pass). Report
         # the GSPMD-inserted collective census of the compiled step instead:
-        # these are exactly the ops the TPU scheduler overlaps.
+        # these are exactly the ops the TPU scheduler overlaps. (The same
+        # census now runs LIVE on every instrumented compile — the
+        # comm.{site}.{bucket}.* gauges, observability/comm.py — this
+        # script stays the human-readable offline artifact.)
         census: dict = {}
         for fname in os.listdir(DUMP):
             if "step_fn" not in fname or "after_optimizations.txt" not in fname:
                 continue
             with open(os.path.join(DUMP, fname)) as f:
-                for op, n in collective_census(f.read()).items():
-                    census[op] = census.get(op, 0) + n
+                for op, rec in collective_bytes_census(f.read()).items():
+                    agg = census.setdefault(op, {"count": 0, "bytes": 0.0})
+                    agg["count"] += rec["count"]
+                    agg["bytes"] += rec["bytes"]
         print("CPU backend lowers collectives synchronously; GSPMD-inserted "
               "collectives in the compiled train step (what the TPU "
               "latency-hiding scheduler overlaps):")
-        for op, n in sorted(census.items()):
-            print(f"  {op:20s} {n}")
+        for op, rec in sorted(census.items()):
+            print(f"  {op:20s} {rec['count']:4d}  "
+                  f"{rec['bytes'] / 1e6:10.3f} MB/device")
     print(f"step time, fetch every step:  {per_step_sync * 1e3:.2f} ms")
     print(f"step time, fetch every 50:    {per_step_async * 1e3:.2f} ms")
     print(f"async-loop win: {(per_step_sync / per_step_async - 1) * 100:.1f}%")

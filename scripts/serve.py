@@ -20,7 +20,8 @@ VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS, VEOMNI_SERVE_PREFIX_CACHE
 (tokens prefilled per engine tick, 0 = whole prompt at once),
 VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD). VEOMNI_METRICS_PORT
 serves Prometheus /metrics + /healthz while the pump runs; /debug/requests
-rows carry each request's cached_tokens (docs/observability.md).
+rows carry each request's cached_tokens, and /debug/fleet the collective
+census of the engine's compiled programs (docs/observability.md).
 """
 
 import argparse

@@ -527,7 +527,10 @@ def test_every_emitted_metric_family_is_documented():
                      "integrity.ckpt_quarantined", "resilience.anomalies",
                      "retry.attempts", "recompiles", "span.", "train.",
                      "cost.", "cost.programs", "cost.compile_s", "mem.",
-                     "serve.kv_pool_bytes", "serve.kv_max_concurrent_seqs"):
+                     "serve.kv_pool_bytes", "serve.kv_max_concurrent_seqs",
+                     # fleet & comm observatory call sites (PR 11)
+                     "comm.programs", "fleet.step_time_skew_s",
+                     "fleet.slowest_rank", "fleet.stragglers"):
         assert expected in tokens, f"scanner lost {expected!r}"
     doc = open(os.path.join(_REPO, "docs", "observability.md")).read()
     missing = sorted(t for t in tokens if t not in doc)

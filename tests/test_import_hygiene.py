@@ -55,7 +55,9 @@ for required in ("veomni_tpu.serving", "veomni_tpu.serving.engine",
                  "veomni_tpu.observability.flight_recorder",
                  "veomni_tpu.observability.request_trace",
                  "veomni_tpu.observability.cost",
-                 "veomni_tpu.observability.devmem"):
+                 "veomni_tpu.observability.devmem",
+                 "veomni_tpu.observability.comm",
+                 "veomni_tpu.observability.fleet"):
     if required not in visited:
         print("MISSING:" + required)
         sys.exit(1)

@@ -205,6 +205,16 @@ class TrainingArguments:
     # output_dir/postmortem-<rank>.json on watchdog fire / supervisor abort
     # / uncaught exception / SIGTERM. Ring size in events; 0 disables.
     observability_flight_events: int = 4096
+    # fleet tier (observability/fleet.py): per-sync-window per-rank
+    # step-time skew exchange (one tiny all-gather of a handful of floats;
+    # automatically off below 2 processes), straggler warnings +
+    # fleet.straggler flight events, and a host-side heartbeat file per
+    # rank (output_dir/heartbeat-<rank>.json) so a WEDGED rank is
+    # diagnosable from outside the process. 0 disables the tier entirely.
+    observability_fleet: int = 1
+    # a rank whose window-mean step time exceeds the fleet median by this
+    # factor is named a straggler (rank-0 warning + flight event)
+    observability_straggler_factor: float = 2.0
     enable_profiling: bool = False
     # VEOMNI_PROFILE_START / VEOMNI_PROFILE_END env vars override the window
     profile_start_step: int = 3

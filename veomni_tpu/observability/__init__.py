@@ -38,12 +38,27 @@ full profiler:
                  high-watermark tracking with a CPU fallback, KV-pool
                  capacity stats, and the OOM post-mortem payload
                  (``/debug/memory``).
+* ``comm``     — live collective census riding the cost census's compile:
+                 per-program bytes by collective kind, predicted comm time
+                 against the ICI peak, overlappable-vs-serialized pair
+                 counts, the ``comm``-bound roofline extension and the
+                 window ``comm_est_frac``.
+* ``fleet``    — cross-rank view: per-sync-window step-time skew exchange
+                 (straggler warnings + ``fleet.straggler`` flight events),
+                 host-side per-rank heartbeat files for out-of-process
+                 wedge diagnosis, and ``/debug/fleet``
+                 (``scripts/fleet.py`` merges ranks offline).
 
 ``callback.ObservabilityCallback`` (imported lazily by the trainer — it
 depends on ``trainer.callbacks``) ties them together in the train loop.
 See ``docs/observability.md``.
 """
 
+from veomni_tpu.observability.comm import (
+    CommCensus,
+    CommCost,
+    get_comm_census,
+)
 from veomni_tpu.observability.cost import (
     CostCensus,
     CostWindow,
@@ -60,6 +75,13 @@ from veomni_tpu.observability.devmem import (
     publish_memory_gauges,
 )
 from veomni_tpu.observability.exporter import MetricsExporter, render_prometheus
+from veomni_tpu.observability.fleet import (
+    FleetMonitor,
+    get_active_monitor,
+    heartbeat_ages,
+    read_heartbeats,
+    write_heartbeat,
+)
 from veomni_tpu.observability.flight_recorder import (
     FlightRecorder,
     configure_flight_recorder,
@@ -90,9 +112,12 @@ from veomni_tpu.observability.spans import (
 )
 
 __all__ = [
+    "CommCensus",
+    "CommCost",
     "CostCensus",
     "CostWindow",
     "Counter",
+    "FleetMonitor",
     "FlightRecorder",
     "Gauge",
     "ProgramCost",
@@ -110,18 +135,23 @@ __all__ = [
     "dump_chrome_trace",
     "dump_postmortem",
     "enable_spans",
+    "get_active_monitor",
+    "get_comm_census",
     "get_cost_census",
     "get_flight_recorder",
     "get_registry",
+    "heartbeat_ages",
     "instrument_jit",
     "is_resource_exhausted",
     "kv_capacity_stats",
     "oom_report",
     "publish_memory_gauges",
+    "read_heartbeats",
     "record",
     "render_prometheus",
     "set_registry",
     "span",
     "spans_enabled",
     "update_memory_gauges",
+    "write_heartbeat",
 ]

@@ -43,8 +43,16 @@ class ObservabilityCallback(Callback):
         self.detector = None
         self.exporter = None
         self.cost_window = None
+        self.fleet = None
         self._chrome_trace_path = ""
         self._armed = False
+        # per-sync-window host step timing (feeds the fleet skew exchange):
+        # one perf_counter read per step, no device syncs
+        self._win_t0 = 0.0
+        self._win_last = 0.0
+        self._win_steps = 0
+        self._win_max_step_s = 0.0
+        self._fleet_warm = False
 
     def on_train_begin(self, trainer, state):
         t = trainer.args.train
@@ -69,12 +77,24 @@ class ObservabilityCallback(Callback):
             shape_source=train_step_mod.LAST_TRACE_SHAPES,
             registry=self.registry,
         )
+        # fleet tier (observability/fleet.py): heartbeats always (a wedged
+        # rank must be diagnosable from outside), skew exchange only with
+        # >= 2 processes; train.observability_fleet=0 turns it all off
+        if t.observability_fleet:
+            from veomni_tpu.observability.fleet import FleetMonitor
+
+            self.fleet = FleetMonitor(
+                registry=self.registry,
+                straggler_factor=t.observability_straggler_factor,
+                heartbeat_dir=t.output_dir,
+            )
         port = resolve_port(t.observability_port)
         if port is not None:
             sup = getattr(trainer, "_supervisor", None)
             health_fn = sup.health if sup is not None else None
             self.exporter = MetricsExporter(
-                port=port, registry=self.registry, health_fn=health_fn
+                port=port, registry=self.registry, health_fn=health_fn,
+                fleet_fn=self.fleet.debug_doc if self.fleet else None,
             )
             self.exporter.start()
         self.tracker.begin_window()
@@ -84,8 +104,20 @@ class ObservabilityCallback(Callback):
         self.cost_window = CostWindow()
         self.cost_window.begin()
         self._armed = False
+        import time as _time
+
+        self._win_t0 = self._win_last = _time.perf_counter()
+        self._win_steps = 0
+        self._win_max_step_s = 0.0
+        self._fleet_warm = False  # window 1 = compile warmup, no exchange
 
     def on_step_end(self, trainer, state):
+        import time as _time
+
+        now = _time.perf_counter()
+        self._win_steps += 1
+        self._win_max_step_s = max(self._win_max_step_s, now - self._win_last)
+        self._win_last = now
         if not self._armed:
             # absorb the warmup compile of step 1; everything after is a
             # recompile worth shouting about
@@ -99,6 +131,25 @@ class ObservabilityCallback(Callback):
         state.metrics.update(self.cost_window.end())
         state.metrics["recompiles"] = float(self.detector.total_recompiles)
         update_memory_gauges(self.registry)
+        if self.fleet is not None and self._win_steps:
+            # heartbeat + skew exchange on the loop's existing sync cadence
+            # (the host just blocked on the device fetch anyway). The FIRST
+            # window carries step-1's compile wall — cross-host compile
+            # skew (cold vs warm persistent cache) is not a straggler, so
+            # it heartbeats but skips the exchange, mirroring the recompile
+            # detector's warmup arm. Deterministic per window on every
+            # rank: the exchange is a collective.
+            self.fleet.observe_window(
+                state.global_step,
+                (now - self._win_t0) / self._win_steps,
+                max_step_s=self._win_max_step_s,
+                steps=self._win_steps,
+                exchange=self._fleet_warm,
+            )
+            self._fleet_warm = True
+        self._win_t0 = self._win_last = _time.perf_counter()
+        self._win_steps = 0
+        self._win_max_step_s = 0.0
         payload = host_floats(state.metrics)
         self.registry.set_gauges("train", payload)
         self.registry.export(state.global_step, payload)
@@ -127,3 +178,14 @@ class ObservabilityCallback(Callback):
         if self.exporter is not None:
             self.exporter.stop()
             self.exporter = None
+        if self.fleet is not None:
+            from veomni_tpu.observability.fleet import (
+                get_active_monitor,
+                set_active_monitor,
+            )
+
+            # only un-register our own monitor: a second trainer in the
+            # same process may already have installed its own
+            if get_active_monitor() is self.fleet:
+                set_active_monitor(None)
+            self.fleet = None

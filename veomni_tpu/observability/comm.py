@@ -1,0 +1,262 @@
+"""Live collective census: what does each compiled program *communicate*?
+
+Fourth observability tier. PR 10's cost census made the device's compute
+and memory visible per compiled program; communication stayed dark — the
+collective/overlap analysis existed only as an offline artifact script
+(``scripts/overlap_evidence.py``), goodput lumped exposed comm time into
+``dispatch+other``, and GSPMD decides the collectives behind our backs
+(T3, PAPERS.md: overlap must be *tracked*, not assumed). This module
+promotes the PR 1 HLO census (``utils/overlap_evidence.py``) to a live
+per-program record, piggybacking on the cost census's owned AOT
+``lower()``/``compile()`` pair — the HLO text of the already-compiled
+program is parsed once per compile, no extra compiles, zero hot-path cost.
+
+Per (site, bucket) — the same keys as the cost census:
+
+* **bytes by collective kind** (all-reduce / all-gather / reduce-scatter /
+  all-to-all / collective-permute), from each collective instruction's
+  result shape in the optimized (SPMD-partitioned, hence per-device) HLO;
+* **predicted comm time** — total collective bytes over
+  ``utils/device.py::get_device_peak_interconnect_bandwidth`` (an
+  order-of-magnitude link-budget estimate, not measured goodput);
+* **overlappable vs serialized** collective counts from the PR 1
+  dependency census (:func:`overlap_report`): collectives with at least
+  one independent compute partner are *overlappable* — the latency-hiding
+  scheduler can hide them; the rest are *serialized* and their predicted
+  time is exposed step time.
+
+The record rides into the cost census too (``ProgramCost.comm_bytes``), so
+the roofline verdict extends to ``comm``-bound and the per-window
+``CostWindow`` reports ``comm_est_frac`` — the estimated share of window
+wall the program's collectives would take unhidden. ``VEOMNI_COMM_CENSUS=0``
+disables the analysis (the cost census keeps running).
+
+Registry families (``docs/observability.md``):
+``comm.{site}.{bucket}.bytes_{kind}`` / ``.comm_bytes`` /
+``.comm_time_est_s`` / ``.collectives`` / ``.overlappable`` /
+``.serialized`` / ``.pairs`` gauges, plus the aggregate ``comm.programs``
+counter. ``/debug/fleet`` (exporter) carries the census snapshot next to
+the per-rank skew view (``observability/fleet.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from veomni_tpu.observability.metrics import MetricsRegistry, get_registry
+from veomni_tpu.utils.logging import get_logger
+# stdlib-only module, safe at import time; the SAME tuple drives the byte
+# census and the per-kind gauge loop — a hand copy could drift and leave a
+# new kind's bytes inside comm_bytes with no per-kind gauge ever published
+from veomni_tpu.utils.overlap_evidence import ALL_COLLECTIVES as COMM_KINDS
+
+logger = get_logger(__name__)
+
+
+def comm_census_enabled() -> bool:
+    """``VEOMNI_COMM_CENSUS=0`` keeps compiles comm-census-free (the cost
+    census itself stays governed by ``VEOMNI_COST_CENSUS``)."""
+    return os.environ.get("VEOMNI_COMM_CENSUS", "1") not in ("0", "")
+
+
+def _gauge_kind(kind: str) -> str:
+    return kind.replace("-", "_")
+
+
+@dataclass
+class CommCost:
+    """One compiled program's communication record (per (site, bucket))."""
+
+    site: str
+    bucket: str
+    bytes_by_kind: Dict[str, float] = field(default_factory=dict)
+    counts_by_kind: Dict[str, int] = field(default_factory=dict)
+    comm_bytes: float = 0.0       # per device (SPMD-partitioned module)
+    comm_time_est_s: float = 0.0  # comm_bytes / peak ICI (estimate)
+    collectives: int = 0          # tracked-kind collective instructions
+    overlappable: int = 0         # ...with >= 1 independent compute partner
+    serialized: int = 0           # ...with none (exposed comm)
+    pairs: int = 0                # independent (collective, compute) pairs
+    num_devices: int = 1
+
+    def to_doc(self) -> Dict[str, Any]:
+        return {
+            "site": self.site,
+            "bucket": self.bucket,
+            "bytes_by_kind": dict(self.bytes_by_kind),
+            "counts_by_kind": dict(self.counts_by_kind),
+            "comm_bytes": self.comm_bytes,
+            "comm_time_est_s": self.comm_time_est_s,
+            "collectives": self.collectives,
+            "overlappable": self.overlappable,
+            "serialized": self.serialized,
+            "pairs": self.pairs,
+            "num_devices": self.num_devices,
+        }
+
+
+def analyze_hlo_comm(hlo_text: str) -> Dict[str, Any]:
+    """Byte + dependency census over one HLO module's text (pure parsing,
+    backend-free). Returns the :class:`CommCost` field dict."""
+    from veomni_tpu.utils.overlap_evidence import (
+        ALL_COLLECTIVES,
+        collective_bytes_census,
+        overlap_report,
+    )
+
+    bc = collective_bytes_census(hlo_text)
+    bytes_by_kind = {k: v["bytes"] for k, v in bc.items()}
+    counts_by_kind = {k: int(v["count"]) for k, v in bc.items()}
+    total = sum(bytes_by_kind.values())
+    # dependency census over ALL tracked kinds (the offline script's default
+    # was the async-lowering subset; for exposure accounting every kind
+    # GSPMD inserted matters)
+    rep = overlap_report(hlo_text, collective_ops=ALL_COLLECTIVES)
+    return {
+        "bytes_by_kind": bytes_by_kind,
+        "counts_by_kind": counts_by_kind,
+        "comm_bytes": total,
+        "collectives": rep.collectives,
+        "overlappable": rep.overlappable,
+        "serialized": max(0, rep.collectives - rep.overlappable),
+        "pairs": rep.pairs,
+    }
+
+
+class CommCensus:
+    """Thread-safe (site, bucket) -> :class:`CommCost` map; records happen
+    once per compile (cold path) and publish the ``comm.*`` gauges."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple[str, str], CommCost] = {}
+        self._registry = registry
+
+    def _reg(self) -> MetricsRegistry:
+        return self._registry or get_registry()
+
+    def record(self, site: str, bucket: str, *, num_devices: int = 1,
+               **fields: Any) -> CommCost:
+        reg = self._reg()
+        with self._lock:
+            rec = self._programs.get((site, bucket))
+            fresh = rec is None
+            if fresh:
+                rec = CommCost(site=site, bucket=bucket)
+                self._programs[(site, bucket)] = rec
+            for k, v in fields.items():
+                if hasattr(rec, k):
+                    setattr(rec, k, v)
+            rec.num_devices = max(1, int(num_devices))
+            try:
+                from veomni_tpu.utils.device import (
+                    get_device_peak_interconnect_bandwidth,
+                )
+
+                rec.comm_time_est_s = (
+                    rec.comm_bytes / get_device_peak_interconnect_bandwidth()
+                )
+            except Exception:  # no backend yet: bytes stand alone
+                rec.comm_time_est_s = 0.0
+        # registry publication outside the census lock (same discipline as
+        # the cost census); bucket-carrying names stay bounded by the pow2
+        # bucket discipline of the instrumented sites. Names spell the
+        # "comm." family literally so the doc-drift gate's call-site scan
+        # (tests/test_flight_recorder.py) sees it.
+        for kind in COMM_KINDS:
+            reg.gauge(
+                f"comm.{site}.{bucket}.bytes_{_gauge_kind(kind)}"
+            ).set(rec.bytes_by_kind.get(kind, 0.0))
+        reg.gauge(f"comm.{site}.{bucket}.comm_bytes").set(rec.comm_bytes)
+        reg.gauge(f"comm.{site}.{bucket}.comm_time_est_s").set(
+            rec.comm_time_est_s
+        )
+        reg.gauge(f"comm.{site}.{bucket}.collectives").set(rec.collectives)
+        reg.gauge(f"comm.{site}.{bucket}.overlappable").set(rec.overlappable)
+        reg.gauge(f"comm.{site}.{bucket}.serialized").set(rec.serialized)
+        reg.gauge(f"comm.{site}.{bucket}.pairs").set(rec.pairs)
+        if fresh:
+            reg.counter("comm.programs").inc()
+        if rec.collectives:
+            logger.info_rank0(
+                "comm census: %s/%s — %d collectives (%d overlappable, "
+                "%d serialized), %.3g MB/device, est %.3g ms at peak ICI",
+                site, bucket, rec.collectives, rec.overlappable,
+                rec.serialized, rec.comm_bytes / 1e6,
+                rec.comm_time_est_s * 1e3,
+            )
+        return rec
+
+    def get(self, site: str, bucket: str) -> Optional[CommCost]:
+        with self._lock:
+            return self._programs.get((site, bucket))
+
+    def programs(self, site: Optional[str] = None) -> List[CommCost]:
+        with self._lock:
+            return [
+                rec for (s, _b), rec in self._programs.items()
+                if site is None or s == site
+            ]
+
+    def snapshot(self) -> Dict[str, Any]:
+        progs = [rec.to_doc() for rec in self.programs()]
+        return {
+            "programs": progs,
+            "totals": {
+                "programs": len(progs),
+                "comm_bytes": sum(p["comm_bytes"] for p in progs),
+                "serialized": sum(p["serialized"] for p in progs),
+            },
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs.clear()
+
+
+_GLOBAL: Optional[CommCensus] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_comm_census() -> CommCensus:
+    """The process-wide comm census the instrumented jit sites record into."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = CommCensus()
+    return _GLOBAL
+
+
+def _compiled_text(compiled) -> str:
+    texts = compiled.as_text()
+    if isinstance(texts, (list, tuple)):
+        return "\n".join(texts)
+    return texts or ""
+
+
+def maybe_comm_census(site: str, bucket: str, compiled,
+                      num_devices: int) -> Dict[str, float]:
+    """Comm-census hook for ``cost.InstrumentedJit``'s compile branch: parse
+    the already-compiled program's HLO (no extra compile), record the
+    :class:`CommCost`, and return the fields the cost census folds into its
+    own :class:`ProgramCost` (``comm_bytes`` — the roofline/window input).
+    Fail-open: any surprise returns ``{}`` and the compile proceeds
+    comm-census-blind."""
+    if not comm_census_enabled():
+        return {}
+    try:
+        text = _compiled_text(compiled)
+        if not text:
+            return {}
+        fields = analyze_hlo_comm(text)
+        rec = get_comm_census().record(
+            site, bucket, num_devices=num_devices, **fields
+        )
+        return {"comm_bytes": rec.comm_bytes}
+    except Exception as e:
+        logger.debug("comm census skipped for %s/%s: %s", site, bucket, e)
+        return {}

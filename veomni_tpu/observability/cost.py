@@ -201,6 +201,9 @@ class ProgramCost:
     argument_bytes: float = 0.0
     output_bytes: float = 0.0
     generated_code_bytes: float = 0.0
+    comm_bytes: float = 0.0       # per device, collective result payloads
+                                  # (observability/comm.py rides the same
+                                  # compile; full breakdown lives there)
     compile_time_s: float = 0.0
     num_devices: int = 1
     calls: int = 0                # invocations (all compiles of this bucket)
@@ -214,8 +217,12 @@ class ProgramCost:
         return self.flops / self.bytes_accessed if self.bytes_accessed else 0.0
 
     def bound(self) -> str:
-        """Roofline verdict vs the machine balance: ``compute`` |
-        ``bandwidth`` | ``unknown`` (no analysis / no backend yet)."""
+        """Roofline verdict: ``compute`` | ``bandwidth`` | ``comm`` |
+        ``unknown`` (no analysis / no backend yet). Compute and HBM stay the
+        classic intensity-vs-machine-balance comparison; ``comm`` wins when
+        the program's estimated collective time (comm census bytes over the
+        ICI peak) exceeds both device-local times — i.e. a kernel PR should
+        look at overlap/sharding, not the MXU."""
         if not self.flops or not self.bytes_accessed:
             return "unknown"
         try:
@@ -224,10 +231,24 @@ class ProgramCost:
                 get_device_peak_flops,
             )
 
-            balance = get_device_peak_flops() / get_device_peak_bandwidth()
+            t_compute = self.flops / get_device_peak_flops()
+            t_mem = self.bytes_accessed / get_device_peak_bandwidth()
         except Exception:
             return "unknown"
-        return "compute" if self.intensity >= balance else "bandwidth"
+        if self.comm_bytes:
+            try:
+                from veomni_tpu.utils.device import (
+                    get_device_peak_interconnect_bandwidth,
+                )
+
+                t_comm = (
+                    self.comm_bytes / get_device_peak_interconnect_bandwidth()
+                )
+                if t_comm > t_compute and t_comm > t_mem:
+                    return "comm"
+            except Exception:
+                pass
+        return "compute" if t_compute >= t_mem else "bandwidth"
 
     def to_doc(self) -> Dict[str, Any]:
         return {
@@ -241,6 +262,7 @@ class ProgramCost:
             "argument_bytes": self.argument_bytes,
             "output_bytes": self.output_bytes,
             "generated_code_bytes": self.generated_code_bytes,
+            "comm_bytes": self.comm_bytes,
             "compile_time_s": self.compile_time_s,
             "num_devices": self.num_devices,
             "calls": self.calls,
@@ -457,7 +479,7 @@ class CostWindow:
         now = time.perf_counter()
         wall = max(now - self._t0, 1e-9)
         cur = self.census.call_counts()
-        flops = bytes_acc = 0.0
+        flops = bytes_acc = comm_bytes = 0.0
         ran = 0
         for key, calls in cur.items():
             if self.sites is not None and key[0] not in self.sites:
@@ -470,6 +492,7 @@ class CostWindow:
             if rec is not None:
                 flops += delta * rec.flops
                 bytes_acc += delta * rec.bytes_accessed
+                comm_bytes += delta * rec.comm_bytes
         if not ran:
             # no instrumented program ran: re-arm and make no utilization
             # statement (the degenerate train-end window must not overwrite
@@ -480,17 +503,26 @@ class CostWindow:
             from veomni_tpu.utils.device import (
                 get_device_peak_bandwidth,
                 get_device_peak_flops,
+                get_device_peak_interconnect_bandwidth,
             )
 
             peak_flops = get_device_peak_flops()
             peak_bw = get_device_peak_bandwidth()
+            peak_ici = get_device_peak_interconnect_bandwidth()
         except Exception:  # no backend yet: report achieved, not utilization
-            peak_flops = peak_bw = float("inf")
+            peak_flops = peak_bw = peak_ici = float("inf")
         out = {
             "mfu_pct": 100.0 * flops / wall / peak_flops,
             "bandwidth_util_pct": 100.0 * bytes_acc / wall / peak_bw,
             "census_tflops_s": flops / wall / 1e12,
             "census_window_s": wall,
+            # estimated share of window wall the programs' collectives would
+            # take UNHIDDEN (comm census bytes / peak ICI): an exposure
+            # *estimate* reported alongside the goodput split — it overlaps
+            # the dispatch/other fractions and is deliberately not part of
+            # their sum-to-1 set (observability/comm.py)
+            "comm_est_frac": min(1.0, comm_bytes / peak_ici / wall)
+            if peak_ici != float("inf") else 0.0,
         }
         self._t0, self._base = now, cur
         return out
@@ -645,6 +677,21 @@ class InstrumentedJit:
                     fields = analyze_compiled(compiled)
                     if traced is not None:
                         fields = apply_scan_correction(traced, fields, ndev)
+                    # comm observatory (observability/comm.py): parse the
+                    # ALREADY-compiled program's HLO for the collective
+                    # census — zero extra compiles, fail-open, and the
+                    # comm_bytes field rides into this ProgramCost so the
+                    # roofline verdict can say "comm"-bound
+                    try:
+                        from veomni_tpu.observability.comm import (
+                            maybe_comm_census,
+                        )
+
+                        fields.update(maybe_comm_census(
+                            self._site, bucket, compiled, ndev
+                        ))
+                    except Exception as e:
+                        logger.debug("comm census unavailable: %s", e)
                     self._census.record(
                         self._site, bucket,
                         compile_time_s=dt,

@@ -9,8 +9,11 @@ bounds the tail); ``/debug/requests`` the serving engine's in-flight
 request timelines (``requests_fn``); ``/debug/memory`` the live buffer
 census + HBM watermark (plus the KV pool capacity document when
 ``memory_fn`` is wired — ``scripts/serve.py`` passes the engine's
-``kv_capacity``); and ``/debug/cost`` the compiled-program cost census
-with a scrape-to-scrape live MFU window. Usable by both the trainer
+``kv_capacity``); ``/debug/cost`` the compiled-program cost census
+with a scrape-to-scrape live MFU window; and ``/debug/fleet`` the
+cross-rank view (per-rank step-time skew table, heartbeat freshness,
+collective census — ``fleet_fn`` or the process's active
+``FleetMonitor``). Usable by both the trainer
 (``train.observability_port`` / ``VEOMNI_METRICS_PORT``) and
 ``serving.InferenceEngine`` (``scripts/serve.py``).
 """
@@ -25,6 +28,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 from veomni_tpu.observability.metrics import (
+    SLO_BUCKET_BOUNDS,
     Counter,
     Gauge,
     Histogram,
@@ -42,10 +46,25 @@ def _prom_name(name: str) -> str:
     return "veomni_" + _NAME_RE.sub("_", name)
 
 
+#: families additionally rendered as NATIVE Prometheus histograms
+#: (`<name>_hist_bucket{le=...}`): the serving latency SLOs need
+#: `histogram_quantile(0.99, rate(..._hist_bucket[5m]))` to work in
+#: PromQL — the summary's fixed p50/p95 quantiles can't answer a p99
+#: query. Rendered under a `_hist` sibling name because one metric name
+#: cannot be both TYPE summary and TYPE histogram. The bounds table lives
+#: in metrics.py (SLO_BUCKET_BOUNDS) so the registry attaches EXACT
+#: per-bucket counters at observe() time — rate() over these series needs
+#: monotone counters, which a reservoir estimate cannot promise across
+#: scrapes. The bounds themselves (LATENCY_BUCKETS) live in metrics.py.
+NATIVE_HISTOGRAM_FAMILIES = SLO_BUCKET_BOUNDS
+
+
 def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
     """Registry -> Prometheus text format. Counters/gauges map directly;
     histograms render as summaries (quantile labels + _sum/_count) plus a
-    ``_max`` gauge (p100 is the stall-hunting number quantiles smear)."""
+    ``_max`` gauge (p100 is the stall-hunting number quantiles smear); the
+    families in :data:`NATIVE_HISTOGRAM_FAMILIES` additionally render as
+    native cumulative-bucket histograms for PromQL quantile queries."""
     reg = registry or get_registry()
     rank = str(reg.rank())
     lines = []
@@ -70,6 +89,20 @@ def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
             if "max" in snap:
                 lines.append(f"# TYPE {pname}_max gauge")
                 lines.append(f'{pname}_max{{rank="{rank}"}} {snap["max"]}')
+            bounds = NATIVE_HISTOGRAM_FAMILIES.get(name)
+            if bounds is not None:
+                hname = f"{pname}_hist"
+                lines.append(f"# TYPE {hname} histogram")
+                for le, count in m.cumulative_buckets(bounds):
+                    le_txt = le if le == "+Inf" else repr(float(le))
+                    lines.append(
+                        f'{hname}_bucket{{rank="{rank}",le="{le_txt}"}} '
+                        f"{count}"
+                    )
+                lines.append(f'{hname}_sum{{rank="{rank}"}} {snap["sum"]}')
+                lines.append(
+                    f'{hname}_count{{rank="{rank}"}} {int(snap["count"])}'
+                )
     return "\n".join(lines) + "\n"
 
 
@@ -85,7 +118,8 @@ class MetricsExporter:
                  registry: Optional[MetricsRegistry] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
                  requests_fn: Optional[Callable[[], Dict]] = None,
-                 memory_fn: Optional[Callable[[], Dict]] = None):
+                 memory_fn: Optional[Callable[[], Dict]] = None,
+                 fleet_fn: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.registry = registry  # None -> resolve the global lazily
@@ -96,6 +130,9 @@ class MetricsExporter:
         # serving wires InferenceEngine.kv_capacity here; /debug/memory
         # serves the buffer census either way
         self.memory_fn = memory_fn
+        # the trainer wires FleetMonitor.debug_doc; unwired, /debug/fleet
+        # falls back to the process's active monitor (fleet.debug_fleet_doc)
+        self.fleet_fn = fleet_fn
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -177,6 +214,17 @@ class MetricsExporter:
                         doc = debug_cost_doc()
                         self._send(200, json.dumps(doc, default=str).encode(),
                                    "application/json")
+                    elif route == "/debug/fleet":
+                        if exporter.fleet_fn is not None:
+                            doc = dict(exporter.fleet_fn())
+                        else:
+                            from veomni_tpu.observability.fleet import (
+                                debug_fleet_doc,
+                            )
+
+                            doc = debug_fleet_doc()
+                        self._send(200, json.dumps(doc, default=str).encode(),
+                                   "application/json")
                     else:
                         self._send(404, b"not found", "text/plain")
                 except Exception as e:  # a broken scrape must not kill us
@@ -225,12 +273,14 @@ def maybe_start_from_env(registry: Optional[MetricsRegistry] = None,
                          config_port: int = 0,
                          requests_fn: Optional[Callable[[], Dict]] = None,
                          memory_fn: Optional[Callable[[], Dict]] = None,
+                         fleet_fn: Optional[Callable[[], Dict]] = None,
                          ) -> Optional[MetricsExporter]:
     """Start an exporter iff configured; returns it (caller owns stop())."""
     port = resolve_port(config_port)
     if port is None:
         return None
     exp = MetricsExporter(port=port, registry=registry, health_fn=health_fn,
-                          requests_fn=requests_fn, memory_fn=memory_fn)
+                          requests_fn=requests_fn, memory_fn=memory_fn,
+                          fleet_fn=fleet_fn)
     exp.start()
     return exp

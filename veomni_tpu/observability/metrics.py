@@ -37,6 +37,24 @@ from veomni_tpu.utils.logging import _process_index, get_logger
 
 logger = get_logger(__name__)
 
+#: latency-style bucket bounds (seconds) for native-histogram rendering
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: families the exporter renders as NATIVE Prometheus histograms
+#: (`<name>_hist_bucket{le=...}`). The registry auto-attaches these bounds
+#: at creation so the bucket counts are EXACT counters maintained at
+#: observe() time — a reservoir-scaled estimate would not be monotone
+#: non-decreasing across scrapes, and PromQL `rate()` reads any decrease
+#: as a counter reset (spurious p99 spikes on exactly the SLO queries the
+#: native render exists to serve).
+SLO_BUCKET_BOUNDS: Dict[str, tuple] = {
+    "serve.ttft_s": LATENCY_BUCKETS,
+    "serve.tpot_s": LATENCY_BUCKETS,
+}
+
 
 class Counter:
     """Monotonic counter. ``inc`` of a negative amount is rejected."""
@@ -97,9 +115,11 @@ class Histogram:
     exact replay drills stay reproducible)."""
 
     __slots__ = ("name", "_lock", "_samples", "_max_samples", "_count",
-                 "_sum", "_min", "_max", "_rng")
+                 "_sum", "_min", "_max", "_rng", "_bounds", "_bins")
 
-    def __init__(self, name: str, lock: threading.RLock, max_samples: int = 512):
+    def __init__(self, name: str, lock: threading.RLock,
+                 max_samples: int = 512,
+                 bucket_bounds: Optional[tuple] = None):
         if max_samples < 1:
             raise ValueError("max_samples must be >= 1")
         self.name = name
@@ -113,8 +133,20 @@ class Histogram:
         # crc32, not hash(): str hash is salted per process, which would
         # break the cross-restart reproducibility promised above
         self._rng = random.Random(0xC0FFEE ^ zlib.crc32(name.encode()))
+        # optional EXACT bucket accounting (SLO_BUCKET_BOUNDS families):
+        # one bisect + int bump per observe; bins[i] counts values in
+        # (bounds[i-1], bounds[i]], bins[-1] the overflow past every bound
+        self._bounds = (
+            tuple(sorted(float(b) for b in bucket_bounds))
+            if bucket_bounds else None
+        )
+        self._bins: List[int] = (
+            [0] * (len(self._bounds) + 1) if self._bounds else []
+        )
 
     def observe(self, value: float) -> None:
+        import bisect
+
         value = float(value)
         with self._lock:
             self._count += 1
@@ -123,6 +155,8 @@ class Histogram:
                 self._min = value
             if value > self._max:
                 self._max = value
+            if self._bounds is not None:
+                self._bins[bisect.bisect_left(self._bounds, value)] += 1
             if len(self._samples) < self._max_samples:
                 self._samples.append(value)
             else:
@@ -146,6 +180,47 @@ class Histogram:
             ordered = sorted(self._samples)
             idx = min(len(ordered) - 1, max(0, round(q / 100.0 * (len(ordered) - 1))))
             return ordered[idx]
+
+    def cumulative_buckets(self, bounds) -> List[tuple]:
+        """Prometheus-native cumulative bucket counts ``[(le, count), ...,
+        ("+Inf", total)]`` over ascending ``bounds``.
+
+        When ``bounds`` are the histogram's attached bucket bounds (every
+        ``SLO_BUCKET_BOUNDS`` family), counts come from EXACT per-bin
+        counters maintained at observe() time: monotone non-decreasing
+        across scrapes at any observation count, as PromQL's ``rate()``
+        over ``_bucket`` series requires. For ad-hoc bounds the reservoir
+        fraction at or under each bound is scaled to the exact total — an
+        estimate (same approximation as the p50/p95 summary), monotone
+        within one call but NOT across scrapes once the reservoir churns;
+        don't feed it to rate()."""
+        import bisect
+
+        want = tuple(sorted(float(b) for b in bounds))
+        with self._lock:
+            if self._bounds is not None and want == self._bounds:
+                running, out = 0, []
+                for le, n in zip(self._bounds, self._bins):
+                    running += n
+                    out.append((le, running))
+                out.append(("+Inf", int(self._count)))
+                return out
+            ordered = sorted(self._samples)
+            total = self._count
+        out = []
+        n_res = len(ordered)
+        for le in want:
+            if n_res:
+                frac = bisect.bisect_right(ordered, float(le)) / n_res
+            else:
+                frac = 0.0
+            out.append((float(le), int(round(frac * total))))
+        # cumulative counts must be monotone even under scaling round-off
+        for i in range(1, len(out)):
+            if out[i][1] < out[i - 1][1]:
+                out[i] = (out[i][0], out[i - 1][1])
+        out.append(("+Inf", int(total)))
+        return out
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
@@ -197,7 +272,12 @@ class MetricsRegistry:
         return self._get_or_create(name, Gauge)
 
     def histogram(self, name: str, max_samples: int = 512) -> Histogram:
-        return self._get_or_create(name, Histogram, max_samples=max_samples)
+        # SLO families get exact native-bucket counters attached at birth
+        # (see SLO_BUCKET_BOUNDS); everyone else stays reservoir-only
+        return self._get_or_create(
+            name, Histogram, max_samples=max_samples,
+            bucket_bounds=SLO_BUCKET_BOUNDS.get(name),
+        )
 
     def get(self, name: str):
         return self._metrics.get(name)

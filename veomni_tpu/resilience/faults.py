@@ -26,6 +26,11 @@ Fault points wired through the stack:
 ``data.record`` per streaming record read, BEFORE decode (context: the shard
                 file) — the ``corrupt`` drill point for poisoned data records
 ``step.loss``   host-side observation of the train step's finite-loss flag
+``step.delay``  once per trainer-loop iteration, host side, before dispatch —
+                the ``delay`` drill point: a straggler (one rank slower than
+                the fleet) is injected deterministically so the fleet
+                observatory's skew detection runs under JAX_PLATFORMS=cpu in
+                tier-1 like every other recovery path
 ==============  ==============================================================
 
 Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
@@ -42,12 +47,16 @@ Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
   ``OSError`` so the retry layer treats it as I/O), ``nan`` (returns a
   :class:`FaultAction` the site applies — poisons the observed loss signal),
   ``hang`` (sleeps ``seconds`` — bounded, so a watchdog test can't wedge CI),
+  ``delay`` (sleeps ``ms`` milliseconds then returns normally — a
+  deterministic *slowdown*, not a stall: the straggler-drill primitive, with
+  the same hit/times windowing as every other mode),
   ``corrupt`` (damages a file ON DISK — deterministic truncate-or-bitflip —
   then returns normally: the *later* read of those bytes is what fails, like
   real storage rot);
 * ``hit``     1-based hit index at which the fault starts firing (default 1);
 * ``times``   consecutive hits that fire from ``hit`` on (default 1);
 * ``seconds`` hang duration (default 30);
+* ``ms``      delay duration in milliseconds (default 50);
 * ``message`` exception text override;
 * ``op``      corrupt only: ``bitflip`` (default; XOR 0xFF one byte in place
   — same size, only a ``full`` digest verify catches it) or ``truncate``
@@ -79,9 +88,9 @@ logger = get_logger(__name__)
 ENV_PLAN = "VEOMNI_FAULT_PLAN"
 
 KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "ckpt.reshard",
-                "data.fetch", "data.record", "step.loss")
+                "data.fetch", "data.record", "step.loss", "step.delay")
 
-_MODES = ("exception", "nan", "hang", "corrupt")
+_MODES = ("exception", "nan", "hang", "delay", "corrupt")
 
 _CORRUPT_OPS = ("bitflip", "truncate")
 
@@ -113,6 +122,7 @@ class _FaultSpec:
     hit: int = 1
     times: int = 1
     seconds: float = 30.0
+    ms: float = 50.0
     message: str = ""
     op: str = "bitflip"
     file: str = ""
@@ -172,6 +182,7 @@ def _parse_specs(raw: Any) -> List[_FaultSpec]:
             hit=int(entry.get("hit", 1)),
             times=int(entry.get("times", 1)),
             seconds=float(entry.get("seconds", 30.0)),
+            ms=float(entry.get("ms", 50.0)),
             message=str(entry.get("message", "")),
             op=op,
             file=str(entry.get("file", "")),
@@ -292,8 +303,10 @@ def fault_point(name: str,
 
     Armed: bumps the point's hit counter; if a spec covers this hit, applies
     the action — ``exception`` raises :class:`InjectedFault`, ``hang`` sleeps
-    (bounded) then returns the action, ``nan`` returns the action for the
-    call site to apply, ``corrupt`` damages the resolved file on disk and
+    (bounded) then returns the action, ``delay`` sleeps ``ms`` milliseconds
+    (a deterministic slowdown for straggler drills) then returns the action,
+    ``nan`` returns the action for the call site to apply, ``corrupt``
+    damages the resolved file on disk and
     returns (the later READ of those bytes is the failure, like real rot).
     ``context`` is site-supplied corruption scope: ``{"dir": step_dir}`` or
     ``{"file": shard_path}``. Returns None when nothing fired.
@@ -332,6 +345,8 @@ def fault_point(name: str,
             )
         if spec.mode == "hang":
             time.sleep(spec.seconds)
+        if spec.mode == "delay":
+            time.sleep(spec.ms / 1000.0)
         if spec.mode == "corrupt":
             _apply_corruption(spec, action.target)
         return action

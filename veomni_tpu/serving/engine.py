@@ -203,6 +203,12 @@ class InferenceEngine:
         if ec.recompile_warmup_ticks > 0:
             from veomni_tpu.observability.goodput import RecompileDetector
 
+            # the WHOLE trace-count dict is watched (no key filter): every
+            # engine-side compile counter — including the chunked-prefill
+            # one, TRACE_COUNTS["paged_prefill"], and any counter a future
+            # prefill/decode path adds — is storm-detected without anyone
+            # remembering to extend a key list. Chunked-prefill coverage is
+            # pinned by a regression test (test_fleet_observatory.py).
             self._recompile_detector = RecompileDetector(
                 [("serve_decode", decode_mod.TRACE_COUNTS)], registry=reg,
             )

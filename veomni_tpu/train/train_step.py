@@ -200,7 +200,12 @@ def build_train_step(
     # cost census (observability/cost.py): the jit site's compiles flow
     # through an AOT lower/compile pair that records XLA cost_analysis /
     # memory_analysis / compile wall-time per batch-shape bucket — the
-    # attribution substrate behind the train.mfu_pct window gauge. Identity
+    # attribution substrate behind the train.mfu_pct window gauge. The comm
+    # observatory (observability/comm.py) rides the same compile: the
+    # partitioned program's HLO is parsed once for the per-kind collective
+    # byte census + overlappable/serialized pair counts behind the
+    # comm.train_step.* gauges and the comm_est_frac window metric — no
+    # extra compiles, so the trace-count gates stay green. Identity
     # under VEOMNI_COST_CENSUS=0; any census failure falls back to the
     # plain jit call permanently.
     from veomni_tpu.observability.cost import instrument_jit

@@ -724,7 +724,7 @@ class BaseTrainer:
             SupervisorPolicy,
             TrainSupervisor,
         )
-        from veomni_tpu.resilience.faults import arm_from_env
+        from veomni_tpu.resilience.faults import arm_from_env, fault_point
         from veomni_tpu.resilience.supervisor import AnomalyBudgetExceeded, worse_verdict
         from veomni_tpu.utils.helper import Watchdog
 
@@ -796,6 +796,12 @@ class BaseTrainer:
                                     break  # prefetcher closed by the handler
                                 raise
                             self.current_batch = batch_np
+                            # straggler drill point (fleet observatory): a
+                            # `delay`-mode fault here slows THIS rank's loop
+                            # deterministically, so the skew exchange +
+                            # straggler warning run under JAX_PLATFORMS=cpu
+                            # in tier-1. Unarmed: one None check.
+                            fault_point("step.delay")
                             with span("host.callbacks"):
                                 self._fire("on_step_begin", ctl)
                             # each process holds [A, B_local, S]; stitch into

@@ -129,6 +129,38 @@ def get_device_peak_bandwidth() -> float:
     return 819e9
 
 
+@functools.lru_cache(maxsize=None)
+def get_device_peak_interconnect_bandwidth() -> float:
+    """Nominal per-chip aggregate ICI bandwidth in bytes/s (same table
+    discipline as :func:`get_device_peak_flops`).
+
+    Feeds the comm observatory's predicted-comm-time gauges and the
+    ``comm``-bound extension of the roofline verdict
+    (``observability/comm.py``). These are order-of-magnitude link-budget
+    numbers (links x per-link one-way bandwidth, torus generations assume
+    the full link complement), not measured all-reduce goodput — the
+    estimate they produce says *where to look*, it is not an SLA."""
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    table = {
+        "tpu v2": 100e9,
+        "tpu v3": 140e9,
+        "tpu v4": 270e9,        # 6 links x 45 GB/s (3D torus)
+        "tpu v5 lite": 180e9,   # v5e: 4 links x 45 GB/s (2D torus)
+        "tpu v5e": 180e9,
+        "tpu v5": 540e9,        # v5p: 6 links x 90 GB/s
+        "tpu v5p": 540e9,
+        "tpu v6 lite": 360e9,   # trillium: 4 links x 90 GB/s
+        "tpu v6e": 360e9,
+        "tpu7x": 1200e9,
+    }
+    for key in sorted(table, key=len, reverse=True):
+        if kind.startswith(key):
+            return table[key]
+    if get_device_type() == "cpu":
+        return 1e10  # nominal, keeps comm-time estimates finite in tests
+    return 180e9
+
+
 def mesh_devices_grid(shape: Tuple[int, ...]):
     """Devices reshaped to ``shape`` for building a Mesh; validates count."""
     import numpy as np

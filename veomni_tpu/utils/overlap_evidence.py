@@ -103,11 +103,233 @@ def analyze_scheduled_dump(dump_dir: str) -> List[AsyncPair]:
 
 
 def collective_census(hlo_text: str) -> Dict[str, int]:
-    """Count GSPMD-inserted collectives by op in one HLO module's text."""
-    census: Dict[str, int] = {}
-    for op in ALL_COLLECTIVES:
-        census[op] = len(re.findall(rf"= \S* {op}\(|{op}\.", hlo_text))
-    return census
+    """Executed collectives by op per step in HLO text. Delegates to
+    :func:`collective_bytes_census` (trip-count-weighted, `-done`-deduped)
+    so the two censuses can never disagree on what a collective is."""
+    return {
+        op: int(rec["count"])
+        for op, rec in collective_bytes_census(hlo_text).items()
+    }
+
+
+# HLO element-type -> bytes per element (the types XLA actually emits in
+# optimized modules; tokens and opaque types carry no payload and drop out)
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1, "f8e5m2": 1,
+    "f8e4m3fnuz": 1, "f8e5m2fnuz": 1, "f8e8m0fnu": 1, "f8e3m4": 1,
+    "s32": 4, "u32": 4, "f32": 4, "c64": 8,
+    "s64": 8, "u64": 8, "f64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+# one collective instruction: `<shape(s)> <op>(` — optimized CPU modules
+# emit the synchronous form, scheduled TPU modules async `-start`/`-done`
+# pairs. Async collectives are counted at the `-done` half and the `-start`
+# is skipped: a start's result tuple carries input aliases and u32 context
+# words whose split differs per kind (all-gather's output is its LARGEST
+# leaf, reduce-scatter's its smallest), while the done's result is exactly
+# the output payload for EVERY kind — including XLA's combiner-fused
+# variadic all-reduce, whose done is the plain ``(out...)`` tuple. The
+# shape group admits one level of tuple nesting for those variadic forms.
+_COLLECTIVE_INSTR_RE = re.compile(
+    r"=\s*(\((?:[^()]|\([^()]*\))*\)|\S+)\s+(" + "|".join(ALL_COLLECTIVES)
+    + r")(-done)?\("
+)
+
+
+def _shape_leaf_bytes(shape_text: str) -> List[float]:
+    """Per-leaf payload bytes of an HLO shape string:
+    ``f32[4,128]{1,0}`` -> [2048], ``(bf16[64]{0}, u32[2])`` -> [128, 8]."""
+    leaves: List[float] = []
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        leaves.append(float(size * n))
+    return leaves
+
+
+def _flat_bytes_census(text: str) -> Dict[str, Dict[str, float]]:
+    """Unweighted per-kind census over a block of instruction text."""
+    out: Dict[str, Dict[str, float]] = {
+        op: {"count": 0, "bytes": 0.0} for op in ALL_COLLECTIVES
+    }
+    for m in _COLLECTIVE_INSTR_RE.finditer(text):
+        shape_text, op = m.group(1), m.group(2)
+        # `-start` never matches (after the op name comes `-start(`, which
+        # the `(-done)?\(` tail rejects), so each async pair is counted
+        # exactly once, at its `-done` — whose result is the pure output
+        # payload. Sync forms match with group(3) empty.
+        out[op]["count"] += 1
+        out[op]["bytes"] += sum(_shape_leaf_bytes(shape_text))
+    return out
+
+
+# called-computation references on an instruction line (attribute forms
+# only — matching bare %refs would confuse instruction operands with
+# computation names) + the while loop's statically-known trip count, which
+# XLA stamps into the instruction's backend_config for scan-lowered loops
+_COMP_REF_RE = re.compile(r"(to_apply|calls|condition|body)=%([\w.\-]+)")
+_COMP_LIST_RE = re.compile(
+    r"(?:branch_computations|called_computations)=\{([^}]*)\}"
+)
+# 2-branch PRED conditionals print as true_computation=/false_computation=
+# (the index form uses branch_computations); both are one-of-branches
+_COMP_TF_RE = re.compile(r"(?:true|false)_computation=%([\w.\-]+)")
+_WHILE_TRIP_RE = re.compile(r'"known_trip_count"\s*:\s*\{\s*"n"\s*:\s*"(\d+)"')
+
+
+def _split_modules(hlo_text: str) -> List[str]:
+    """Split concatenated HLO text into per-module chunks on ``HloModule``
+    headers. ``compiled.as_text()`` returns a LIST of module texts on some
+    jax versions and the joiners concatenate them — each module has its own
+    ENTRY and identically-named computations, so any name-keyed parse must
+    happen per module or later modules silently shadow earlier ones."""
+    chunks: List[str] = []
+    cur: List[str] = []
+    for line in hlo_text.splitlines():
+        if line.startswith("HloModule") and cur:
+            chunks.append("\n".join(cur))
+            cur = []
+        cur.append(line)
+    if cur:
+        chunks.append("\n".join(cur))
+    return chunks
+
+
+def _parse_module(hlo_text: str):
+    """-> (entry_name | None, {computation_name: [instruction lines]}) for
+    ONE module's text (names are unique within a module; use
+    :func:`_split_modules` first on concatenated text)."""
+    comps: Dict[str, List[str]] = {}
+    entry = None
+    name = None
+    body: List[str] = []
+    for line in hlo_text.splitlines():
+        if name is None:
+            if line and not line[0].isspace() and line.rstrip().endswith("{"):
+                m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)", line)
+                name = m.group(1) if m else "<anon>"
+                if line.lstrip().startswith("ENTRY"):
+                    entry = name
+                body = []
+            continue
+        if line.startswith("}"):
+            comps[name] = body
+            name = None
+            continue
+        if " = " in line:
+            body.append(line)
+    return entry, comps
+
+
+def collective_bytes_census(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Per-collective-kind ``{"count": n, "bytes": b}`` over one HLO
+    module's text — **per executed step**, from each collective
+    instruction's RESULT shape, weighted by loop trip counts.
+
+    This is the byte-level refinement of :func:`collective_census` the live
+    comm observatory (``observability/comm.py``) publishes as
+    ``comm.{site}.{bucket}.*`` gauges: on an SPMD-partitioned module the
+    shapes are per-device, so the bytes are per-device too — the same units
+    as the cost census's FLOPs/bytes-accessed. The number is the payload a
+    collective's result materializes, not the wire traffic of a particular
+    algorithm (a ring all-reduce moves ~2x(n-1)/n of it); it feeds an
+    order-of-magnitude comm-time *estimate* against
+    ``utils/device.py::get_device_peak_interconnect_bandwidth``, not an SLA.
+
+    Trip-count weighting: a ``lax.scan`` lowers to a while loop whose body
+    is ONE computation in the module text, executed ``n`` times — and every
+    model here scans its stacked layers, so an unweighted census would
+    under-count in-layer collectives (Ulysses all-to-alls, TP all-reduces)
+    L-fold, the same blind spot the cost census corrects for FLOPs. The
+    census walks the computation call graph (``to_apply``/``calls``/
+    ``condition``/``body``/branch lists) and multiplies a while body's
+    contribution by the ``known_trip_count`` XLA stamps into the
+    instruction's ``backend_config``; loops without a static trip count
+    contribute once (uncorrected, matching the cost census's while_loop
+    policy). ``count`` is therefore executed collectives per step, not
+    static instructions.
+
+    Shape accounting: synchronous collectives count their result payload
+    directly (a tuple result is a genuine variadic payload and sums its
+    leaves); async pairs count ONCE, at the ``-done`` half, whose result
+    is exactly the output payload for every kind — a ``-start``'s result
+    tuple mixes input aliases and u32 context words whose layout differs
+    per kind (all-gather's output is its largest leaf, reduce-scatter's
+    its smallest), so parsing starts would break sync/async consistency.
+    """
+    chunks = _split_modules(hlo_text)
+    if len(chunks) > 1:  # concatenated as_text() list: sum per module
+        total = _flat_bytes_census("")
+        for chunk in chunks:
+            for op, rec in collective_bytes_census(chunk).items():
+                total[op]["count"] += rec["count"]
+                total[op]["bytes"] += rec["bytes"]
+        return total
+
+    entry, comps = _parse_module(hlo_text)
+    if entry is None or entry not in comps:
+        return _flat_bytes_census(hlo_text)  # fragment: no module structure
+
+    memo: Dict[str, Dict[str, Dict[str, float]]] = {}
+
+    def _add(acc, sub, mult):
+        for op, rec in sub.items():
+            acc[op]["count"] += rec["count"] * mult
+            acc[op]["bytes"] += rec["bytes"] * mult
+
+    def _total(comp: str, stack: frozenset) -> Dict[str, Dict[str, float]]:
+        if comp in memo:
+            return memo[comp]
+        if comp in stack:  # cycles don't exist in valid HLO; fail safe
+            return _flat_bytes_census("")
+        body = comps.get(comp, [])
+        acc = _flat_bytes_census("\n".join(body))
+        for line in body:
+            trip = 1
+            if " while(" in line:
+                tm = _WHILE_TRIP_RE.search(line)
+                if tm:
+                    trip = int(tm.group(1))
+            for attr, ref in _COMP_REF_RE.findall(line):
+                if ref not in comps:
+                    continue
+                # the trip count applies to the loop BODY; the condition
+                # runs n+1 times but is collective-free in practice — one
+                # visit keeps it from inflating a census it can't feed
+                _add(acc, _total(ref, stack | {comp}),
+                     trip if attr == "body" else 1)
+            branch_sets = [
+                [r.strip().lstrip("%") for r in lst.split(",")]
+                for lst in _COMP_LIST_RE.findall(line)
+            ]
+            tf = _COMP_TF_RE.findall(line)
+            if tf:  # PRED-form conditional: one branch pair
+                branch_sets.append(tf)
+            for branches in branch_sets:
+                branches = [b for b in branches if b in comps]
+                if not branches:
+                    continue
+                # a conditional executes exactly ONE branch per visit:
+                # summing all branches would overstate comm up to k-fold,
+                # so take the heaviest branch as the per-step upper bound
+                heaviest = max(
+                    (_total(b, stack | {comp}) for b in branches),
+                    key=lambda c: sum(v["bytes"] for v in c.values()),
+                )
+                _add(acc, heaviest, 1)
+        memo[comp] = acc
+        return acc
+
+    return _total(entry, frozenset())
 
 
 # --------------------------------------------------------------------------
@@ -122,22 +344,12 @@ _OPERAND_RE = re.compile(r"%([\w.\-]+)")
 def hlo_computations(hlo_text: str) -> Iterator[Tuple[str, List[str]]]:
     """Yield ``(computation_name, instruction_lines)`` per HLO computation
     block (text format: an unindented header ending in ``{``, instructions
-    indented, closed by ``}`` at column 0)."""
-    name = None
-    body: List[str] = []
-    for line in hlo_text.splitlines():
-        if name is None:
-            if line and not line[0].isspace() and line.rstrip().endswith("{"):
-                m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)", line)
-                name = m.group(1) if m else "<anon>"
-                body = []
-            continue
-        if line.startswith("}"):
-            yield name, body
-            name = None
-            continue
-        if " = " in line:
-            body.append(line)
+    indented, closed by ``}`` at column 0). One parser serves both censuses
+    (:func:`_parse_module` additionally reports the ENTRY computation);
+    concatenated multi-module text yields every module's computations."""
+    for chunk in _split_modules(hlo_text):
+        _entry, comps = _parse_module(chunk)
+        yield from comps.items()
 
 
 @dataclass
@@ -203,8 +415,11 @@ def overlap_report(
     rep = OverlapReport()
     for comp_name, body in hlo_computations(hlo_text):
         ops, deps = _parse_computation(body)
+        # a `*-done` op is the tail of an already-counted async collective
+        # (scheduled TPU modules), not a second collective
         colls = [n for n, op in ops.items()
-                 if any(op.startswith(c) for c in collective_ops)]
+                 if any(op.startswith(c) for c in collective_ops)
+                 and not op.endswith("-done")]
         if not colls:
             continue
         users: Dict[str, List[str]] = {}
