@@ -475,6 +475,8 @@ def run_serve_bench(
     shared_prefix: int = 0,
     prefill_chunk: int = 0,
     prefix_cache: bool = True,
+    spec_ks=(),
+    spec_draft: str = "ngram",
 ) -> dict:
     """Continuous-batching inference throughput: N requests with a cycled
     prompt-length mix through the serving engine. Returns decode tokens/s
@@ -487,7 +489,14 @@ def run_serve_bench(
     ``shared_prefix``-token system prompt (the millions-of-users-share-a-
     system-prompt workload) and ALSO drives the same timed request set
     through a cache-off engine, so the JSON line carries TTFT p50/p99 and
-    prefill step counts with the prefix cache on vs off."""
+    prefill step counts with the prefix cache on vs off.
+
+    ``spec_ks`` (BENCH_SERVE_SPEC_K, e.g. ``0,2,4,8``) additionally drives
+    the SAME timed request set through a draft-then-verify engine per k:
+    the sweep records decode tok/s and the verify acceptance rate at each
+    k, with the k=0 run doubling as the ``nospec_*`` baseline — the
+    accepted-tokens-per-verify-width tradeoff curve the ROADMAP's
+    speculative-decoding item regresses against."""
     import jax
     import jax.numpy as jnp
 
@@ -554,7 +563,8 @@ def run_serve_bench(
         # bounded by 1 even under preemption storms
         delta = {k: m1[k] - m0[k]
                  for k in ("prefill_chunks", "cached_tokens",
-                           "prompt_tokens")}
+                           "prompt_tokens", "spec_proposed",
+                           "spec_accepted")}
         return eng, ids, outs, dt, delta
 
     def _pctl(vals, q):
@@ -630,6 +640,52 @@ def run_serve_bench(
         result["nocache_ttft_p50_s"] = _pctl(off_ttfts, 50)
         result["nocache_ttft_p99_s"] = _pctl(off_ttfts, 99)
         result["nocache_prefill_chunks"] = delta_off["prefill_chunks"]
+    if spec_ks:
+        # the SAME timed request set per draft length k: the k=0 run is the
+        # nospec baseline (mirrors the nocache_* pattern above), the rest
+        # trace the accepted-tokens-vs-verify-width curve
+        sweep = []
+        for k in spec_ks:
+            if int(k) == 0:
+                # spec_k=0 IS the main (speculation-off) drive above —
+                # reuse its measurement instead of re-running warmup + the
+                # whole timed set for a byte-identical engine
+                entry = {
+                    "spec_k": 0,
+                    "decode_tok_s": result["decode_tok_s"],
+                    "spec_acceptance_rate": 0.0,
+                    "spec_accepted_tokens": 0.0,
+                    "tpot_p50_s": result["tpot_p50_s"],
+                }
+            else:
+                _, ids_k, outs_k, dt_k, delta_k = drive(
+                    EngineConfig(num_slots=num_slots, block_size=block_size,
+                                 max_model_len=max_len,
+                                 prefix_cache=prefix_cache,
+                                 prefill_chunk=prefill_chunk,
+                                 spec_k=int(k), spec_draft=spec_draft),
+                    warm, timed_prompts,
+                )
+                total_k = sum(len(outs_k[r].token_ids) for r in ids_k)
+                tpots_k = [outs_k[r].tpot_s for r in ids_k
+                           if outs_k[r].tpot_s is not None]
+                entry = {
+                    "spec_k": int(k),
+                    "decode_tok_s": total_k / dt_k,
+                    "spec_acceptance_rate": (
+                        delta_k["spec_accepted"]
+                        / max(1.0, delta_k["spec_proposed"])
+                    ),
+                    "spec_accepted_tokens": delta_k["spec_accepted"],
+                    "tpot_p50_s": _pctl(tpots_k, 50),
+                }
+            sweep.append(entry)
+            _beat(global_step=len(sweep), phase="serve_spec_sweep")
+            if int(k) == 0:
+                result["nospec_decode_tok_s"] = entry["decode_tok_s"]
+                result["nospec_tpot_p50_s"] = entry["tpot_p50_s"]
+        result["spec_sweep"] = sweep
+        result["spec_draft"] = spec_draft
     return result
 
 
@@ -646,6 +702,12 @@ def _serve_main(preset: str, watchdog=None):
     prefill_chunk = int(os.environ.get(
         "BENCH_SERVE_PREFILL_CHUNK", 64 if shared_prefix > 0 else 0
     ))
+    # BENCH_SERVE_SPEC_K="0,2,4,8" sweeps draft-then-verify speculation
+    # over the same timed request set (empty/unset skips the sweep)
+    spec_ks = tuple(
+        int(x) for x in
+        os.environ.get("BENCH_SERVE_SPEC_K", "").split(",") if x.strip()
+    )
     r = run_serve_bench(
         num_slots=int(os.environ.get("BENCH_SERVE_SLOTS", 4)),
         block_size=int(os.environ.get("BENCH_SERVE_BLOCK", 16)),
@@ -657,6 +719,8 @@ def _serve_main(preset: str, watchdog=None):
         prefill_chunk=prefill_chunk,
         prefix_cache=os.environ.get("BENCH_SERVE_PREFIX_CACHE", "1")
         not in ("0", ""),
+        spec_ks=spec_ks,
+        spec_draft=os.environ.get("BENCH_SERVE_SPEC_DRAFT", "ngram"),
     )
     if watchdog is not None:
         watchdog.stop()
@@ -694,6 +758,22 @@ def _serve_main(preset: str, watchdog=None):
         line["nocache_ttft_p50_s"] = round(r["nocache_ttft_p50_s"], 5)
         line["nocache_ttft_p99_s"] = round(r["nocache_ttft_p99_s"], 5)
         line["nocache_prefill_chunks"] = r["nocache_prefill_chunks"]
+    if "spec_sweep" in r:
+        # speculative decoding sweep (serving/spec_decode.py): decode tok/s
+        # + verify acceptance rate per draft length k, nospec baseline from
+        # the k=0 leg — the multi-token-decode tradeoff curve
+        line["spec_draft"] = r["spec_draft"]
+        line["spec_sweep"] = [
+            {"spec_k": e["spec_k"],
+             "decode_tok_s": round(e["decode_tok_s"], 1),
+             "spec_acceptance_rate": round(e["spec_acceptance_rate"], 4),
+             "spec_accepted_tokens": e["spec_accepted_tokens"],
+             "tpot_p50_s": round(e["tpot_p50_s"], 5)}
+            for e in r["spec_sweep"]
+        ]
+        if "nospec_decode_tok_s" in r:
+            line["nospec_decode_tok_s"] = round(r["nospec_decode_tok_s"], 1)
+            line["nospec_tpot_p50_s"] = round(r["nospec_tpot_p50_s"], 5)
     print(json.dumps(line), flush=True)
     _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 

@@ -18,6 +18,9 @@ Env knobs (flags win): VEOMNI_SERVE_SLOTS, VEOMNI_SERVE_BLOCK,
 VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS, VEOMNI_SERVE_PREFIX_CACHE
 (1 default; 0 disables prompt-block sharing), VEOMNI_SERVE_PREFILL_CHUNK
 (tokens prefilled per engine tick, 0 = whole prompt at once),
+VEOMNI_SERVE_SPEC_K (draft-then-verify speculation: max drafted tokens per
+slot per tick, 0 = off) with VEOMNI_SERVE_SPEC_DRAFT selecting the drafting
+strategy (`ngram` prompt-lookup default, `off` disables),
 VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD). VEOMNI_METRICS_PORT
 serves Prometheus /metrics + /healthz while the pump runs; /debug/requests
 rows carry each request's cached_tokens, and /debug/fleet the collective
@@ -88,6 +91,16 @@ def main():
                     help="max tokens prefilled per engine tick (0 = whole "
                          "prompt at once; bounds how long a long arrival "
                          "stalls running decodes)")
+    ap.add_argument("--spec-k", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_SPEC_K", 0)),
+                    help="speculative decoding: max drafted tokens per "
+                         "slot per tick, verified in one batched step "
+                         "(0 = off; token-exact either way)")
+    ap.add_argument("--spec-draft",
+                    default=os.environ.get("VEOMNI_SERVE_SPEC_DRAFT",
+                                           "ngram"),
+                    help="drafting strategy registry impl (`ngram` "
+                         "prompt-lookup, `off`)")
     args = ap.parse_args()
 
     import numpy as np
@@ -105,6 +118,7 @@ def main():
         max_model_len=args.max_model_len, log_every_steps=args.log_steps,
         prefix_cache=bool(args.prefix_cache),
         prefill_chunk=args.prefill_chunk,
+        spec_k=args.spec_k, spec_draft=args.spec_draft,
     ))
     # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz + /debug/flight +
     # /debug/requests (per-request timelines) for the pump loop (the engine
@@ -176,6 +190,7 @@ def main():
             "finish_reason": o.finish_reason,
             "ttft_s": round(o.ttft_s, 4) if o.ttft_s is not None else None,
             "cached_tokens": o.cached_tokens,
+            "spec_accepted_tokens": o.spec_accepted_tokens,
         }), flush=True)
 
 

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Default tier-1 entry point (ROADMAP.md "Tier-1 verify").
+#
+# The full suite exceeds a single 870s invocation on a 2-core box, so this
+# runs it as N deterministic shards (scripts/tier1_shard.py: crc32-stable
+# file partition) SEQUENTIALLY, each under its own timeout, and merges the
+# passed-dot counts into the one DOTS_PASSED line drivers grep for. A shard
+# that times out or fails makes the whole run fail (worst rc wins), but the
+# later shards still run — a hang in shard 1 must not hide shard 2's result.
+#
+# Knobs (env):
+#   TIER1_SHARDS         shard count (default 2)
+#   TIER1_SHARD_TIMEOUT  per-shard budget in seconds (default 870, the
+#                        ROADMAP's historical single-run budget)
+#   TIER1_LOG_DIR        where per-shard logs land (default /tmp)
+#
+# Usage (docs/testing.md "Sharded tier-1"):
+#   bash scripts/tier1.sh
+#   TIER1_SHARDS=3 TIER1_SHARD_TIMEOUT=600 bash scripts/tier1.sh
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+SHARDS="${TIER1_SHARDS:-2}"
+SHARD_TIMEOUT="${TIER1_SHARD_TIMEOUT:-870}"
+LOG_DIR="${TIER1_LOG_DIR:-/tmp}"
+
+total_dots=0
+rc=0
+for k in $(seq 1 "$SHARDS"); do
+  log="$LOG_DIR/_t1_shard${k}of${SHARDS}.log"
+  rm -f "$log"
+  timeout -k 10 "$SHARD_TIMEOUT" env JAX_PLATFORMS=cpu \
+    python scripts/tier1_shard.py --shard "$k/$SHARDS" 2>&1 | tee "$log"
+  shard_rc=${PIPESTATUS[0]}
+  # pytest's -q progress lines are runs of [.FEsx] (with an optional
+  # percentage suffix); count the dots = passed tests, same recipe the
+  # single-invocation verify line used
+  dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$log" | tr -cd . | wc -c)
+  echo "SHARD_DOTS ${k}/${SHARDS}=${dots} rc=${shard_rc}"
+  total_dots=$((total_dots + dots))
+  if [ "$shard_rc" -ne 0 ] && [ "$rc" -eq 0 ]; then
+    rc=$shard_rc
+  fi
+done
+echo "DOTS_PASSED=${total_dots}"
+exit "$rc"

@@ -182,6 +182,29 @@ def _paged_layer(hidden, lp, cfg: TransformerConfig, cos, sin, k_pool, v_pool,
     return _layer_tail(hidden, attn, lp, cfg, is_moe), k_pool, v_pool
 
 
+def _paged_verify_layer(hidden, lp, cfg: TransformerConfig, cos, sin,
+                        k_pool, v_pool, block_tables, write_blocks,
+                        write_offs, valid_mask, is_moe):
+    """One decoder layer over a speculative **verify** batch against the
+    paged pool: KB candidate rows per slot (the committed last token plus
+    the drafted continuation). Every row's k/v is scattered to its
+    (block, offset) BEFORE attending — the same write-before-attend
+    invariant as the decode path, so row j can attend to the draft rows
+    0..j-1 of its own slot as well as the committed prefix. Rows past each
+    slot's real input length are routed to the reserved null block 0
+    (garbage no live query can see)."""
+    x = _norm(hidden, lp["input_layernorm"], cfg)
+    q, k_new, v_new = _qkv(x, lp, cfg, cos, sin)
+    k_pool = k_pool.at[write_blocks, write_offs].set(k_new)
+    v_pool = v_pool.at[write_blocks, write_offs].set(v_new)
+    nrep, scale = _attn_params(cfg)
+    attn = ops.paged_attend(
+        q, k_pool, v_pool, block_tables, valid_mask,
+        num_rep=nrep, scale=scale, sinks=lp.get("sinks"),
+    )
+    return _layer_tail(hidden, attn, lp, cfg, is_moe), k_pool, v_pool
+
+
 def _paged_prefill_layer(hidden, lp, cfg: TransformerConfig, cos, sin,
                          k_pool, v_pool, block_tables, write_blocks,
                          write_offs, valid_mask, is_moe):
@@ -218,19 +241,18 @@ def _layer_meta(cfg: TransformerConfig):
     return windows, local
 
 
-def _walk(compute, cfg: TransformerConfig, hidden, caches, write_idx,
-          cos_g, sin_g, cos_l, sin_l, valid_base):
-    """Scan all layers (dense segment then MoE segment), threading caches.
+def _segment_scan(compute, cfg: TransformerConfig, hidden, k_all, v_all,
+                  layer_body):
+    """Scan all layers (dense segment then MoE segment), threading the
+    per-layer k/v stacks — the walk skeleton every decode-path variant
+    (contiguous, paged decode, paged prefill, speculative verify) shares,
+    so a masking/segment fix can never drift between paths that must stay
+    bit-identical.
 
-    caches: (k [L,B,M,hkv,d], v [L,B,M,hkv,d]); valid_base [B,T,M] is the
-    causal+length mask — per-layer windows are AND-ed inside the scan."""
+    ``layer_body(hidden, lp, k, v, window, local_rope, is_moe) ->
+    (hidden, k, v)`` supplies the variant-specific math (rope selection,
+    mask construction, cache write + attend)."""
     windows, local_flags = _layer_meta(cfg)
-    k_all, v_all = caches
-    M = k_all.shape[2]
-    kpos = jnp.arange(M)[None, None]  # [1,1,M]
-    t = hidden.shape[1]
-    qpos = write_idx + jnp.arange(t)[None, :, None]  # [1,T,1]
-
     L = cfg.num_hidden_layers
     k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
     segments = []
@@ -241,17 +263,11 @@ def _walk(compute, cfg: TransformerConfig, hidden, caches, write_idx,
     for name, offset, count, is_moe_seg in segments:
         tree = compute[name]
 
-        def body(carry, xs):
+        def body(carry, xs, is_moe_seg=is_moe_seg):
             hidden, = carry
             lp, k_c, v_c, win, loc = xs
-            cos = jnp.where(loc, cos_l, cos_g)
-            sin = jnp.where(loc, sin_l, sin_g)
-            in_window = jnp.where(win > 0, qpos - kpos < win, True)
-            mask = valid_base & in_window
-            hidden, k_c, v_c = _layer(
-                hidden, lp, cfg, cos, sin, k_c, v_c, mask, write_idx,
-                is_moe_seg,
-            )
+            hidden, k_c, v_c = layer_body(hidden, lp, k_c, v_c, win, loc,
+                                          is_moe_seg)
             return (hidden,), (k_c, v_c)
 
         sl = slice(offset, offset + count)
@@ -264,6 +280,29 @@ def _walk(compute, cfg: TransformerConfig, hidden, caches, write_idx,
     return hidden, (k_all, v_all)
 
 
+def _walk(compute, cfg: TransformerConfig, hidden, caches, write_idx,
+          cos_g, sin_g, cos_l, sin_l, valid_base):
+    """Scan all layers (dense segment then MoE segment), threading caches.
+
+    caches: (k [L,B,M,hkv,d], v [L,B,M,hkv,d]); valid_base [B,T,M] is the
+    causal+length mask — per-layer windows are AND-ed inside the scan."""
+    k_all, v_all = caches
+    M = k_all.shape[2]
+    kpos = jnp.arange(M)[None, None]  # [1,1,M]
+    t = hidden.shape[1]
+    qpos = write_idx + jnp.arange(t)[None, :, None]  # [1,T,1]
+
+    def layer_body(hidden, lp, k_c, v_c, win, loc, is_moe_seg):
+        cos = jnp.where(loc, cos_l, cos_g)
+        sin = jnp.where(loc, sin_l, sin_g)
+        in_window = jnp.where(win > 0, qpos - kpos < win, True)
+        mask = valid_base & in_window
+        return _layer(hidden, lp, cfg, cos, sin, k_c, v_c, mask, write_idx,
+                      is_moe_seg)
+
+    return _segment_scan(compute, cfg, hidden, k_all, v_all, layer_body)
+
+
 def _paged_walk(compute, cfg: TransformerConfig, hidden, pools, block_tables,
                 positions, cos_g, sin_g, cos_l, sin_l):
     """Paged analogue of ``_walk``: scan all layers (dense segment then MoE
@@ -274,7 +313,6 @@ def _paged_walk(compute, cfg: TransformerConfig, hidden, pools, block_tables,
     Block-table order is sequence order, so gathered context index j sits at
     absolute position j and the causal/window masks are identical to the
     contiguous path's."""
-    windows, local_flags = _layer_meta(cfg)
     k_all, v_all = pools
     bs = k_all.shape[2]  # [L, NB, BS, hkv, d]
     ctx = block_tables.shape[1] * bs
@@ -286,37 +324,62 @@ def _paged_walk(compute, cfg: TransformerConfig, hidden, pools, block_tables,
     )[:, 0]
     write_off = positions % bs
 
-    L = cfg.num_hidden_layers
-    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
-    segments = []
-    if k_dense:
-        segments.append(("dense_layers", 0, k_dense, False))
-    segments.append(("layers", k_dense, L - k_dense, cfg.is_moe))
+    def layer_body(hidden, lp, k_p, v_p, win, loc, is_moe_seg):
+        cos = jnp.where(loc, cos_l, cos_g)
+        sin = jnp.where(loc, sin_l, sin_g)
+        in_window = jnp.where(win > 0, qpos - kpos < win, True)
+        mask = valid_base & in_window
+        return _paged_layer(hidden, lp, cfg, cos, sin, k_p, v_p,
+                            block_tables, write_block, write_off, mask,
+                            is_moe_seg)
 
-    for name, offset, count, is_moe_seg in segments:
-        tree = compute[name]
+    return _segment_scan(compute, cfg, hidden, k_all, v_all, layer_body)
 
-        def body(carry, xs):
-            hidden, = carry
-            lp, k_p, v_p, win, loc = xs
-            cos = jnp.where(loc, cos_l, cos_g)
-            sin = jnp.where(loc, sin_l, sin_g)
-            in_window = jnp.where(win > 0, qpos - kpos < win, True)
-            mask = valid_base & in_window
-            hidden, k_p, v_p = _paged_layer(
-                hidden, lp, cfg, cos, sin, k_p, v_p, block_tables,
-                write_block, write_off, mask, is_moe_seg,
-            )
-            return (hidden,), (k_p, v_p)
 
-        sl = slice(offset, offset + count)
-        (hidden,), (k_seg, v_seg) = jax.lax.scan(
-            body, (hidden,),
-            (tree, k_all[sl], v_all[sl], windows[sl], local_flags[sl]),
-        )
-        k_all = k_all.at[sl].set(k_seg)
-        v_all = v_all.at[sl].set(v_seg)
-    return hidden, (k_all, v_all)
+def _paged_verify_walk(compute, cfg: TransformerConfig, hidden, pools,
+                       block_tables, positions, n_input, cos_g, sin_g,
+                       cos_l, sin_l):
+    """Verify-step analogue of ``_paged_walk``: scan all layers (dense
+    segment then MoE segment) threading the block pools, with KB candidate
+    queries per slot instead of one.
+
+    pools: (k [L,NB,BS,hkv,d], v); block_tables [S,nb] (null-padded);
+    positions [S,KB] are each slot's candidate rows' absolute write/query
+    positions (``pos + arange(KB)``); n_input [S] is the real candidate
+    count per slot (1 committed token + drafted tokens). Block-table order
+    is sequence order, so gathered context index j sits at absolute
+    position j and the causal/window masks are identical to the decode
+    path's — row j of a slot sees exactly the context the non-speculative
+    engine would have at that position."""
+    k_all, v_all = pools
+    bs = k_all.shape[2]  # [L, NB, BS, hkv, d]
+    nb = block_tables.shape[1]
+    ctx = nb * bs
+    kb = positions.shape[1]
+    kpos = jnp.arange(ctx)[None, None]  # [1,1,ctx]
+    qpos = positions[:, :, None]  # [S,KB,1]
+    valid_base = kpos <= qpos
+    # rows past each slot's real input (bucket padding) write their garbage
+    # into the null block; real rows land at (table[pos // bs], pos % bs).
+    # The clip keeps the table gather in bounds for padded rows whose
+    # position overruns the table — they are rerouted to block 0 anyway.
+    real = jnp.arange(kb)[None, :] < n_input[:, None]  # [S,KB]
+    blk_idx = jnp.clip(positions // bs, 0, nb - 1)
+    write_blocks = jnp.where(
+        real, jnp.take_along_axis(block_tables, blk_idx, axis=1), 0
+    )
+    write_offs = positions % bs
+
+    def layer_body(hidden, lp, k_p, v_p, win, loc, is_moe_seg):
+        cos = jnp.where(loc, cos_l, cos_g)
+        sin = jnp.where(loc, sin_l, sin_g)
+        in_window = jnp.where(win > 0, qpos - kpos < win, True)
+        mask = valid_base & in_window
+        return _paged_verify_layer(hidden, lp, cfg, cos, sin, k_p, v_p,
+                                   block_tables, write_blocks, write_offs,
+                                   mask, is_moe_seg)
+
+    return _segment_scan(compute, cfg, hidden, k_all, v_all, layer_body)
 
 
 def _paged_prefill_walk(compute, cfg: TransformerConfig, hidden, pools,
@@ -332,7 +395,6 @@ def _paged_prefill_walk(compute, cfg: TransformerConfig, hidden, pools,
     Block-table order is sequence order, so gathered context index j sits
     at absolute position j and the causal/window masks are identical to
     the contiguous prefill's."""
-    windows, local_flags = _layer_meta(cfg)
     k_all, v_all = pools
     bs = k_all.shape[2]  # [L, NB, BS, hkv, d]
     nb = block_tables.shape[1]
@@ -350,37 +412,16 @@ def _paged_prefill_walk(compute, cfg: TransformerConfig, hidden, pools,
     write_blocks = jnp.where(real, block_tables[0][blk_idx], 0)
     write_offs = positions % bs
 
-    L = cfg.num_hidden_layers
-    k_dense = cfg.first_k_dense_replace if cfg.is_moe else 0
-    segments = []
-    if k_dense:
-        segments.append(("dense_layers", 0, k_dense, False))
-    segments.append(("layers", k_dense, L - k_dense, cfg.is_moe))
+    def layer_body(hidden, lp, k_p, v_p, win, loc, is_moe_seg):
+        cos = jnp.where(loc, cos_l, cos_g)
+        sin = jnp.where(loc, sin_l, sin_g)
+        in_window = jnp.where(win > 0, qpos - kpos < win, True)
+        mask = valid_base & in_window
+        return _paged_prefill_layer(hidden, lp, cfg, cos, sin, k_p, v_p,
+                                    block_tables, write_blocks, write_offs,
+                                    mask, is_moe_seg)
 
-    for name, offset, count, is_moe_seg in segments:
-        tree = compute[name]
-
-        def body(carry, xs):
-            hidden, = carry
-            lp, k_p, v_p, win, loc = xs
-            cos = jnp.where(loc, cos_l, cos_g)
-            sin = jnp.where(loc, sin_l, sin_g)
-            in_window = jnp.where(win > 0, qpos - kpos < win, True)
-            mask = valid_base & in_window
-            hidden, k_p, v_p = _paged_prefill_layer(
-                hidden, lp, cfg, cos, sin, k_p, v_p, block_tables,
-                write_blocks, write_offs, mask, is_moe_seg,
-            )
-            return (hidden,), (k_p, v_p)
-
-        sl = slice(offset, offset + count)
-        (hidden,), (k_seg, v_seg) = jax.lax.scan(
-            body, (hidden,),
-            (tree, k_all[sl], v_all[sl], windows[sl], local_flags[sl]),
-        )
-        k_all = k_all.at[sl].set(k_seg)
-        v_all = v_all.at[sl].set(v_seg)
-    return hidden, (k_all, v_all)
+    return _segment_scan(compute, cfg, hidden, k_all, v_all, layer_body)
 
 
 def paged_prefill_step(params, cfg: TransformerConfig, pools, block_table,
@@ -442,6 +483,79 @@ def paged_decode_step(params, cfg: TransformerConfig, pools, block_tables,
                                 positions, cos_g, sin_g, cos_l, sin_l)
     logits = _logits(params, compute, cfg, hidden)
     return logits[:, 0].astype(jnp.float32), pools
+
+
+def paged_verify_step(params, cfg: TransformerConfig, pools, block_tables,
+                      positions, tokens, n_input):
+    """One batched speculative **verify** step over the slot batch.
+
+    tokens [S,KB] (column 0 is each slot's committed last token, columns
+    1..n_input-1 its drafted continuation, zero-padded past ``n_input``);
+    positions [S] (column 0's write position — the same position the
+    non-speculative decode step would write); block_tables [S,nb] int32
+    padded with the null block 0; n_input [S] in [1, KB]. Returns
+    (logits [S,KB,V] f32, pools): logits[:, j] is the next-token
+    distribution AFTER candidate row j, computed with the draft rows
+    0..j written — so as long as the drafts up to j are accepted, it is
+    bit-for-bit the distribution the one-token path would have produced.
+    The serving engine jits this with the pools donated; (KB, gathered
+    context width) are the compile buckets."""
+    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    kb = tokens.shape[1]
+    pos_rows = positions[:, None] + jnp.arange(kb, dtype=jnp.int32)[None, :]
+    cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, pos_rows)
+    hidden = compute["embed_tokens"][tokens]
+    if cfg.embed_scale:
+        hidden = hidden * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    hidden, pools = _paged_verify_walk(
+        compute, cfg, hidden, pools, block_tables, pos_rows, n_input,
+        cos_g, sin_g, cos_l, sin_l,
+    )
+    logits = _logits(params, compute, cfg, hidden)
+    return logits.astype(jnp.float32), pools
+
+
+def verify_accept(logits, tokens, n_input, keys, temperature, top_k, top_p):
+    """Vectorized accept-prefix selection for a speculative verify step.
+
+    logits [S,KB,V] f32 from :func:`paged_verify_step`; tokens [S,KB] its
+    inputs (committed token in column 0, drafts after); n_input [S];
+    keys [S,2] the per-slot PRNG carries; temperature/top_p [S] f32,
+    top_k [S] int32. Returns ``(targets [S,KB], n_emit [S],
+    new_keys [S,2])``.
+
+    ``targets[:, j]`` is the token the NON-speculative engine would emit as
+    this tick's (j+1)-th token: each column is sampled with the same
+    per-step key schedule the one-token path uses (split carry/sample once
+    per emitted token), so greedy slots reproduce the argmax chain exactly
+    and sampled slots reproduce the categorical draw chain exactly. Draft
+    column j+1 is accepted iff it equals target j AND every earlier draft
+    was accepted; ``n_emit = accepted + 1`` counts the accepted prefix plus
+    the bonus token (the target after the last accepted draft), so the
+    emitted tokens are simply ``targets[:, :n_emit]`` and ``new_keys`` is
+    the carry advanced by exactly ``n_emit`` splits — byte-identical PRNG
+    state to emitting those tokens one step at a time."""
+    s, kb, _ = logits.shape
+    carry = jnp.asarray(keys, jnp.uint32)
+    target_cols, carry_cols = [], [carry]
+    for j in range(kb):  # kb is the static compile bucket: unrolled
+        split = jax.vmap(lambda k: jax.random.split(k, 2))(carry)
+        target_cols.append(sample_tokens(
+            logits[:, j], split[:, 1], temperature, top_k, top_p
+        ))
+        carry = split[:, 0]
+        carry_cols.append(carry)
+    targets = jnp.stack(target_cols, axis=1)  # [S,KB]
+    carries = jnp.stack(carry_cols, axis=1)  # [S,KB+1,2]
+    if kb > 1:
+        in_draft = jnp.arange(1, kb)[None, :] < n_input[:, None]
+        match = (tokens[:, 1:] == targets[:, :-1]) & in_draft
+        accepted = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    else:
+        accepted = jnp.zeros((s,), jnp.int32)
+    n_emit = accepted + 1
+    new_keys = carries[jnp.arange(s), n_emit]  # carry after n_emit splits
+    return targets, n_emit, new_keys
 
 
 def scatter_prompt_cache(pools, prompt_caches, block_ids):
@@ -623,7 +737,7 @@ _JIT_CACHE_MAX = 8
 # cache hits): tests assert the bucket scheme keeps these flat across
 # distinct prompt lengths (each retrace on TPU costs 20-40s)
 TRACE_COUNTS = {"prefill": 0, "decode": 0, "paged_decode": 0,
-                "paged_prefill": 0}
+                "paged_prefill": 0, "paged_verify": 0}
 
 
 def _bucket_pow2(n: int, floor: int = 16) -> int:

@@ -62,6 +62,14 @@ class RequestTimeline:
     tokens: int = 0
     tpot_s: Optional[float] = None
     cached_tokens: int = 0  # prompt positions served from the prefix cache
+    # decode-phase accounting by PER-TICK emitted counts: with speculative
+    # decoding a verify step lands several tokens in one tick, so "ticks
+    # since first token" and "tokens since first token" are different
+    # numbers — tpot_s must divide by the latter. decode_tokens accumulates
+    # every decode tick's real emitted count (the first token, emitted by
+    # prefill, is excluded: tpot is a decode-phase figure).
+    decode_tokens: int = 0
+    spec_accepted_tokens: int = 0  # of those, accepted speculative drafts
 
     def mark(self, stage: str, t: Optional[float] = None,
              **detail: Any) -> float:
@@ -108,6 +116,7 @@ class RequestTimeline:
             "preemptions": self.preemptions,
             "tokens": self.tokens,
             "cached_tokens": self.cached_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
         }
         if self._wait_since is not None and now is not None:
             doc["waiting"] = True
@@ -185,6 +194,28 @@ class RequestTracer:
                 tl.first_token_t = tl.mark("first_token")
         _flight_record("serve.first_token", cid=request_id)
 
+    def on_decode_tokens(self, request_id: str, n: int,
+                         spec_accepted: int = 0) -> None:
+        """Record one decode tick's REAL emitted-token count (the engine
+        calls this once per sequence per decode tick, before the tokens are
+        emitted). ``serve.tpot_s`` previously divided the decode wall by
+        ``tokens - 1`` — correct only while every decode tick emits exactly
+        one token; a speculative verify step lands up to k+1, so the
+        tracer now accumulates the per-tick counts and divides by those.
+        Multi-token ticks additionally land a ``verify_emit`` timeline mark
+        (tokens + accepted-draft count) so ``/debug/requests`` shows WHERE
+        a request's speculative wins happened; one-token ticks only bump
+        the counters (a mark per generated token would bloat every
+        timeline)."""
+        with self._lock:
+            tl = self._inflight.get(request_id)
+            if tl is None:
+                return
+            tl.decode_tokens += n
+            tl.spec_accepted_tokens += spec_accepted
+            if n > 1:
+                tl.mark("verify_emit", tokens=n, spec_accepted=spec_accepted)
+
     def on_preempted(self, request_id: str) -> None:
         with self._lock:
             tl = self._inflight.get(request_id)
@@ -233,7 +264,15 @@ class RequestTracer:
                     decode_wall -= max(
                         0.0, min(w1, t) - max(w0, tl.first_token_t)
                     )
-                tl.tpot_s = max(decode_wall, 0.0) / (tokens - 1)
+                # divide by the RECORDED decode-phase token count (per-tick
+                # emitted counts, multi-token verify ticks included) — the
+                # old ``tokens - 1`` denominator assumed one token per
+                # decode tick and is kept only as the fallback for engines
+                # that never report tick counts
+                denom = tl.decode_tokens if tl.decode_tokens > 0 else (
+                    tokens - 1
+                )
+                tl.tpot_s = max(decode_wall, 0.0) / denom
                 self._h_tpot.observe(tl.tpot_s)
             self._h_preempt.observe(float(tl.preemptions))
             self._finished.append(tl)

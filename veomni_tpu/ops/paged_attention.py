@@ -103,8 +103,11 @@ def _paged_attend_xla(
 def paged_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
                  num_rep: int = 1, scale: float,
                  sinks: Optional[jax.Array] = None):
-    """q [S,1,hq,d] + pool [NB,BS,hkv,d] + block_tables [S,nb] ->
-    [S,1,hq,d]. valid_mask [S,1,nb*BS] in gathered (== absolute) positions."""
+    """q [S,T,hq,d] + pool [NB,BS,hkv,d] + block_tables [S,nb] ->
+    [S,T,hq,d]. valid_mask [S,T,nb*BS] in gathered (== absolute)
+    positions. T is 1 for the plain decode step and KB (committed token +
+    drafted continuation) for the speculative verify step — the math is
+    identical per query row, so the two paths can never drift."""
     inner = resolve_op("paged_attention")
     return inner(
         q, k_pool, v_pool, block_tables, valid_mask,

@@ -3,11 +3,14 @@
 Layering: ``api`` (request/response dataclasses) -> ``kv_block_manager``
 (host block accounting: shared refcounted blocks) -> ``prefix_cache``
 (radix tree sharing prompt KV blocks across requests) -> ``scheduler``
-(admission/preemption policy, cache-aware) -> ``engine`` (jitted chunked
-prefill over cached prefixes + batched paged decode). See
-``docs/serving.md`` for the architecture and the compile-count story.
+(admission/preemption policy, cache-aware) -> ``spec_decode`` (host-side
+draft strategies for speculative decoding, registry-dispatched) ->
+``engine`` (jitted chunked prefill over cached prefixes + batched paged
+decode, one-token or draft-then-verify). See ``docs/serving.md`` for the
+architecture and the compile-count story.
 """
 
+from veomni_tpu.serving import spec_decode  # registers the spec_draft op
 from veomni_tpu.serving.api import (
     Request,
     RequestOutput,

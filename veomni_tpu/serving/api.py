@@ -65,3 +65,7 @@ class RequestOutput:
     # (0 with the cache off or on a cold miss) — cached_tokens/len(prompt_ids)
     # is this request's share of the engine's serve.prefix_hit_rate
     cached_tokens: int = 0
+    # generated tokens that arrived as ACCEPTED speculative drafts (verify
+    # steps, spec_k > 0) rather than one-token decode steps — this
+    # request's share of serve.spec_accepted. 0 with speculation off.
+    spec_accepted_tokens: int = 0

@@ -26,6 +26,20 @@ Execution model (one ``step()`` tick):
    by request count or arrival pattern (``TRACE_COUNTS["paged_decode"]``).
    Chunked prefill adds one more bucketed program
    (``TRACE_COUNTS["paged_prefill"]``) over (chunk bucket, table bucket).
+   With ``spec_k > 0`` the decode tick becomes **draft-then-verify**: a
+   host-side drafting op (``spec_draft`` registry dispatch,
+   ``serving/spec_decode.py``) proposes up to k tokens per slot, blocks for
+   the drafted positions are claimed best-effort (never preempting), and
+   ONE jitted ``paged_verify_step`` scores all k+1 positions — emitting
+   1..k+1 tokens per slot per tick while staying token-exact with the
+   one-token path (greedy AND seeded sampling; the verify step replays the
+   same per-token PRNG key schedule). Rejected drafts roll their claimed
+   blocks back the same tick. The verify program's compile count is
+   bounded by (verify-width bucket x table bucket):
+   ``TRACE_COUNTS["paged_verify"]`` is O(log2 k x log2 table-width). A
+   tick where no slot drafts anything (or ``spec_k == 0``, the default)
+   runs the plain decode step — byte-identical to the non-speculative
+   engine.
 
 Shapes the XLA programs see: slot batch ``S`` (static per engine), prompt
 and chunk buckets (power-of-two), context buckets (power-of-two blocks).
@@ -81,6 +95,13 @@ class EngineConfig:
     # uncached suffix in one go). Bounds how long a newly arrived long
     # prompt can stall every running request's next token.
     prefill_chunk: int = 0
+    # speculative decoding (draft-then-verify): propose up to spec_k tokens
+    # per running slot per tick via the spec_draft strategy and verify them
+    # in ONE batched jitted step — multi-token decode ticks, token-exact
+    # with the one-token path. 0 (the default) keeps the seed decode path
+    # byte-identical; the `off` strategy disables drafting even with k > 0.
+    spec_k: int = 0
+    spec_draft: str = "ngram"  # registry impl name (serving/spec_decode.py)
     # serving-side recompile detection: after this many step() ticks the
     # decode/prefill TRACE_COUNTS baselines are armed, and any later bucket
     # growth emits the trainer's loud rank-0 RECOMPILE warning + the
@@ -95,6 +116,8 @@ class EngineConfig:
             raise ValueError("block_size must be a power of two")
         if self.prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 disables)")
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 disables)")
         if self.num_blocks <= 0:
             per_seq = -(-self.max_model_len // self.block_size)
             self.num_blocks = 1 + self.num_slots * per_seq
@@ -134,9 +157,31 @@ class InferenceEngine:
         # first-token/finished — together they feed serve.queue_wait_s and
         # serve.tpot_s and the /debug/requests timelines
         self.tracer = RequestTracer(ec.num_slots)
+        # draft-then-verify speculation: resolve the drafting strategy up
+        # front (a typo'd spec_draft fails at construction, not mid-serve)
+        # and widen admission headroom for the per-tick k-token growth. An
+        # ops-config pin outranks the engine knob — including for the
+        # enabled/disabled decision, so a pinned `off` also releases the
+        # admission headroom and the per-tick draft calls, and a pinned
+        # real strategy can switch speculation ON over a spec_draft="off"
+        # engine (spec_k still gates: k=0 never speculates).
+        from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY
+        from veomni_tpu.serving.spec_decode import resolve_draft_fn
+
+        effective_draft = (
+            KERNEL_REGISTRY.pinned("spec_draft") or ec.spec_draft
+        )
+        self._spec_enabled = ec.spec_k > 0 and effective_draft != "off"
+        self._draft_fn = (
+            resolve_draft_fn(ec.spec_draft) if self._spec_enabled else None
+        )
+        spec_headroom = (
+            -(-ec.spec_k // ec.block_size) if self._spec_enabled else 0
+        )
         self.scheduler = Scheduler(ec.num_slots, self.blocks,
                                    tracer=self.tracer,
-                                   prefix_cache=self.prefix_cache)
+                                   prefix_cache=self.prefix_cache,
+                                   spec_headroom_blocks=spec_headroom)
 
         # prefill is the SAME jitted program greedy_generate uses (shared
         # prompt buckets, shared TRACE_COUNTS["prefill"])
@@ -147,6 +192,9 @@ class InferenceEngine:
         self._sample = jax.jit(decode_mod.sample_tokens)
         self._decode_step = self._build_decode_step()
         self._prefill_chunk_step = self._build_prefill_chunk_step()
+        self._verify_step = (
+            self._build_verify_step() if self._spec_enabled else None
+        )
         # copy-on-write block duplication: src/dst are traced scalars, so
         # this compiles exactly once per engine
         self._cow = jax.jit(
@@ -169,6 +217,12 @@ class InferenceEngine:
         self._prompt_tokens_total = 0
         self._cached_tokens_total = 0
         self._prefill_chunks_total = 0
+        # speculative-decoding accounting: lifetime totals + a window pair
+        # for the acceptance-rate gauge (resets with the metrics window)
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+        self._win_spec_proposed = 0
+        self._win_spec_accepted = 0
         # observability registry: same surface the trainer exports through,
         # so one /metrics endpoint covers both (docs/observability.md)
         reg = get_registry()
@@ -183,6 +237,12 @@ class InferenceEngine:
         self._m_hit_rate = reg.gauge("serve.prefix_hit_rate")
         self._m_cached_tokens = reg.counter("serve.cached_tokens")
         self._m_chunks = reg.counter("serve.prefill_chunks")
+        # speculative decoding: drafted tokens sent to verification, how
+        # many were accepted, and the window acceptance rate — the live
+        # "is speculation paying for its verify width" gauges
+        self._m_spec_proposed = reg.counter("serve.spec_proposed")
+        self._m_spec_accepted = reg.counter("serve.spec_accepted")
+        self._m_spec_rate = reg.gauge("serve.spec_acceptance_rate")
         # HBM capacity accounting (observability/devmem.py): pool bytes are
         # static per engine; the concurrent-sequence estimates answer "how
         # many max-length users fit" (total, and with the blocks free now)
@@ -258,6 +318,33 @@ class InferenceEngine:
             static_argnums=(7,),
             # (chunk bucket, table-width bucket) — the two compile axes
             bucket_fn=lambda a: f"cb{a[7]}_nbb{a[3].shape[0]}",
+        )
+
+    def _build_verify_step(self):
+        cfg = self.cfg
+
+        def impl(params, k_pool, v_pool, tables, positions, tokens, n_input,
+                 keys, temps, top_ks, top_ps):
+            decode_mod.TRACE_COUNTS["paged_verify"] += 1  # trace-time only
+            logits, (k_pool, v_pool) = decode_mod.paged_verify_step(
+                params, cfg, (k_pool, v_pool), tables, positions, tokens,
+                n_input,
+            )
+            targets, n_emit, new_keys = decode_mod.verify_accept(
+                logits, tokens, n_input, keys, temps, top_ks, top_ps
+            )
+            return targets, n_emit, new_keys, k_pool, v_pool
+
+        from veomni_tpu.observability.cost import instrument_jit
+
+        return instrument_jit(
+            "paged_verify", jax.jit(impl, donate_argnums=(1, 2)),
+            # args: (params, k_pool, v_pool, tables, positions, tokens, ...)
+            # — (table-width bucket, verify-width bucket) are the two
+            # varying shapes, each a power of two: O(log2 x log2) compiles
+            bucket_fn=lambda a: (
+                f"s{a[3].shape[0]}_nbb{a[3].shape[1]}_kb{a[5].shape[1]}"
+            ),
         )
 
     # ----------------------------------------------------------------- intake
@@ -510,23 +597,35 @@ class InferenceEngine:
             self._win_ttft_n += 1
             self._m_ttft.observe(ttft)
             self.tracer.on_first_token(seq.seq_id)
+        else:
+            # post-preemption re-admission: this prefill's resume token is
+            # part of the DECODE phase (it lands after first_token), so it
+            # counts toward the tracer's per-tick decode-token tally —
+            # serve.tpot_s divides by exactly the tokens inside its wall
+            self.tracer.on_decode_tokens(seq.seq_id, 1)
         return [self._emit(seq, first)]
 
     def _decode_tick(
         self, running: List[Tuple[int, SequenceState]]
     ) -> List[StreamEvent]:
-        ec = self.config
-        bs = ec.block_size
-        # power-of-two bucket of the widest block table: the decode step's
-        # only varying shape, so compile count is O(log2 blocks-per-seq)
+        if self._spec_enabled:
+            return self._spec_decode_tick(running)
+        return self._plain_decode_tick(running)
+
+    def _fill_slot_arrays(self, running: List[Tuple[int, SequenceState]]):
+        """Per-slot batch rows shared by the plain and verify decode
+        ticks: null-padded block tables (width = the power-of-two bucket
+        of the widest running table — the step's only varying table
+        shape), positions, PRNG keys and per-slot sampling params. Keeping
+        ONE assembly path is what keeps the two ticks' batches — and
+        therefore their token streams — in lockstep."""
         nbb = decode_mod._bucket_pow2(
             max(self.blocks.num_allocated(s.seq_id) for _, s in running),
             floor=1,
         )
-        S = ec.num_slots
+        S = self.config.num_slots
         tables = np.zeros((S, nbb), np.int32)  # null-block padded
         positions = np.zeros(S, np.int32)
-        tokens = np.zeros(S, np.int32)
         keys = np.zeros((S, 2), np.uint32)
         temps = np.zeros(S, np.float32)
         top_ks = np.zeros(S, np.int32)
@@ -535,12 +634,22 @@ class InferenceEngine:
             tbl = self.blocks.table(seq.seq_id)
             tables[slot, : len(tbl)] = tbl
             positions[slot] = seq.pos
-            tokens[slot] = seq.last_token
             keys[slot] = seq.rng
             sp = seq.request.sampling
             temps[slot] = sp.temperature
             top_ks[slot] = sp.top_k
             top_ps[slot] = sp.top_p
+        return tables, positions, keys, temps, top_ks, top_ps
+
+    def _plain_decode_tick(
+        self, running: List[Tuple[int, SequenceState]]
+    ) -> List[StreamEvent]:
+        tables, positions, keys, temps, top_ks, top_ps = (
+            self._fill_slot_arrays(running)
+        )
+        tokens = np.zeros(self.config.num_slots, np.int32)
+        for slot, seq in running:
+            tokens[slot] = seq.last_token
 
         nxt, new_keys, self.k_pool, self.v_pool = self._decode_step(
             self.params, self.k_pool, self.v_pool,
@@ -555,7 +664,138 @@ class InferenceEngine:
         for slot, seq in running:
             seq.rng = new_keys[slot]
             seq.pos += 1  # the freshly sampled token's write position
+            # per-tick emitted-token count: keeps serve.tpot_s honest for
+            # any multi-token tick (the verify path lands several)
+            self.tracer.on_decode_tokens(seq.seq_id, 1)
             events.append(self._emit(seq, int(nxt[slot])))
+        return events
+
+    def _spec_decode_tick(
+        self, running: List[Tuple[int, SequenceState]]
+    ) -> List[StreamEvent]:
+        """Draft-then-verify decode tick: host-side drafting per slot,
+        best-effort speculative block claims, ONE batched verify step, then
+        per-slot accept/rollback. Token-exact with the one-token path: the
+        verify step replays the same logits contexts and the same per-token
+        PRNG key schedule, and only emits tokens the target model would
+        have emitted anyway."""
+        ec = self.config
+        bs = ec.block_size
+        # 1) draft (host, cheap) + claim blocks for the drafted positions.
+        # Per-slot k: a slot whose drafter proposes nothing — or whose
+        # remaining token budget is 0 — degrades to k=0 (pure decode for
+        # that slot) instead of widening everyone's verify step.
+        drafts: Dict[int, List[int]] = {}
+        pre_lens: Dict[int, int] = {}
+        for slot, seq in running:
+            sp = seq.request.sampling
+            # a verify tick emits up to k+1 tokens; never draft past the
+            # request's remaining budget (parity: the one-token path would
+            # have stopped at max_new_tokens too)
+            budget = sp.max_new_tokens - len(seq.generated) - 1
+            k = min(ec.spec_k, max(0, budget))
+            d = list(self._draft_fn(seq.recompute_prompt, k))[:k] if k else []
+            if d:
+                pre = self.blocks.num_allocated(seq.seq_id)
+                k_granted, claimed = self.scheduler.claim_speculative(
+                    seq, len(d)
+                )
+                d = d[:max(0, k_granted)]
+                if not d and claimed:
+                    # pool too dry to cover even one draft: roll the claim
+                    # back immediately, this slot decodes plainly
+                    self.blocks.shrink(seq.seq_id, pre)
+                else:
+                    pre_lens[slot] = pre
+            drafts[slot] = d
+        if not any(drafts.values()):
+            # nothing to verify anywhere: the plain decode step (same
+            # compiled program as the non-speculative engine) is strictly
+            # cheaper than a kb=2 verify
+            return self._plain_decode_tick(running)
+
+        # 2) ONE batched verify step over all slots. kb (committed token +
+        # widest draft, power-of-two) and the table-width bucket are the
+        # only varying shapes — compile count stays O(log2 k x log2 width).
+        kb = decode_mod._bucket_pow2(
+            1 + max(len(d) for d in drafts.values()), floor=2
+        )
+        tables, positions, keys, temps, top_ks, top_ps = (
+            self._fill_slot_arrays(running)
+        )
+        S = ec.num_slots
+        tokens = np.zeros((S, kb), np.int32)
+        n_input = np.ones(S, np.int32)
+        for slot, seq in running:
+            d = drafts[slot]
+            tokens[slot, 0] = seq.last_token
+            if d:
+                tokens[slot, 1:1 + len(d)] = d
+            n_input[slot] = 1 + len(d)
+
+        targets, n_emit, new_keys, self.k_pool, self.v_pool = (
+            self._verify_step(
+                self.params, self.k_pool, self.v_pool, jnp.asarray(tables),
+                jnp.asarray(positions), jnp.asarray(tokens),
+                jnp.asarray(n_input), jnp.asarray(keys), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+            )
+        )
+        targets = np.asarray(targets)
+        n_emit = np.asarray(n_emit)
+        new_keys = np.asarray(new_keys)
+
+        # 3) per-slot accept + emit + rollback
+        events: List[StreamEvent] = []
+        for slot, seq in running:
+            seq.rng = new_keys[slot]
+            m = int(n_emit[slot])
+            proposed = len(drafts[slot])
+            accepted = m - 1  # drafts matching target sampling, in order
+            # truncate at eos / budget BEFORE emitting so the tick's token
+            # count (and the accepted rollup) reflect what actually lands
+            sp = seq.request.sampling
+            emit: List[int] = []
+            for j in range(m):
+                t = int(targets[slot, j])
+                emit.append(t)
+                if sp.eos_id >= 0 and t == sp.eos_id:
+                    break
+                if len(seq.generated) + len(emit) >= sp.max_new_tokens:
+                    break
+            # accepted drafts that actually LANDED as extra tokens: a tick
+            # emitting L tokens saves L-1 decode steps, so an eos/budget
+            # truncation caps the rollup at len(emit) - 1 (counting the
+            # truncated tick's first token too would overstate the win)
+            accepted_emitted = min(accepted, len(emit) - 1)
+            if proposed:
+                self._spec_proposed_total += proposed
+                self._win_spec_proposed += proposed
+                self._m_spec_proposed.inc(proposed)
+                self._spec_accepted_total += accepted_emitted
+                self._win_spec_accepted += accepted_emitted
+                self._m_spec_accepted.inc(accepted_emitted)
+                self._outputs[seq.seq_id].spec_accepted_tokens += (
+                    accepted_emitted
+                )
+            self.tracer.on_decode_tokens(seq.seq_id, len(emit),
+                                         spec_accepted=accepted_emitted)
+            finished = False
+            for t in emit:
+                seq.pos += 1  # this token's write position
+                ev = self._emit(seq, t)
+                events.append(ev)
+                if ev.finished:
+                    finished = True
+                    break
+            if finished or slot not in pre_lens:
+                continue  # finish freed every block / nothing was claimed
+            # rollback: release claimed blocks past what the ACCEPTED
+            # extent (plus the pending token's write position) needs — a
+            # rejected draft's block goes back to the pool this tick, and
+            # the refcounted release can never strand a shared/cached block
+            keep = max(pre_lens[slot], seq.pos // bs + 1)
+            self.blocks.shrink(seq.seq_id, keep)
         return events
 
     def _emit(self, seq: SequenceState, token: int) -> StreamEvent:
@@ -625,6 +865,13 @@ class InferenceEngine:
             "cached_tokens": float(self._cached_tokens_total),
             "prompt_tokens": float(self._prompt_tokens_total),
             "prefill_chunks": float(self._prefill_chunks_total),
+            # speculative decoding: lifetime totals (bench deltas) + the
+            # window acceptance rate (drafted tokens the verify step kept)
+            "spec_proposed": float(self._spec_proposed_total),
+            "spec_accepted": float(self._spec_accepted_total),
+            "spec_acceptance_rate": (
+                self._win_spec_accepted / max(1, self._win_spec_proposed)
+            ),
         }
         if self._win_ttft_n:
             m["ttft_avg_s"] = self._win_ttft_sum / self._win_ttft_n
@@ -634,8 +881,11 @@ class InferenceEngine:
             # the resetting caller owns the throughput window; mirror its
             # reading to the exporter gauge
             self._m_tps.set(m["decode_tokens_per_sec"])
+            self._m_spec_rate.set(m["spec_acceptance_rate"])
             self._window_tokens = 0
             self._window_t0 = now
             self._win_ttft_sum = 0.0
             self._win_ttft_n = 0
+            self._win_spec_proposed = 0
+            self._win_spec_accepted = 0
         return host_floats(m)

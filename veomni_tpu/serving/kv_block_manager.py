@@ -214,6 +214,37 @@ class KVBlockManager:
         self._tables[seq_id].extend(fresh)
         return self.table(seq_id)
 
+    def shrink(self, seq_id: str, n_keep: int) -> List[int]:
+        """Release the sequence's trailing table entries down to ``n_keep``
+        blocks — the speculative-decoding rollback: blocks claimed for
+        drafted positions whose drafts were rejected go back to the pool
+        the same tick they were claimed.
+
+        Releases go through the shared refcount machinery
+        (:meth:`_release_ref`), NOT straight to the free list: if a trailing
+        block is somehow still shared (a refcount > 1 cached prefix block
+        can never legally be a speculative claim, but the invariant is
+        enforced here rather than assumed), shrinking this sequence only
+        drops ITS reference — a rollback can never strand a block another
+        sequence (or the prefix cache) still holds. Returns the released
+        block ids (tail first)."""
+        if seq_id not in self._tables:
+            raise KeyError(
+                f"sequence {seq_id!r} has no block table to shrink: shrink()"
+                " is only valid between allocate()/allocate_shared() and "
+                f"free_seq() (currently allocated: "
+                f"{sorted(self._tables) or 'none'})"
+            )
+        if n_keep < 1:
+            raise ValueError(f"shrink() must keep >= 1 block, got {n_keep}")
+        table = self._tables[seq_id]
+        released: List[int] = []
+        while len(table) > n_keep:
+            blk = table.pop()
+            self._release_ref(blk)
+            released.append(blk)
+        return released
+
     def release_block(self, block: int) -> None:
         """Drop one reference taken outside a table (the copy-on-write
         source pin)."""

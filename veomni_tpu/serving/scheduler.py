@@ -89,7 +89,8 @@ class SequenceState:
 class Scheduler:
     def __init__(self, num_slots: int, block_manager: KVBlockManager,
                  tracer: Optional[Any] = None,
-                 prefix_cache: Optional[Any] = None):
+                 prefix_cache: Optional[Any] = None,
+                 spec_headroom_blocks: int = 0):
         if num_slots < 1:
             raise ValueError("need at least one decode slot")
         self.blocks = block_manager
@@ -101,6 +102,13 @@ class Scheduler:
         # optional RequestTracer (duck-typed: anything with on_queued /
         # on_admitted / on_preempted) — None keeps the scheduler trace-free
         self.tracer = tracer
+        # extra admission headroom when the engine decodes speculatively:
+        # a running sequence can grow by ceil(spec_k / block_size) blocks
+        # per tick on top of the usual one, so admission keeps that many
+        # more blocks free per tick — a fresh admission must not force every
+        # speculative claim to degrade to k=0 on its very first step. 0
+        # (the default, spec off) keeps the seed admission policy exactly.
+        self.spec_headroom_blocks = spec_headroom_blocks
 
     # ---------------------------------------------------------------- queries
     @property
@@ -153,8 +161,12 @@ class Scheduler:
             n_new = n_total - len(shared)
             # no headroom demanded when the engine is idle: an exact-fit
             # request must admit (it can still grow — the engine validates
-            # blocks_for(prompt+max_new) <= pool size at submit)
-            headroom = 1 if self.num_running else 0
+            # blocks_for(prompt+max_new) <= pool size at submit). With
+            # speculative decoding on, per-tick growth is up to
+            # spec_headroom_blocks MORE than the one-token step's single
+            # block (k drafted positions per running sequence).
+            headroom = ((1 + self.spec_headroom_blocks) if self.num_running
+                        else 0)
             # matched blocks currently sitting in the evictable set leave it
             # the moment allocate_shared references them, so they must not
             # double-count as claimable headroom
@@ -206,6 +218,33 @@ class Scheduler:
                 if victim is seq:
                     break
         return preempted
+
+    def claim_speculative(self, seq: SequenceState,
+                          k: int) -> Tuple[int, List[int]]:
+        """Best-effort block claim for ``k`` drafted positions beyond the
+        sequence's pending write position: grow its table until positions
+        [pos, pos + k] are covered or the pool runs dry. NEVER preempts —
+        speculation is an optimization, so a dry pool degrades k (possibly
+        to 0, a pure decode step) instead of evicting a running sequence.
+        Cached refcount-0 blocks still count as claimable (the pool's
+        ``free ∪ evictable`` accounting), exactly like any other growth.
+
+        Returns ``(k_granted, claimed_blocks)``: the draft length the
+        claimed coverage supports, and the freshly claimed block ids — the
+        engine rolls the unaccepted suffix back via
+        :meth:`KVBlockManager.shrink` after the verify step."""
+        bs = self.blocks.block_size
+        have = self.blocks.num_allocated(seq.seq_id)
+        need = (seq.pos + k) // bs + 1
+        claimed: List[int] = []
+        while have < need and self.blocks.can_allocate(1):
+            claimed.append(self.blocks.grow(seq.seq_id, 1)[-1])
+            have += 1
+        # coverage reached: positions [0, have*bs) — the last draftable
+        # position is have*bs - 1, so k_granted drafts fit after pos (a
+        # pool so dry the PENDING position isn't even covered grants 0;
+        # the mandatory ensure_decode_capacity pass handles that case)
+        return max(0, min(k, have * bs - 1 - seq.pos)), claimed
 
     def cache_insert(self, seq: SequenceState) -> int:
         """Register the sequence's full KV blocks in the prefix cache, keyed
