@@ -133,10 +133,16 @@ def main():
     configure_flight_recorder(
         dump_dir=os.environ.get("VEOMNI_SERVE_OUT", ".")
     )
+    from veomni_tpu.observability.metrics import get_registry
+
+    # the exporter's HTTP thread must NOT read live scheduler internals the
+    # pump loop mutates (unlocked cross-thread read — the lock-discipline
+    # audit in docs/static-analysis.md): the engine publishes these as
+    # thread-safe registry gauges after every tick, so health reads those
     exporter = maybe_start_from_env(health_fn=lambda: {
         "healthy": True,
-        "queue_depth": engine.scheduler.queue_depth,
-        "num_running": engine.scheduler.num_running,
+        "queue_depth": get_registry().gauge("serve.queue_depth").value,
+        "num_running": get_registry().gauge("serve.num_running").value,
     }, requests_fn=engine.tracer.snapshot,
         # /debug/memory gains the KV pool capacity document (pool bytes +
         # estimated max-concurrent sequences) next to the buffer census

@@ -24,9 +24,25 @@ cd "$(dirname "$0")/.."
 SHARDS="${TIER1_SHARDS:-2}"
 SHARD_TIMEOUT="${TIER1_SHARD_TIMEOUT:-870}"
 LOG_DIR="${TIER1_LOG_DIR:-/tmp}"
+mkdir -p "$LOG_DIR"
 
 total_dots=0
 rc=0
+
+# Fast static-analysis stage (graftlint, docs/static-analysis.md): AST-only,
+# never initializes a JAX backend, finishes in seconds. Runs BEFORE the
+# pytest shards so a trace-purity / lock-discipline / doc-drift violation
+# fails tier-1 without waiting out two ~870s shards; the shards still run so
+# a lint failure never hides a test regression (worst rc wins, same policy
+# as a failing shard). --json artifact lands next to the shard logs for CI.
+lint_log="$LOG_DIR/_t1_lint.log"
+timeout -k 5 120 python scripts/lint.py --json "$LOG_DIR/_t1_lint.json" \
+  2>&1 | tee "$lint_log"
+lint_rc=${PIPESTATUS[0]}
+echo "LINT rc=${lint_rc}"
+if [ "$lint_rc" -ne 0 ]; then
+  rc=$lint_rc
+fi
 for k in $(seq 1 "$SHARDS"); do
   log="$LOG_DIR/_t1_shard${k}of${SHARDS}.log"
   rm -f "$log"

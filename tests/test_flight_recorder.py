@@ -17,7 +17,6 @@ import importlib.util
 import json
 import logging
 import os
-import re
 import subprocess
 import sys
 import time
@@ -490,52 +489,26 @@ def test_postmortem_drill_supervisor_abort(tmp_path):
 
 
 # -------------------------------------------------------------- doc drift
-_INSTRUMENT_RE = re.compile(
-    r"""\.(?:counter|gauge|histogram)\(\s*f?["']([^"']+)["']"""
-)
-_SET_GAUGES_RE = re.compile(r"""\.set_gauges\(\s*["']([^"']+)["']""")
-
-
-def _emitted_metric_tokens():
-    """Every metric name the package can emit, found by scanning the
-    instrument-creation call sites. f-string names reduce to their static
-    family prefix (``span.{name}`` -> ``span.``)."""
-    tokens = set()
-    pkg = os.path.join(_REPO, "veomni_tpu")
-    for dirpath, _dirs, files in os.walk(pkg):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            src = open(os.path.join(dirpath, fname)).read()
-            for name in _INSTRUMENT_RE.findall(src):
-                token = name.split("{")[0]
-                if token:  # fully-dynamic names (registry internals) skip
-                    tokens.add(token)
-            for prefix in _SET_GAUGES_RE.findall(src):
-                tokens.add(prefix + ".")
-    return tokens
-
-
 def test_every_emitted_metric_family_is_documented():
     """Doc-drift gate: a metric family emitted at runtime that is absent
     from docs/observability.md fails CI — new metrics can't ship
-    undocumented."""
-    tokens = _emitted_metric_tokens()
-    # sanity: the scan actually sees the load-bearing families — including
-    # the cost/devmem observatory modules' registry call sites (PR 10)
-    for expected in ("serve.queue_wait_s", "serve.tpot_s", "span.dropped",
-                     "integrity.ckpt_quarantined", "resilience.anomalies",
-                     "retry.attempts", "recompiles", "span.", "train.",
-                     "cost.", "cost.programs", "cost.compile_s", "mem.",
-                     "serve.kv_pool_bytes", "serve.kv_max_concurrent_seqs",
-                     # fleet & comm observatory call sites (PR 11)
-                     "comm.programs", "fleet.step_time_skew_s",
-                     "fleet.slowest_rank", "fleet.stragglers"):
-        assert expected in tokens, f"scanner lost {expected!r}"
-    doc = open(os.path.join(_REPO, "docs", "observability.md")).read()
-    missing = sorted(t for t in tokens if t not in doc)
-    assert not missing, (
+    undocumented.
+
+    Since ISSUE 13 the scan lives in the static-analysis framework
+    (``veomni_tpu/analysis/drift.py``: AST instrument-creation call sites,
+    the same sanity-pinned family list, plus the analysis-subtree pin) —
+    this test keeps its name and CI behavior by delegating to that pass,
+    so a regression fails here exactly like it did in PR 6."""
+    from veomni_tpu.analysis import drift
+    from veomni_tpu.analysis.core import RepoIndex
+
+    index = RepoIndex.load(_REPO)
+    sanity = [f for f in drift.sanity(index) if f.rule == "drift/scan-sanity"]
+    assert not sanity, "\n".join(f.format() for f in sanity)
+    findings = drift.metric_findings(index)
+    assert not findings, (
         "metric families emitted at runtime but absent from "
-        f"docs/observability.md: {missing} — document them (metric "
-        "reference tables) or stop emitting them"
+        "docs/observability.md:\n"
+        + "\n".join(f.format() for f in findings)
+        + "\n— document them (metric reference tables) or stop emitting them"
     )

@@ -1,0 +1,9 @@
+"""Drift fixture: one documented and one undocumented train.* knob."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class TrainingArguments:
+    documented_knob: int = 1
+    mystery_knob: int = 0  # EXPECT: drift/knob-undocumented

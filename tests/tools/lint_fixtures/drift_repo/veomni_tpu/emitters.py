@@ -1,0 +1,43 @@
+"""Drift fixture: metric / env / registry-op surfaces, half undocumented."""
+
+import os
+
+
+class _Reg:
+    def counter(self, name):
+        return name
+
+    def gauge(self, name):
+        return name
+
+    def set_gauges(self, prefix, values):
+        return prefix
+
+    def register(self, op, impl):
+        def deco(fn):
+            return fn
+
+        return deco
+
+
+reg = _Reg()
+KERNEL_REGISTRY = _Reg()
+
+
+def emit(kind):
+    reg.counter("documented.count")
+    reg.gauge("ghost.gauge")  # EXPECT: drift/metric-undocumented
+    reg.counter(f"family.{kind}")
+    reg.set_gauges("stats", {})
+    os.environ.get("VEOMNI_DOCUMENTED")
+    os.environ.get("VEOMNI_GHOST")  # EXPECT: drift/env-undocumented
+
+
+@KERNEL_REGISTRY.register("documented_op", "xla")
+def _op_a(x):
+    return x
+
+
+@KERNEL_REGISTRY.register("ghost_op", "xla")  # EXPECT: drift/registry-op-undocumented
+def _op_b(x):
+    return x

@@ -1,0 +1,15 @@
+"""Drift fixture: one documented and one undocumented fault point."""
+
+KNOWN_POINTS = (
+    "ckpt.save",
+    "step.ghost",  # EXPECT: drift/fault-point-undocumented
+)
+
+
+def fault_point(name):
+    return name
+
+
+def site():
+    fault_point("ckpt.save")
+    fault_point("data.phantom")  # EXPECT: drift/fault-point-undocumented

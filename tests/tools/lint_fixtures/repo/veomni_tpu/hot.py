@@ -1,0 +1,60 @@
+"""Positive fixtures: trace-purity + recompile-hazard rules.
+
+Every marked line must fire exactly its rule; unmarked lines must stay
+clean (the sanctioned TRACE_COUNTS bump below pins the negative case).
+"""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GLOBAL_STATE = {}
+TRACE_COUNTS = {"step": 0}
+
+
+def _bucket_pow2(n, floor=16):
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def impure_step(x, scale):
+    TRACE_COUNTS["step"] += 1  # sanctioned trace-counter pattern: clean
+    GLOBAL_STATE["last"] = 1  # EXPECT: trace-purity/global-mutation
+    t = time.time()  # EXPECT: trace-purity/host-time
+    r = random.random()  # EXPECT: trace-purity/host-random
+    print("tracing")  # EXPECT: trace-purity/io
+    host = np.asarray(x)  # EXPECT: trace-purity/host-sync
+    v = x.item()  # EXPECT: trace-purity/host-sync
+    f = float(x)  # EXPECT: trace-purity/host-cast
+    y = jnp.sum(x) * scale + t + r + f
+    if y > 0:  # EXPECT: recompile-hazard/traced-branch
+        y = y + 1
+    return y, host, v
+
+
+step = jax.jit(impure_step)
+
+_jitted_entry = jax.jit(lambda tokens, bucket: tokens[:bucket],
+                        static_argnums=(1,))
+
+
+def caller(tokens):
+    good = _jitted_entry(tokens, _bucket_pow2(len(tokens)))
+    bad = _jitted_entry(tokens, len(tokens))  # EXPECT: recompile-hazard/unbucketed-static-arg
+    return good, bad
+
+
+class Engine:
+    def __init__(self):
+        self._step = self._build()
+
+    def _build(self):
+        return jax.jit(lambda a, width: a, static_argnums=(1,))
+
+    def tick(self, xs):
+        return self._step(xs, xs.shape[0])  # EXPECT: recompile-hazard/unbucketed-static-arg

@@ -1,0 +1,56 @@
+"""Positive fixtures: lock-discipline rules.
+
+Locked paths (``with``-block and acquire-style) must stay clean; the
+unlocked read/write, the unknown lock, and the detached annotation must
+each fire exactly their rule.
+"""
+
+import threading
+
+_registry = {}  # guarded-by: _registry_lock
+_registry_lock = threading.Lock()
+_ghost = 0  # guarded-by: _missing_lock  # EXPECT: lock-discipline/unknown-lock
+
+
+def put(k, v):
+    with _registry_lock:
+        _registry[k] = v
+
+
+def peek():
+    return dict(_registry)  # EXPECT: lock-discipline/unlocked-read
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._count += 1
+
+    def size(self):
+        return self._count  # EXPECT: lock-discipline/unlocked-read
+
+    def drop(self):
+        self._items = []  # EXPECT: lock-discipline/unlocked-write
+
+    def bounded_drop(self):
+        if not self._lock.acquire(timeout=1.0):
+            return
+        try:
+            self._items = []  # acquire-style evidence: clean
+        finally:
+            self._lock.release()
+
+
+def shadowing_local():
+    _registry = {"local": True}  # a LOCAL, not the guarded global: clean
+    return _registry
+
+
+def detached():  # guarded-by: _lock  # EXPECT: lock-discipline/bad-annotation
+    return None

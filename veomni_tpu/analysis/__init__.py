@@ -1,0 +1,28 @@
+"""graftlint — repo-native static analysis (docs/static-analysis.md).
+
+AST-based, JAX-free. Four pass families over one parse-once
+:class:`~veomni_tpu.analysis.core.RepoIndex`:
+
+* ``trace-purity``     — host syncs / impure constructs reachable from the
+  known jit roots (train step, decode buckets, engine paged steps);
+* ``recompile-hazard`` — unbucketed shape-feeding static args at jit call
+  sites, python branching on traced values;
+* ``lock-discipline``  — ``# guarded-by: <lock>`` annotations vs AST lock
+  evidence in the threaded modules;
+* ``drift``            — metrics / ``train.*`` knobs / ``VEOMNI_*`` env
+  knobs / fault points / registry ops vs the docs.
+
+Entry points: ``scripts/lint.py`` (CLI, ``--json`` for CI) and the tier-1
+gate ``tests/test_static_analysis.py``. Suppressions live in
+``analysis/allowlist.toml`` — every entry needs a justification, and stale
+entries fail the gate.
+"""
+
+from veomni_tpu.analysis.core import (  # noqa: F401
+    Allowlist,
+    Finding,
+    LintResult,
+    RepoIndex,
+    get_passes,
+    run_lint,
+)

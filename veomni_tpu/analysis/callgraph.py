@@ -1,0 +1,495 @@
+"""Call-graph machinery for the trace-purity / recompile-hazard passes.
+
+Builds, per module, the tables AST-level name resolution needs (module-level
+defs, import bindings, ``name = _alias.attr`` re-exports, class methods),
+finds every **jit root** — the callables handed to ``jax.jit`` (positional
+arg, ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators, lambdas inline)
+— and walks the call graph from those roots to the set of functions whose
+bodies execute **at trace time**. That set is what the purity rules scan:
+an ``.item()`` three calls below ``paged_decode_step`` is just as much a
+host sync as one in the jitted body itself.
+
+Resolution is deliberately best-effort: a call through a parameter (the
+trainer's ``loss_fn``), a dict dispatch, or an unresolvable attribute is
+skipped, never guessed. The known jit sites this repo cares about
+(``train/train_step.py`` ``build_train_step``, ``models/decode.py``
+prefill/decode/verify buckets, the engine's ``paged_*`` steps) all bind
+their callees by name, so the walk covers them; the boundary is documented
+in docs/static-analysis.md and pinned by the sanity check in
+:func:`veomni_tpu.analysis.purity.run`.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from veomni_tpu.analysis.core import (
+    RepoIndex,
+    SourceFile,
+    attr_chain,
+    parent_map,
+    qualname_map,
+)
+
+_CALLGRAPH_CACHE: Dict[int, "CallGraph"] = {}
+
+
+def get_callgraph(index: RepoIndex) -> "CallGraph":
+    """One CallGraph per index — the purity and recompile passes share the
+    (comparatively expensive) build."""
+    cg = _CALLGRAPH_CACHE.get(id(index))
+    if cg is None:
+        cg = CallGraph(index)
+        _CALLGRAPH_CACHE.clear()  # hold at most one index alive
+        _CALLGRAPH_CACHE[id(index)] = cg
+    return cg
+
+
+#: attribute reads that yield STATIC (python-level) values off a traced
+#: array — referencing these never makes an expression traced
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "maxlen"}
+
+#: calls whose result is static regardless of argument tracedness
+STATIC_CALLS = {"len", "range", "isinstance", "hasattr", "getattr", "type",
+                "id", "repr", "str"}
+
+
+@dataclass
+class FuncInfo:
+    """One analyzable callable (def or lambda) with its home module."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    sf: SourceFile
+    qualname: str
+
+    @property
+    def key(self) -> Tuple[str, int]:
+        return (self.sf.path, id(self.node))
+
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in getattr(a, "posonlyargs", [])]
+        names += [p.arg for p in a.args]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        names += [p.arg for p in a.kwonlyargs]
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+@dataclass
+class JitRoot:
+    func: FuncInfo
+    static_names: Set[str]
+    site_sf: SourceFile
+    site_line: int
+
+
+@dataclass
+class _ModuleTables:
+    defs: Dict[str, ast.AST] = field(default_factory=dict)
+    #: local name -> ("module", dotted) or ("from", dotted, orig)
+    imports: Dict[str, Tuple] = field(default_factory=dict)
+    #: local name -> (alias, attr) for module-level ``x = _alias.attr``
+    reexports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: class name -> {method name: node}
+    classes: Dict[str, Dict[str, ast.AST]] = field(default_factory=dict)
+    #: module-level assigned names (global-mutation detection)
+    globals: Set[str] = field(default_factory=set)
+
+
+class CallGraph:
+    def __init__(self, index: RepoIndex):
+        self.index = index
+        self.tables: Dict[str, _ModuleTables] = {}
+        self.parents: Dict[str, Dict[ast.AST, ast.AST]] = {}
+        self.quals: Dict[str, Dict[ast.AST, str]] = {}
+        for sf in index.files.values():
+            self.tables[sf.path] = _build_tables(sf.tree)
+            self.parents[sf.path] = parent_map(sf.tree)
+            self.quals[sf.path] = qualname_map(sf.tree)
+
+    # ------------------------------------------------------------- resolution
+    def module_binding(self, sf: SourceFile, name: str) -> Optional[str]:
+        """Dotted module a local name is bound to (``import x as name``)."""
+        b = self.tables[sf.path].imports.get(name)
+        if b and b[0] == "module":
+            return b[1]
+        return None
+
+    def resolve_in_module(self, module: str, name: str,
+                          depth: int = 0) -> Optional[FuncInfo]:
+        """Find def ``name`` in dotted ``module``, following one re-export
+        or ``from``-import hop (the ``ops/__init__.py`` pattern)."""
+        sf = self.index.by_module.get(module)
+        if sf is None or depth > 2:
+            return None
+        t = self.tables[sf.path]
+        node = t.defs.get(name)
+        if node is not None:
+            return FuncInfo(node, sf, self.quals[sf.path].get(node, name))
+        rx = t.reexports.get(name)
+        if rx is not None:
+            alias_mod = self._binding_module(sf, rx[0])
+            if alias_mod:
+                return self.resolve_in_module(alias_mod, rx[1], depth + 1)
+        b = t.imports.get(name)
+        if b and b[0] == "from":
+            return self.resolve_in_module(b[1], b[2], depth + 1)
+        return None
+
+    def resolve_name(self, sf: SourceFile, at: ast.AST,
+                     name: str) -> Optional[FuncInfo]:
+        """Resolve a bare Name at AST position ``at``: nested defs in
+        enclosing function scopes, then module defs / imports."""
+        parents = self.parents[sf.path]
+        cur = parents.get(at)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                body = getattr(cur, "body", None)
+                if isinstance(body, list):
+                    for stmt in body:
+                        for child in ast.walk(stmt):
+                            if isinstance(child, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef)) \
+                                    and child.name == name \
+                                    and self._nearest_function(
+                                        child, parents) is cur:
+                                return FuncInfo(
+                                    child, sf,
+                                    self.quals[sf.path].get(child, name),
+                                )
+            cur = parents.get(cur)
+        if sf.module:
+            got = self.resolve_in_module(sf.module, name)
+            if got is not None:
+                return got
+        # scripts (no module name): resolve against the local tables only
+        t = self.tables[sf.path]
+        node = t.defs.get(name)
+        if node is not None:
+            return FuncInfo(node, sf, self.quals[sf.path].get(node, name))
+        b = t.imports.get(name)
+        if b and b[0] == "from":
+            return self.resolve_in_module(b[1], b[2], 1)
+        return None
+
+    def _binding_module(self, sf: SourceFile, name: str) -> Optional[str]:
+        """Module a local name denotes: ``import x as name`` OR
+        ``from pkg import submodule as name`` (a from-import whose target
+        is itself a module in the index)."""
+        mod = self.module_binding(sf, name)
+        if mod is not None:
+            return mod
+        b = self.tables[sf.path].imports.get(name)
+        if b and b[0] == "from":
+            dotted = f"{b[1]}.{b[2]}"
+            if dotted in self.index.by_module:
+                return dotted
+        return None
+
+    @staticmethod
+    def _nearest_function(node: ast.AST, parents) -> Optional[ast.AST]:
+        """Closest enclosing function/lambda (a def nested in an ``if``
+        inside a function still scopes to that function)."""
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def resolve_callee(self, sf: SourceFile,
+                       call: ast.Call) -> Optional[FuncInfo]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self.resolve_name(sf, call, fn.id)
+        chain = attr_chain(fn)
+        if not chain or len(chain) < 2:
+            return None
+        if chain[0] == "self" and len(chain) == 2:
+            # method on the enclosing class
+            parents = self.parents[sf.path]
+            cur: Optional[ast.AST] = call
+            while cur is not None and not isinstance(cur, ast.ClassDef):
+                cur = parents.get(cur)
+            if isinstance(cur, ast.ClassDef):
+                meths = self.tables[sf.path].classes.get(cur.name, {})
+                node = meths.get(chain[1])
+                if node is not None:
+                    return FuncInfo(
+                        node, sf, self.quals[sf.path].get(node, chain[1])
+                    )
+            return None
+        mod = self.module_binding(sf, chain[0])
+        if mod is None:
+            b = self.tables[sf.path].imports.get(chain[0])
+            if b and b[0] == "from":
+                mod = f"{b[1]}.{b[2]}"  # ``from veomni_tpu import ops``
+        if mod is not None and len(chain) == 2:
+            return self.resolve_in_module(mod, chain[1])
+        return None
+
+    # --------------------------------------------------------------- jit roots
+    def is_jit_ref(self, sf: SourceFile, node: ast.AST) -> bool:
+        """Does this expression denote ``jax.jit``?"""
+        chain = attr_chain(node)
+        if chain == ["jax", "jit"]:
+            return True
+        if isinstance(node, ast.Name):
+            b = self.tables[sf.path].imports.get(node.id)
+            return bool(b and b[0] == "from" and b[1] == "jax"
+                        and b[2] == "jit")
+        return False
+
+    def jit_roots(self) -> List[JitRoot]:
+        roots: List[JitRoot] = []
+        for sf in self.index.files.values():
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and self.is_jit_ref(
+                        sf, node.func) and node.args:
+                    fi = self._root_target(sf, node.args[0])
+                    if fi is not None:
+                        roots.append(JitRoot(
+                            func=fi,
+                            static_names=_static_names(node, fi),
+                            site_sf=sf, site_line=node.lineno,
+                        ))
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        jr = self._decorator_root(sf, node, dec)
+                        if jr is not None:
+                            roots.append(jr)
+        return roots
+
+    def _root_target(self, sf: SourceFile,
+                     arg: ast.AST) -> Optional[FuncInfo]:
+        if isinstance(arg, ast.Lambda):
+            q = self.quals[sf.path]
+            return FuncInfo(arg, sf, q.get(arg, "<lambda>"))
+        if isinstance(arg, ast.Name):
+            return self.resolve_name(sf, arg, arg.id)
+        if isinstance(arg, ast.Attribute):
+            chain = attr_chain(arg)
+            if chain and len(chain) == 2:
+                mod = self.module_binding(sf, chain[0])
+                if mod is None:
+                    b = self.tables[sf.path].imports.get(chain[0])
+                    if b and b[0] == "from":
+                        mod = f"{b[1]}.{b[2]}"
+                if mod:
+                    return self.resolve_in_module(mod, chain[1])
+        return None
+
+    def _decorator_root(self, sf: SourceFile, fn: ast.AST,
+                        dec: ast.AST) -> Optional[JitRoot]:
+        fi = FuncInfo(fn, sf, self.quals[sf.path].get(fn, fn.name))
+        if self.is_jit_ref(sf, dec):
+            return JitRoot(fi, set(), sf, dec.lineno)
+        if isinstance(dec, ast.Call):
+            if self.is_jit_ref(sf, dec.func):
+                return JitRoot(fi, _static_names(dec, fi), sf, dec.lineno)
+            # @partial(jax.jit, static_argnums=...)
+            if isinstance(dec.func, ast.Name) and dec.func.id == "partial" \
+                    and dec.args and self.is_jit_ref(sf, dec.args[0]):
+                return JitRoot(fi, _static_names(dec, fi), sf, dec.lineno)
+        return None
+
+    # ------------------------------------------------------------ traced walk
+    def traced_functions(self) -> Dict[Tuple[str, int], "TracedFunc"]:
+        """BFS from the jit roots. A locally-defined function *referenced*
+        (not just called) inside traced code is traced too — scan/vmap/cond
+        bodies are passed by name, and at trace time they all run."""
+        out: Dict[Tuple[str, int], TracedFunc] = {}
+        queue: List[TracedFunc] = []
+        for root in self.jit_roots():
+            tf = TracedFunc(root.func, static_names=root.static_names,
+                            is_root=True, via=f"jit@{root.site_sf.path}:"
+                            f"{root.site_line}")
+            if root.func.key not in out:
+                out[root.func.key] = tf
+                queue.append(tf)
+        while queue:
+            tf = queue.pop()
+            fi = tf.func
+            body = getattr(fi.node, "body", None)
+            nodes = body if isinstance(body, list) else [body]
+            for stmt in nodes:
+                for node in ast.walk(stmt):
+                    callee: Optional[FuncInfo] = None
+                    if isinstance(node, ast.Call):
+                        callee = self.resolve_callee(fi.sf, node)
+                    elif isinstance(node, ast.Name) and isinstance(
+                            node.ctx, ast.Load):
+                        # function reference (scan body, vmap arg, ...)
+                        maybe = self.resolve_name(fi.sf, node, node.id)
+                        if maybe is not None and isinstance(
+                                maybe.node, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            callee = maybe
+                    if callee is None or callee.key in out:
+                        continue
+                    sub = TracedFunc(
+                        callee, static_names=set(), is_root=False,
+                        via=f"{fi.sf.path}:{fi.qualname}",
+                    )
+                    out[callee.key] = sub
+                    queue.append(sub)
+        return out
+
+
+@dataclass
+class TracedFunc:
+    func: FuncInfo
+    static_names: Set[str]
+    is_root: bool
+    via: str  # human-readable provenance for finding messages
+
+    def traced_locals(self, cg: CallGraph) -> Set[str]:
+        """Names that definitely hold traced values inside this function:
+        non-static root params, plus locals assigned from expressions that
+        reference traced names or jax/jnp calls (one fixpoint sweep).
+        Non-root functions' params are *unknown*, treated untraced — the
+        branch/cast rules prefer silence over false alarms there."""
+        traced: Set[str] = set()
+        if self.is_root:
+            traced |= set(self.func.param_names()) - self.static_names
+        body = getattr(self.func.node, "body", None)
+        nodes = body if isinstance(body, list) else [body]
+        for _ in range(3):  # tiny fixpoint; function bodies are short
+            grew = False
+            for stmt in nodes:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        continue
+                    tgt = None
+                    if isinstance(node, ast.Assign):
+                        tgt, val = node.targets, node.value
+                    elif isinstance(node, ast.AugAssign):
+                        tgt, val = [node.target], node.value
+                    else:
+                        continue
+                    if not expr_is_traced(val, traced):
+                        continue
+                    for t in tgt:
+                        for el in (t.elts if isinstance(
+                                t, (ast.Tuple, ast.List)) else [t]):
+                            if isinstance(el, ast.Name) \
+                                    and el.id not in traced:
+                                traced.add(el.id)
+                                grew = True
+            if not grew:
+                break
+        return traced
+
+
+def expr_is_traced(node: ast.AST, traced_names: Set[str]) -> bool:
+    """Conservative 'does this expression produce a traced value': it
+    references a known-traced name, or calls into jnp/jax — with
+    static-yielding attribute reads (``x.shape``), static builtins
+    (``len``), and is/in comparisons pruned."""
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id in STATIC_CALLS:
+            return False
+        chain = attr_chain(node.func)
+        if chain and chain[0] in ("jnp", "jax", "lax"):
+            return True
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in node.ops):
+            return False
+    if isinstance(node, ast.Name):
+        return node.id in traced_names
+    for child in ast.iter_child_nodes(node):
+        if expr_is_traced(child, traced_names):
+            return True
+    return False
+
+
+def _static_names(jit_call: ast.Call, fi: FuncInfo) -> Set[str]:
+    """Static parameter names from a jax.jit call's static_argnums /
+    static_argnames keywords, mapped onto the wrapped callable's params."""
+    params = fi.param_names()
+    names: Set[str] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            for idx in _const_ints(kw.value):
+                if 0 <= idx < len(params):
+                    names.add(params[idx])
+        elif kw.arg == "static_argnames":
+            for s in _const_strs(kw.value):
+                names.add(s)
+    return names
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+        return out
+    return []
+
+
+def _const_strs(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [el.value for el in node.elts
+                if isinstance(el, ast.Constant)
+                and isinstance(el.value, str)]
+    return []
+
+
+def _build_tables(tree: ast.AST) -> _ModuleTables:
+    t = _ModuleTables()
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            t.defs[node.name] = node
+            t.globals.add(node.name)
+        elif isinstance(node, ast.ClassDef):
+            t.globals.add(node.name)
+            meths = {}
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    meths[sub.name] = sub
+            t.classes[node.name] = meths
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else \
+                    alias.name.split(".")[0]
+                t.imports[local] = ("module", target)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    t.imports[local] = ("from", node.module, alias.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else \
+                [node.target]
+            for tgt in targets:
+                for el in (tgt.elts if isinstance(
+                        tgt, (ast.Tuple, ast.List)) else [tgt]):
+                    if isinstance(el, ast.Name):
+                        t.globals.add(el.id)
+            value = getattr(node, "value", None)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(value, ast.Attribute):
+                chain = attr_chain(value)
+                if chain and len(chain) == 2:
+                    t.reexports[node.targets[0].id] = (chain[0], chain[1])
+    return t

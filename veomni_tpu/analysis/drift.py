@@ -1,0 +1,323 @@
+"""drift: code-vs-docs gates (the PR 6 metric scan, generalized).
+
+Five sub-gates, one rule family. Each scans a *code* surface for the names
+it exports to operators and requires every name to appear in the relevant
+docs — so a knob/metric/fault-point/op can't ship (or rot) undocumented:
+
+* ``drift/metric-undocumented``       — metric families created via
+  ``.counter("...")`` / ``.gauge("...")`` / ``.histogram("...")`` /
+  ``.set_gauges("prefix", ...)`` (f-string names reduce to their static
+  prefix) vs ``docs/observability.md``. This is the PR 6 doc-drift gate
+  ported out of ``tests/test_flight_recorder.py``; the old test now
+  delegates here.
+* ``drift/knob-undocumented``         — ``TrainingArguments`` fields
+  (``arguments/arguments_types.py``) vs ``train.<field>`` anywhere in
+  ``docs/*.md``.
+* ``drift/env-undocumented``          — ``VEOMNI_*`` string literals read
+  anywhere in the scanned code vs ``docs/*.md``.
+* ``drift/fault-point-undocumented``  — ``resilience/faults.py``
+  ``KNOWN_POINTS`` plus every ``fault_point("...")`` call-site literal vs
+  ``docs/resilience.md``.
+* ``drift/registry-op-undocumented``  — ``KERNEL_REGISTRY.register(op,
+  impl)`` names vs ``docs/performance.md`` + ``docs/serving.md``.
+
+A ``drift/scan-sanity`` guard pins load-bearing facts about the scan
+itself: the metric scan must still see the known families (losing
+``serve.tpot_s`` means the scanner broke, not that serving stopped
+emitting), and the analyzed file set must include the ``analysis/``
+subtree (the linter lints itself; excluding it from the walk fails CI).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from veomni_tpu.analysis.core import (
+    Finding,
+    RepoIndex,
+    attr_chain,
+    const_str,
+    fstring_prefix,
+)
+
+#: metric families the scanner must keep seeing (PR 6 list + later tiers);
+#: losing one is scanner rot, reported as drift/scan-sanity
+SANITY_METRIC_TOKENS = (
+    "serve.queue_wait_s", "serve.tpot_s", "span.dropped",
+    "integrity.ckpt_quarantined", "resilience.anomalies",
+    "retry.attempts", "recompiles", "span.", "train.",
+    "cost.", "cost.programs", "cost.compile_s", "mem.",
+    "serve.kv_pool_bytes", "serve.kv_max_concurrent_seqs",
+    "comm.programs", "fleet.step_time_skew_s",
+    "fleet.slowest_rank", "fleet.stragglers",
+)
+
+#: the analysis subtree pins ITSELF into the scanned file set — a walk that
+#: silently drops the linter's own sources must fail the gate
+SANITY_SCANNED_FILES = (
+    "veomni_tpu/analysis/core.py",
+    "veomni_tpu/analysis/callgraph.py",
+    "veomni_tpu/analysis/purity.py",
+    "veomni_tpu/analysis/recompile.py",
+    "veomni_tpu/analysis/locks.py",
+    "veomni_tpu/analysis/drift.py",
+)
+
+_INSTRUMENT_METHODS = ("counter", "gauge", "histogram")
+_ENV_RE = re.compile(r"^VEOMNI_[A-Z0-9_]+$")
+_ENV_DOC_RE = re.compile(r"VEOMNI_[A-Z0-9_]+")
+_TRAIN_KNOB_DOC_RE = re.compile(r"train\.[a-z0-9_]+")
+
+
+def run(index: RepoIndex) -> List[Finding]:
+    # the instrument-creation scan walks every AST once; share it between
+    # the sanity pins and the metric gate (docs reads are memoized on the
+    # index for the same reason)
+    tokens = emitted_metric_tokens(index)
+    out: List[Finding] = []
+    out.extend(sanity(index, tokens=tokens))
+    out.extend(metric_findings(index, tokens=tokens))
+    out.extend(knob_findings(index))
+    out.extend(env_findings(index))
+    out.extend(fault_findings(index))
+    out.extend(registry_findings(index))
+    return out
+
+
+def sanity(index: RepoIndex, tokens=None) -> List[Finding]:
+    out = []
+    for path in SANITY_SCANNED_FILES:
+        if path not in index.files:
+            out.append(Finding(
+                rule="drift/scan-sanity", path=path, line=0, symbol="",
+                message=(
+                    "analysis subtree file missing from the scanned index — "
+                    "the linter no longer lints itself"
+                ),
+            ))
+    if tokens is None:
+        tokens = emitted_metric_tokens(index)
+    tokens = {t for t, _ in tokens}
+    for expected in SANITY_METRIC_TOKENS:
+        if expected not in tokens:
+            out.append(Finding(
+                rule="drift/scan-sanity",
+                path="veomni_tpu/analysis/drift.py", line=0, symbol="",
+                message=(
+                    f"metric scanner lost {expected!r} — the instrument-"
+                    "creation scan broke (or the family really moved; "
+                    "update SANITY_METRIC_TOKENS only in that case)"
+                ),
+            ))
+    return out
+
+
+# ------------------------------------------------------------------- metrics
+def emitted_metric_tokens(index: RepoIndex
+                          ) -> List[Tuple[str, Tuple[str, int]]]:
+    """Every metric family the package can emit, from the instrument-
+    creation call sites under veomni_tpu/ (AST, not regex: a name in a
+    comment or docstring is not an emission). f-string names reduce to
+    their static family prefix (``span.{name}`` -> ``span.``); fully
+    dynamic names (registry internals) are skipped."""
+    tokens: List[Tuple[str, Tuple[str, int]]] = []
+    for sf in index.files.values():
+        if not sf.path.startswith("veomni_tpu/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                    node.func, ast.Attribute):
+                continue
+            meth = node.func.attr
+            if meth in _INSTRUMENT_METHODS and node.args:
+                name = const_str(node.args[0])
+                if name is None:
+                    name = fstring_prefix(node.args[0])
+                if name:
+                    tokens.append((name.split("{")[0],
+                                   (sf.path, node.lineno)))
+            elif meth == "set_gauges" and node.args:
+                prefix = const_str(node.args[0])
+                if prefix:
+                    tokens.append((prefix + ".", (sf.path, node.lineno)))
+    return tokens
+
+
+def metric_findings(index: RepoIndex, tokens=None) -> List[Finding]:
+    """The ported PR 6 gate (tests/test_flight_recorder.py delegates
+    here): every emitted metric family must appear in
+    docs/observability.md."""
+    doc = index.doc_text("observability.md")
+    if tokens is None:
+        tokens = emitted_metric_tokens(index)
+    out = []
+    seen: Set[str] = set()
+    for token, (path, line) in sorted(tokens):
+        if token in seen or token in doc:
+            continue
+        seen.add(token)
+        out.append(Finding(
+            rule="drift/metric-undocumented", path=path, line=line,
+            symbol="",
+            message=(
+                f"metric family {token!r} is emitted at runtime but absent "
+                "from docs/observability.md — document it (metric reference "
+                "tables) or stop emitting it"
+            ),
+        ))
+    return out
+
+
+# --------------------------------------------------------------------- knobs
+def train_knob_fields(index: RepoIndex) -> List[Tuple[str, int]]:
+    sf = index.files.get("veomni_tpu/arguments/arguments_types.py")
+    if sf is None:
+        return []
+    fields: List[Tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == \
+                "TrainingArguments":
+            for sub in node.body:
+                if isinstance(sub, ast.AnnAssign) and isinstance(
+                        sub.target, ast.Name):
+                    fields.append((sub.target.id, sub.lineno))
+    return fields
+
+
+def knob_findings(index: RepoIndex) -> List[Finding]:
+    # EXACT token match against `train.<name>` occurrences in the docs —
+    # substring containment would count `train.lr` as documented the moment
+    # any longer-named knob (`train.lr_decay_style`) is, defeating the gate
+    documented = set(_TRAIN_KNOB_DOC_RE.findall(index.all_docs_text()))
+    out = []
+    path = "veomni_tpu/arguments/arguments_types.py"
+    for name, line in train_knob_fields(index):
+        if f"train.{name}" not in documented:
+            out.append(Finding(
+                rule="drift/knob-undocumented", path=path, line=line,
+                symbol="TrainingArguments",
+                message=(
+                    f"train.{name} is a config surface but appears in no "
+                    "docs/*.md — add it to a knob table"
+                ),
+            ))
+    return out
+
+
+# ----------------------------------------------------------------- env knobs
+def env_knob_literals(index: RepoIndex) -> Dict[str, Tuple[str, int]]:
+    """Every VEOMNI_* string literal in the scanned code, first site wins."""
+    found: Dict[str, Tuple[str, int]] = {}
+    for sf in index.files.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                    node.value, str) and _ENV_RE.match(node.value):
+                found.setdefault(node.value, (sf.path, node.lineno))
+    return found
+
+
+def env_findings(index: RepoIndex) -> List[Finding]:
+    # EXACT token match (same reason as knob_findings: substring would let
+    # VEOMNI_COST_CENSUS masquerade as documentation for
+    # VEOMNI_COST_CENSUS_SCAN_CORRECT's shorter prefix and vice versa)
+    documented = set(_ENV_DOC_RE.findall(index.all_docs_text()))
+    out = []
+    for name, (path, line) in sorted(env_knob_literals(index).items()):
+        if name not in documented:
+            out.append(Finding(
+                rule="drift/env-undocumented", path=path, line=line,
+                symbol="",
+                message=(
+                    f"env knob {name} is read by the code but appears in no "
+                    "docs/*.md — add it to a knob table"
+                ),
+            ))
+    return out
+
+
+# -------------------------------------------------------------- fault points
+def fault_point_names(index: RepoIndex) -> Dict[str, Tuple[str, int]]:
+    names: Dict[str, Tuple[str, int]] = {}
+    faults = index.files.get("veomni_tpu/resilience/faults.py")
+    if faults is not None:
+        for node in ast.walk(faults.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "KNOWN_POINTS" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for el in node.value.elts:
+                    s = const_str(el)
+                    if s:
+                        names.setdefault(s, (faults.path, el.lineno))
+    for sf in index.files.values():
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name) and node.func.id == "fault_point" \
+                    and node.args:
+                s = const_str(node.args[0])
+                if s:
+                    names.setdefault(s, (sf.path, node.lineno))
+    return names
+
+
+def fault_findings(index: RepoIndex) -> List[Finding]:
+    doc = index.doc_text("resilience.md")
+    out = []
+    for name, (path, line) in sorted(fault_point_names(index).items()):
+        if name not in doc:
+            out.append(Finding(
+                rule="drift/fault-point-undocumented", path=path, line=line,
+                symbol="",
+                message=(
+                    f"fault point {name!r} exists in code but is absent "
+                    "from docs/resilience.md's fault-point catalog"
+                ),
+            ))
+    return out
+
+
+# ------------------------------------------------------------- registry ops
+def registered_ops(index: RepoIndex
+                   ) -> List[Tuple[str, str, Tuple[str, int]]]:
+    """(op, impl, site) for every KERNEL_REGISTRY.register call (used as a
+    decorator factory or called directly)."""
+    out: List[Tuple[str, str, Tuple[str, int]]] = []
+    for sf in index.files.values():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain or chain[-1] != "register":
+                continue
+            if "KERNEL_REGISTRY" not in chain and not (
+                    len(chain) == 2 and chain[0] == "self"):
+                continue
+            if len(node.args) < 2:
+                continue
+            op, impl = const_str(node.args[0]), const_str(node.args[1])
+            if op and impl and "KERNEL_REGISTRY" in chain:
+                out.append((op, impl, (sf.path, node.lineno)))
+    return out
+
+
+def registry_findings(index: RepoIndex) -> List[Finding]:
+    doc = index.doc_text("performance.md", "serving.md")
+    out = []
+    seen: Set[str] = set()
+    for op, impl, (path, line) in sorted(registered_ops(index)):
+        for token, what in ((op, "op"), (impl, f"impl of op {op!r}")):
+            if token in seen or token in doc:
+                continue
+            seen.add(token)
+            out.append(Finding(
+                rule="drift/registry-op-undocumented", path=path, line=line,
+                symbol="",
+                message=(
+                    f"registry {what} {token!r} is registered but absent "
+                    "from docs/performance.md and docs/serving.md — add it "
+                    "to the op/impl tables"
+                ),
+            ))
+    return out

@@ -1,0 +1,316 @@
+"""lock-discipline: ``# guarded-by:`` annotated state vs AST lock evidence.
+
+Annotation grammar (docs/static-analysis.md):
+
+* instance attribute — trailing comment on the attribute's initialization
+  (normally in ``__init__``)::
+
+      self._events = deque()  # guarded-by: _lock
+
+  declares that every later ``self._events`` access in the class must sit
+  under ``with self._lock:`` (or in a function that explicitly calls
+  ``self._lock.acquire(...)`` — the try/finally pattern the flight
+  recorder's bounded-timeout dump uses).
+
+* module global — trailing comment on the module-level assignment::
+
+      _events = deque(maxlen=...)  # guarded-by: _ring_lock
+
+  declares the same for every function-level read/write of the global in
+  that module (module top-level code runs single-threaded at import and is
+  exempt, as is ``__init__`` for instance attributes — construction happens
+  before the object is shared).
+
+Rules:
+
+* ``lock-discipline/unlocked-read`` / ``unlocked-write`` — an annotated
+  attribute/global touched without lock evidence.
+* ``lock-discipline/unknown-lock`` — the annotation names a lock the
+  class/module never defines.
+* ``lock-discipline/bad-annotation`` — a ``guarded-by`` comment on a line
+  that is not a recognizable attribute/global assignment.
+
+The pass is annotation-driven: unannotated state is not judged (that is
+what keeps it adoptable), but every annotation is enforced everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from veomni_tpu.analysis.core import (
+    Finding,
+    RepoIndex,
+    SourceFile,
+    parent_map,
+    qualname_map,
+)
+
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+
+@dataclass
+class _Guard:
+    attr: str  # guarded attribute / global name
+    lock: str  # lock attribute / global name (no "self." prefix)
+    instance: bool  # True: self.<attr> in a class; False: module global
+    cls: str  # class name for instance guards
+    line: int
+
+
+def run(index: RepoIndex) -> List[Finding]:
+    out: List[Finding] = []
+    for sf in index.files.values():
+        out.extend(_scan_file(sf))
+    return out
+
+
+def _comment_annotations(sf: SourceFile) -> List[Tuple[int, str]]:
+    """(line, lockname) for every real ``# guarded-by:`` COMMENT token —
+    tokenize, not a line regex, so the grammar written out in docstrings
+    (or this pass's own regex literal) never reads as an annotation."""
+    import io
+    import tokenize
+
+    out: List[Tuple[int, str]] = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(sf.source).readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                m = GUARD_RE.search(tok.string)
+                if m:
+                    out.append((tok.start[0], m.group(1)))
+    except tokenize.TokenError:  # pragma: no cover - index parsed it
+        pass
+    return out
+
+
+def _scan_file(sf: SourceFile) -> List[Finding]:
+    annotations = _comment_annotations(sf)
+    if not annotations:
+        return []
+    parents = parent_map(sf.tree)
+    quals = qualname_map(sf.tree)
+    out: List[Finding] = []
+    guards: List[_Guard] = []
+    for lineno, lock in annotations:
+        g = _guard_for_line(sf, parents, lineno, lock)
+        if g is None:
+            out.append(Finding(
+                rule="lock-discipline/bad-annotation", path=sf.path,
+                line=lineno, symbol="",
+                message=(
+                    "guarded-by comment is not attached to a recognizable "
+                    "self.<attr> or module-global assignment"
+                ),
+            ))
+        else:
+            guards.append(g)
+
+    class_attrs = _class_attr_sets(sf)
+    for g in guards:
+        lock = g.lock[5:] if g.lock.startswith("self.") else g.lock
+        g.lock = lock
+        known = (lock in class_attrs.get(g.cls, set())) if g.instance else (
+            _module_defines(sf, lock)
+        )
+        if not known:
+            where = f"class {g.cls}" if g.instance else "module"
+            out.append(Finding(
+                rule="lock-discipline/unknown-lock", path=sf.path,
+                line=g.line, symbol=g.cls or "<module>",
+                message=(
+                    f"guarded-by names lock {lock!r} which the {where} "
+                    "never defines"
+                ),
+            ))
+
+    out.extend(_check_accesses(sf, parents, quals, guards))
+    return out
+
+
+def _guard_for_line(sf: SourceFile, parents, lineno: int,
+                    lock: str) -> Optional[_Guard]:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            continue
+        if node.lineno != lineno and getattr(node, "end_lineno",
+                                             node.lineno) != lineno:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id == "self":
+                cls = _enclosing_class(node, parents)
+                if cls is not None:
+                    return _Guard(attr=t.attr, lock=lock, instance=True,
+                                  cls=cls.name, line=lineno)
+            if isinstance(t, ast.Name) and _is_module_level(node, parents):
+                return _Guard(attr=t.id, lock=lock, instance=False,
+                              cls="", line=lineno)
+    return None
+
+
+def _enclosing_class(node: ast.AST, parents) -> Optional[ast.ClassDef]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _is_module_level(node: ast.AST, parents) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda, ast.ClassDef)):
+            return False
+        cur = parents.get(cur)
+    return True
+
+
+def _class_attr_sets(sf: SourceFile) -> Dict[str, Set[str]]:
+    """class name -> every ``self.X`` ever assigned in it (lock existence)."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                tgts = sub.targets if isinstance(sub, ast.Assign) else \
+                    [sub.target]
+                for t in tgts:
+                    if isinstance(t, ast.Attribute) and isinstance(
+                            t.value, ast.Name) and t.value.id == "self":
+                        attrs.add(t.attr)
+        out[node.name] = attrs
+    return out
+
+
+def _module_defines(sf: SourceFile, name: str) -> bool:
+    for node in ast.iter_child_nodes(sf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name) and node.target.id == name:
+            return True
+    return False
+
+
+def _check_accesses(sf: SourceFile, parents, quals,
+                    guards: List[_Guard]) -> List[Finding]:
+    out: List[Finding] = []
+    inst = {(g.cls, g.attr): g for g in guards if g.instance}
+    glob = {g.attr: g for g in guards if not g.instance}
+    if not inst and not glob:
+        return out
+    for node in ast.walk(sf.tree):
+        g: Optional[_Guard] = None
+        is_store = False
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self":
+            cls = _enclosing_class(node, parents)
+            if cls is None:
+                continue
+            g = inst.get((cls.name, node.attr))
+            is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+        elif isinstance(node, ast.Name):
+            g = glob.get(node.id)
+            is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+        if g is None:
+            continue
+        fn = _enclosing_function(node, parents)
+        if fn is None:
+            continue  # module top-level / class body: import-time, exempt
+        if g.instance and fn.name == "__init__":
+            continue  # construction precedes sharing
+        if node.lineno == g.line:
+            continue  # the annotated initialization itself
+        if isinstance(node, ast.Name) and not g.instance:
+            # a local shadowing the global (assigned without `global`) is a
+            # different variable entirely
+            if node.id not in _declared_globals(fn) and \
+                    _assigns_name(fn, node.id):
+                continue
+        if _lock_held(node, parents, fn, g):
+            continue
+        kind = "unlocked-write" if is_store else "unlocked-read"
+        what = f"self.{g.attr}" if g.instance else g.attr
+        lock = f"self.{g.lock}" if g.instance else g.lock
+        out.append(Finding(
+            rule=f"lock-discipline/{kind}", path=sf.path, line=node.lineno,
+            symbol=_symbol(node, parents, quals),
+            message=(
+                f"{what} is guarded-by {g.lock} but this "
+                f"{'write' if is_store else 'read'} has no `with {lock}:` "
+                f"(or {lock}.acquire) evidence"
+            ),
+        ))
+    return out
+
+
+def _symbol(node, parents, quals) -> str:
+    from veomni_tpu.analysis.core import enclosing_symbol
+
+    return enclosing_symbol(node, parents, quals)
+
+
+def _enclosing_function(node: ast.AST, parents):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _declared_globals(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def _assigns_name(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == name and isinstance(
+                node.ctx, ast.Store):
+            return True
+    return False
+
+
+def _lock_expr_matches(expr: ast.AST, g: _Guard) -> bool:
+    if g.instance:
+        return isinstance(expr, ast.Attribute) and expr.attr == g.lock \
+            and isinstance(expr.value, ast.Name) and expr.value.id == "self"
+    return isinstance(expr, ast.Name) and expr.id == g.lock
+
+
+def _lock_held(node: ast.AST, parents, fn: ast.AST, g: _Guard) -> bool:
+    # 1) lexical `with <lock>:` ancestor
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                if _lock_expr_matches(item.context_expr, g):
+                    return True
+        if cur is fn:
+            break
+        cur = parents.get(cur)
+    # 2) acquire-style: the enclosing function calls <lock>.acquire(...)
+    #    anywhere (the try/finally bounded-timeout pattern)
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and isinstance(
+                sub.func, ast.Attribute) and sub.func.attr == "acquire" \
+                and _lock_expr_matches(sub.func.value, g):
+            return True
+    return False

@@ -1,0 +1,291 @@
+"""trace-purity: host syncs / impure constructs reachable from jitted code.
+
+Walks the call graph from the repo's jit roots (``callgraph.jit_roots``) and
+scans every function that executes at trace time for constructs that either
+force a host sync on a traced value, make the traced program nondeterministic
+across traces, or mutate python state from inside tracing:
+
+* ``trace-purity/host-sync``   — ``.item()``, ``.block_until_ready()``,
+  ``jax.device_get``, ``np.asarray``/``np.array``/``np.copy``.
+* ``trace-purity/host-time``   — any ``time.*`` call (stdlib module).
+* ``trace-purity/host-random`` — any stdlib ``random.*`` call (``jax.random``
+  and ``np.random`` are not flagged; the former is traced, the latter is
+  caught as host-sync the moment its output meets a tracer).
+* ``trace-purity/io``          — ``print`` and ``logger``/``logging`` calls
+  (fire once per *trace*, i.e. unpredictably under bucketing — a log that
+  must exist belongs outside the jitted body).
+* ``trace-purity/global-mutation`` — assignment/store into module-level
+  state, except the pinned trace-counter pattern
+  (``TRACE_COUNTS[...] += 1`` / ``LAST_TRACE_SHAPES[...] = ...``), which is
+  the repo's sanctioned trace-time side channel (recompile detection).
+* ``trace-purity/host-cast``   — ``float()``/``int()``/``bool()`` on an
+  expression the tracedness analysis can prove traced (root params minus
+  the jit call's static args, and locals derived from them).
+
+A scan-sanity guard fails the pass if the traced set ever loses the named
+jit roots (train step, decode buckets, engine paged steps): an analyzer that
+silently stopped seeing the hot paths must fail CI, not pass vacuously.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from veomni_tpu.analysis.callgraph import (
+    CallGraph,
+    TracedFunc,
+    expr_is_traced,
+    get_callgraph,
+)
+from veomni_tpu.analysis.core import Finding, RepoIndex, attr_chain
+
+#: the sanctioned trace-time global-mutation pattern (train_step.py /
+#: models/decode.py trace counters — the recompile detector's substrate)
+ALLOWED_GLOBAL_MUTATION = {"TRACE_COUNTS", "LAST_TRACE_SHAPES"}
+
+#: functions the traced walk must always reach (ISSUE 13 root list); losing
+#: one is analyzer rot, reported as trace-purity/scan-sanity
+SANITY_TRACED = {
+    ("veomni_tpu/train/train_step.py", "build_train_step.step_fn"),
+    ("veomni_tpu/models/decode.py", "_prefill_impl"),
+    ("veomni_tpu/models/decode.py", "_decode_impl"),
+    ("veomni_tpu/models/decode.py", "paged_decode_step"),
+    ("veomni_tpu/models/decode.py", "paged_prefill_step"),
+    ("veomni_tpu/models/decode.py", "paged_verify_step"),
+    ("veomni_tpu/models/decode.py", "sample_tokens"),
+    ("veomni_tpu/serving/engine.py",
+     "InferenceEngine._build_decode_step.impl"),
+    ("veomni_tpu/serving/engine.py",
+     "InferenceEngine._build_prefill_chunk_step.impl"),
+    ("veomni_tpu/serving/engine.py",
+     "InferenceEngine._build_verify_step.impl"),
+}
+
+_LOG_NAMES = {"logger", "logging", "log"}
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
+                "fatal"}
+_NP_HOST_FNS = {"asarray", "array", "copy", "save", "load"}
+
+
+def run(index: RepoIndex) -> List[Finding]:
+    cg = get_callgraph(index)
+    traced = cg.traced_functions()
+    findings: List[Finding] = []
+
+    seen = {(tf.func.sf.path, tf.func.qualname) for tf in traced.values()}
+    for path, qual in sorted(SANITY_TRACED):
+        if path in index.files and (path, qual) not in seen:
+            findings.append(Finding(
+                rule="trace-purity/scan-sanity", path=path, line=1,
+                symbol=qual,
+                message=(
+                    f"jit-root walk no longer reaches {qual!r} — the "
+                    "analyzer lost a known hot path (update SANITY_TRACED "
+                    "only if the root really moved)"
+                ),
+            ))
+
+    for tf in traced.values():
+        findings.extend(_scan_function(cg, tf))
+    return findings
+
+
+def _scan_function(cg: CallGraph, tf: TracedFunc) -> List[Finding]:
+    fi = tf.func
+    sf = fi.sf
+    out: List[Finding] = []
+    traced_names = tf.traced_locals(cg)
+    local_stores = _local_names(fi.node)
+    global_decls = _global_decls(fi.node)
+    body = getattr(fi.node, "body", None)
+    nodes = body if isinstance(body, list) else [body]
+
+    def finding(rule: str, node: ast.AST, msg: str) -> None:
+        out.append(Finding(
+            rule=rule, path=sf.path, line=node.lineno, symbol=fi.qualname,
+            message=f"{msg} (traced via {tf.via})",
+        ))
+
+    for stmt in nodes:
+        for node in _walk_skip_nested_defs(stmt):
+            if isinstance(node, ast.Call):
+                self_rule = _call_rule(cg, sf, node)
+                if self_rule is not None:
+                    finding(self_rule[0], node, self_rule[1])
+                cast = _host_cast(node, traced_names)
+                if cast is not None:
+                    finding("trace-purity/host-cast", node, cast)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    msg = _global_store(cg, sf, t, local_stores, global_decls)
+                    if msg is not None:
+                        finding("trace-purity/global-mutation", node, msg)
+    return out
+
+
+def _call_rule(cg: CallGraph, sf, call: ast.Call):
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "print":
+            return ("trace-purity/io",
+                    "print() inside traced code runs once per trace, "
+                    "not per step")
+        return None
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "item" and not call.args:
+            return ("trace-purity/host-sync",
+                    ".item() forces a device->host sync on a traced value")
+        if fn.attr == "block_until_ready":
+            return ("trace-purity/host-sync",
+                    ".block_until_ready() inside traced code is a host sync")
+        chain = attr_chain(fn)
+        if not chain:
+            return None
+        base_mod = cg.module_binding(sf, chain[0])
+        if base_mod == "time":
+            return ("trace-purity/host-time",
+                    f"time.{fn.attr}() reads the host clock at trace time — "
+                    "the compiled program bakes in one reading")
+        if base_mod == "random":
+            return ("trace-purity/host-random",
+                    f"stdlib random.{fn.attr}() at trace time bakes one draw "
+                    "into the compiled program; use jax.random with a "
+                    "threaded key")
+        if base_mod == "numpy" and fn.attr in _NP_HOST_FNS:
+            return ("trace-purity/host-sync",
+                    f"np.{fn.attr}() on a traced value forces a host "
+                    "transfer (use jnp)")
+        if chain[:2] == ["jax", "device_get"] or (
+                base_mod == "jax" and chain[1:] == ["device_get"]):
+            return ("trace-purity/host-sync",
+                    "jax.device_get inside traced code is a host sync")
+        if chain[0] in _LOG_NAMES and len(chain) == 2 \
+                and fn.attr.split("_")[0] in _LOG_METHODS:
+            return ("trace-purity/io",
+                    f"{chain[0]}.{fn.attr}() inside traced code fires once "
+                    "per trace (bucket-dependent), not per step — log from "
+                    "the host loop instead")
+    return None
+
+
+def _host_cast(call: ast.Call, traced_names: Set[str]) -> Optional[str]:
+    fn = call.func
+    if not (isinstance(fn, ast.Name) and fn.id in ("float", "int", "bool")):
+        return None
+    if len(call.args) != 1:
+        return None
+    if expr_is_traced(call.args[0], traced_names):
+        return (f"{fn.id}() on a traced value forces a device->host sync "
+                "and bakes the result into the compiled program")
+    return None
+
+
+def _global_store(cg: CallGraph, sf, target: ast.AST,
+                  local_stores: Set[str],
+                  global_decls: Set[str]) -> Optional[str]:
+    """A store that mutates module-level state from traced code."""
+    mod_globals = cg.tables[sf.path].globals
+    if isinstance(target, ast.Name):
+        if target.id in global_decls:
+            if target.id in ALLOWED_GLOBAL_MUTATION:
+                return None
+            return (f"rebinding module global {target.id!r} at trace time "
+                    "(runs once per compile, silently stale after)")
+        return None
+    root: Optional[str] = None
+    base_name: Optional[str] = None
+    if isinstance(target, (ast.Subscript, ast.Attribute)):
+        chain = attr_chain(target.value)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            root = chain[0]
+            base_name = chain[0]
+            if root in local_stores or root not in mod_globals:
+                return None
+        elif len(chain) == 2:
+            # module-alias attribute: decode_mod.TRACE_COUNTS[...]
+            mod = cg.module_binding(sf, chain[0])
+            if mod is None:
+                b = cg.tables[sf.path].imports.get(chain[0])
+                if b and b[0] == "from":
+                    mod = f"{b[1]}.{b[2]}"
+            if mod is None:
+                return None
+            target_sf = cg.index.by_module.get(mod)
+            if target_sf is None or chain[1] not in \
+                    cg.tables[target_sf.path].globals:
+                return None
+            root, base_name = ".".join(chain), chain[1]
+        else:
+            return None
+        if base_name in ALLOWED_GLOBAL_MUTATION:
+            return None
+        return (f"store into module-level state {root!r} from traced code "
+                "(trace-time side effect; only the TRACE_COUNTS/"
+                "LAST_TRACE_SHAPES counter pattern is sanctioned)")
+    return None
+
+
+def _walk_skip_nested_defs(stmt: ast.AST):
+    """ast.walk that does not descend into nested def/class bodies (those
+    are traced — and scanned — as their own functions when referenced)."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def _local_names(fn_node: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    args = getattr(fn_node, "args", None)
+    if args is not None:
+        names |= {p.arg for p in getattr(args, "posonlyargs", [])}
+        names |= {p.arg for p in args.args}
+        names |= {p.arg for p in args.kwonlyargs}
+        if args.vararg:
+            names.add(args.vararg.arg)
+        if args.kwarg:
+            names.add(args.kwarg.arg)
+    body = getattr(fn_node, "body", None)
+    nodes = body if isinstance(body, list) else [body]
+    for stmt in nodes:
+        for node in _walk_skip_nested_defs(stmt):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    for el in (t.elts if isinstance(
+                            t, (ast.Tuple, ast.List)) else [t]):
+                        if isinstance(el, ast.Name):
+                            names.add(el.id)
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                t = node.target
+                for el in (t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t]):
+                    if isinstance(el, ast.Name):
+                        names.add(el.id)
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if isinstance(item.optional_vars, ast.Name):
+                        names.add(item.optional_vars.id)
+    return names
+
+
+def _global_decls(fn_node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    body = getattr(fn_node, "body", None)
+    nodes = body if isinstance(body, list) else [body]
+    for stmt in nodes:
+        for node in _walk_skip_nested_defs(stmt):
+            if isinstance(node, ast.Global):
+                out.update(node.names)
+    return out

@@ -1,0 +1,296 @@
+"""recompile-hazard: shape-feeding static args and python-on-traced branches.
+
+Two checks, both aimed at the compile-count discipline the decode/serving
+stack pins with ``TRACE_COUNTS`` (one compile per power-of-two bucket,
+never per request/length):
+
+* ``recompile-hazard/unbucketed-static-arg`` — a call into a known jitted
+  entry point passes a static argument derived from a data length
+  (``len(...)`` / ``.shape``) without routing it through a bucketing helper
+  (any callable whose name contains ``bucket``, e.g.
+  ``models/decode.py::_bucket_pow2``). Every distinct raw length is a new
+  compile (20-40s each on TPU).
+
+  Jitted entry points are found two ways: direct bindings
+  (``x = jax.jit(...)`` / ``x = instrument_jit(...)``, including
+  ``self.x = ...``) and factory methods whose ``return`` is such a call
+  (the engine's ``_build_*_step`` pattern), with static positions read from
+  ``static_argnums``. Bindings the resolver cannot see (tuple unpacks,
+  dict dispatch) fall back to a narrow check: only an argument that IS
+  directly ``len(...)`` or a ``.shape`` access is flagged.
+
+* ``recompile-hazard/traced-branch`` — ``if``/``while``/ternary/``assert``
+  inside traced code whose condition the tracedness analysis proves traced
+  (root params minus static args, locals derived from them, jnp/jax call
+  results). Python control flow on a traced value either crashes at trace
+  time or — via ``static_argnums`` promotion — recompiles per value;
+  either way it belongs in ``lax.cond``/``jnp.where``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from veomni_tpu.analysis.callgraph import (
+    CallGraph,
+    expr_is_traced,
+    get_callgraph,
+)
+from veomni_tpu.analysis.core import Finding, RepoIndex, attr_chain
+
+
+def run(index: RepoIndex) -> List[Finding]:
+    cg = get_callgraph(index)
+    findings: List[Finding] = []
+    for sf in index.files.values():
+        findings.extend(_scan_static_args(cg, sf))
+    findings.extend(_scan_traced_branches(cg))
+    return findings
+
+
+# ---------------------------------------------------------- static-arg check
+def _is_instrument_jit(cg: CallGraph, sf, node: ast.AST) -> bool:
+    if isinstance(node, ast.Name) and node.id == "instrument_jit":
+        return True
+    chain = attr_chain(node)
+    return bool(chain and chain[-1] == "instrument_jit")
+
+
+def _jit_wrap_static(cg: CallGraph, sf,
+                     value: ast.AST) -> Optional[Set[int]]:
+    """If ``value`` is a jax.jit(...) / instrument_jit(...) expression,
+    return its static positional indices (possibly empty); else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    if cg.is_jit_ref(sf, value.func):
+        return set(_static_positions(value))
+    if _is_instrument_jit(cg, sf, value.func):
+        pos = set(_static_positions(value))
+        for arg in value.args:
+            inner = _jit_wrap_static(cg, sf, arg)
+            if inner is not None:
+                pos |= inner
+        return pos
+    return None
+
+
+def _static_positions(call: ast.Call) -> List[int]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            node = kw.value
+            if isinstance(node, ast.Constant) and isinstance(node.value, int):
+                return [node.value]
+            if isinstance(node, (ast.Tuple, ast.List)):
+                return [el.value for el in node.elts
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)]
+    return []
+
+
+def _collect_bindings(cg: CallGraph, sf) -> Dict[Tuple[str, str], Set[int]]:
+    """(kind, name) -> static positions. kind is "name" (bare) or "self"
+    (instance attribute)."""
+    bindings: Dict[Tuple[str, str], Set[int]] = {}
+    factories: Dict[str, Set[int]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    static = _jit_wrap_static(cg, sf, sub.value)
+                    if static is not None:
+                        factories[node.name] = \
+                            factories.get(node.name, set()) | static
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        static = _jit_wrap_static(cg, sf, node.value)
+        if static is None and isinstance(node.value, ast.Call):
+            # self.x = self._build_y()  /  x = build_y(...)
+            fn = node.value.func
+            fname = None
+            if isinstance(fn, ast.Name):
+                fname = fn.id
+            else:
+                chain = attr_chain(fn)
+                if chain and len(chain) == 2 and chain[0] == "self":
+                    fname = chain[1]
+            if fname in factories:
+                static = set(factories[fname])
+        if static is None:
+            continue
+        if isinstance(target, ast.Name):
+            key = ("name", target.id)
+        else:
+            chain = attr_chain(target)
+            if chain and len(chain) == 2 and chain[0] == "self":
+                key = ("self", chain[1])
+            else:
+                continue
+        bindings[key] = bindings.get(key, set()) | static
+    return bindings
+
+
+def _scan_static_args(cg: CallGraph, sf) -> List[Finding]:
+    bindings = _collect_bindings(cg, sf)
+    if not bindings:
+        return []
+    out: List[Finding] = []
+    parents = cg.parents[sf.path]
+    quals = cg.quals[sf.path]
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        key = None
+        if isinstance(fn, ast.Name):
+            key = ("name", fn.id)
+        else:
+            chain = attr_chain(fn)
+            if chain and len(chain) == 2 and chain[0] == "self":
+                key = ("self", chain[1])
+        if key is None or key not in bindings:
+            continue
+        static = bindings[key]
+        enclosing = _enclosing_function(node, parents)
+        assigns = _function_assign_values(enclosing) if enclosing else {}
+        for i, arg in enumerate(node.args):
+            if static and i not in static:
+                continue
+            if not static and not _is_direct_shape(arg):
+                continue
+            if _shape_feeding(arg, assigns) and not _bucketed(arg, assigns):
+                from veomni_tpu.analysis.core import enclosing_symbol
+
+                out.append(Finding(
+                    rule="recompile-hazard/unbucketed-static-arg",
+                    path=sf.path, line=node.lineno,
+                    symbol=enclosing_symbol(node, parents, quals),
+                    message=(
+                        f"static arg {i} of jitted {key[1]!r} derives from a "
+                        "data length (len()/.shape) without a bucketing "
+                        "helper — every distinct raw length is a fresh "
+                        "compile; route it through _bucket_pow2-style "
+                        "power-of-two bucketing"
+                    ),
+                ))
+    return out
+
+
+def _enclosing_function(node: ast.AST, parents) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _function_assign_values(fn_node: ast.AST) -> Dict[str, ast.AST]:
+    """Last-wins map of simple Name assignments in a function body."""
+    out: Dict[str, ast.AST] = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+    return out
+
+
+def _bucket_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    name = fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else ""
+    )
+    return "bucket" in name
+
+
+def _bucketed(expr: ast.AST, assigns: Dict[str, ast.AST],
+              depth: int = 0) -> bool:
+    if depth > 3:
+        return False
+    if _bucket_call(expr):
+        return True
+    if isinstance(expr, ast.Name) and expr.id in assigns:
+        return _bucketed(assigns[expr.id], assigns, depth + 1)
+    return False
+
+
+def _shape_feeding(expr: ast.AST, assigns: Dict[str, ast.AST],
+                   depth: int = 0) -> bool:
+    if depth > 3:
+        return False
+    for node in ast.walk(expr):
+        if _bucket_call(node):
+            return False  # bucketed sub-expression sanitizes the length
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "len":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "shape":
+            return True
+        if isinstance(node, ast.Name) and node.id in assigns:
+            if _shape_feeding(assigns[node.id], {}, depth + 1) \
+                    and not _bucketed(assigns[node.id], assigns, depth + 1):
+                return True
+    return False
+
+
+def _is_direct_shape(arg: ast.AST) -> bool:
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) \
+            and arg.func.id == "len":
+        return True
+    if isinstance(arg, ast.Attribute) and arg.attr == "shape":
+        return True
+    if isinstance(arg, ast.Subscript) and isinstance(
+            arg.value, ast.Attribute) and arg.value.attr == "shape":
+        return True
+    return False
+
+
+# -------------------------------------------------------- traced-branch check
+def _scan_traced_branches(cg: CallGraph) -> List[Finding]:
+    out: List[Finding] = []
+    for tf in cg.traced_functions().values():
+        fi = tf.func
+        traced_names = tf.traced_locals(cg)
+        if not traced_names:
+            continue
+        body = getattr(fi.node, "body", None)
+        nodes = body if isinstance(body, list) else [body]
+        for stmt in nodes:
+            for node in _walk_no_defs(stmt):
+                test = None
+                kind = ""
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.IfExp):
+                    test, kind = node.test, "ternary"
+                elif isinstance(node, ast.Assert):
+                    test, kind = node.test, "assert"
+                if test is None or not expr_is_traced(test, traced_names):
+                    continue
+                out.append(Finding(
+                    rule="recompile-hazard/traced-branch",
+                    path=fi.sf.path, line=node.lineno, symbol=fi.qualname,
+                    message=(
+                        f"python {kind} on a traced value inside jitted "
+                        f"code (via {tf.via}) — this either fails at trace "
+                        "time or forces per-value recompiles; use "
+                        "lax.cond/jnp.where"
+                    ),
+                ))
+    return out
+
+
+def _walk_no_defs(stmt: ast.AST):
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)

@@ -19,6 +19,14 @@ re-raised to the consumer WITH the worker's original traceback (the frames
 that actually failed — not a bare sentinel ending iteration); ``close()`` is
 idempotent and signal-handler-safe, and a consumer blocked on the queue wakes
 with :class:`PrefetcherClosed` instead of absorbing a preemption deadline.
+
+Threading contract (lock-discipline audit, docs/static-analysis.md): this
+module deliberately has NO lock-guarded state, so it carries no
+``# guarded-by:`` annotations. Worker→consumer handoff is the internally
+locked ``queue.Queue``; ``_stop`` is a ``threading.Event``; ``_closed`` is
+a write-once bool latch whose racy read path is re-checked each loop
+iteration; ``_consumed_state``/``_finished`` are touched only by the
+consumer thread.
 """
 
 from __future__ import annotations

@@ -236,7 +236,7 @@ class FleetMonitor:
         self._window_interval_s = 0.0  # observed sync cadence (debug_doc)
         self._last_window_t: Optional[float] = None
         self._lock = threading.Lock()
-        self._last: Optional[Dict[str, Any]] = None
+        self._last: Optional[Dict[str, Any]] = None  # guarded-by: _lock
         self.straggler_count = 0
         set_active_monitor(self)
 
@@ -384,7 +384,7 @@ class FleetMonitor:
         return doc
 
 
-_ACTIVE: Optional[FleetMonitor] = None
+_ACTIVE: Optional[FleetMonitor] = None  # guarded-by: _ACTIVE_LOCK
 _ACTIVE_LOCK = threading.Lock()
 
 
@@ -395,7 +395,8 @@ def set_active_monitor(monitor: Optional[FleetMonitor]) -> None:
 
 
 def get_active_monitor() -> Optional[FleetMonitor]:
-    return _ACTIVE
+    with _ACTIVE_LOCK:
+        return _ACTIVE
 
 
 def debug_fleet_doc() -> Dict[str, Any]:

@@ -56,9 +56,12 @@ class FlightRecorder:
     def __init__(self, max_events: int = DEFAULT_MAX_EVENTS):
         self._lock = threading.Lock()
         self._dump_lock = threading.Lock()
-        self._events: deque = deque(maxlen=max(0, max_events) or None)
+        self._events: deque = deque(maxlen=max(0, max_events) or None)  # guarded-by: _lock
+        # _enabled is deliberately NOT lock-guarded: record()'s fast path
+        # reads it as a latch (one attribute check when disabled) and a
+        # torn read merely records/skips one borderline event
         self._enabled = max_events > 0
-        self._dropped = 0
+        self._dropped = 0  # guarded-by: _lock
         self.dump_dir = ""
         self.last_dump_path = ""
 
@@ -101,10 +104,12 @@ class FlightRecorder:
 
     @property
     def dropped(self) -> int:
-        return self._dropped
+        with self._lock:
+            return self._dropped
 
     def __len__(self) -> int:
-        return len(self._events)
+        with self._lock:
+            return len(self._events)
 
     def clear(self) -> None:
         with self._lock:
@@ -132,12 +137,17 @@ class FlightRecorder:
 
     def snapshot(self, limit: int = 200) -> Dict[str, Any]:
         """JSON-ready view for ``/debug/flight``."""
+        with self._lock:
+            evs = list(self._events)
+            dropped = self._dropped  # one locked pass: count matches events
+        if limit > 0:
+            evs = evs[-limit:]
         return {
             "rank": _process_index(),
             "enabled": self._enabled,
-            "dropped": self._dropped,
+            "dropped": dropped,
             "anchor": _anchor(),
-            "events": [_event_doc(ev) for ev in self.events(limit)],
+            "events": [_event_doc(ev) for ev in evs],
         }
 
     # a dump wedged on a dead filesystem (the watchdog abandons its side-
@@ -179,13 +189,16 @@ class FlightRecorder:
         from veomni_tpu.observability.spans import live_span_events
         from veomni_tpu.utils.helper import dump_thread_stacks
 
+        with self._lock:
+            evs = list(self._events)
+            dropped = self._dropped  # one locked pass: count matches events
         doc: Dict[str, Any] = {
             "schema": 1,
             "reason": reason,
             "rank": rank,
             "anchor": _anchor(),
-            "dropped": self._dropped,
-            "events": [_event_doc(ev) for ev in self.events()],
+            "dropped": dropped,
+            "events": [_event_doc(ev) for ev in evs],
             "metrics": get_registry().export_scalars(),
             "spans": [
                 {"name": n, "ts_ns": t0, "dur_ns": d, "tid": tid}
@@ -232,7 +245,7 @@ class FlightRecorder:
         log = logger.warning if reason == "sigterm" else logger.error
         log(
             "flight recorder: wrote post-mortem (%s, %d events, %d dropped) "
-            "-> %s", reason, len(doc["events"]), self._dropped, path,
+            "-> %s", reason, len(doc["events"]), dropped, path,
         )
         return path
 

@@ -63,7 +63,7 @@ class Counter:
 
     def __init__(self, name: str, lock: threading.RLock):
         self.name = name
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
         self._lock = lock
 
     def inc(self, amount: float = 1.0) -> None:
@@ -74,10 +74,12 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def snapshot(self) -> Dict[str, float]:
-        return {"value": self._value}
+        with self._lock:
+            return {"value": self._value}
 
 
 class Gauge:
@@ -87,7 +89,7 @@ class Gauge:
 
     def __init__(self, name: str, lock: threading.RLock):
         self.name = name
-        self._value = 0.0
+        self._value = 0.0  # guarded-by: _lock
         self._lock = lock
 
     def set(self, value: float) -> None:
@@ -100,10 +102,12 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def snapshot(self) -> Dict[str, float]:
-        return {"value": self._value}
+        with self._lock:
+            return {"value": self._value}
 
 
 class Histogram:
@@ -124,12 +128,12 @@ class Histogram:
             raise ValueError("max_samples must be >= 1")
         self.name = name
         self._lock = lock
-        self._samples: List[float] = []
+        self._samples: List[float] = []  # guarded-by: _lock
         self._max_samples = max_samples
-        self._count = 0
-        self._sum = 0.0
-        self._min = float("inf")
-        self._max = float("-inf")
+        self._count = 0  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._min = float("inf")  # guarded-by: _lock
+        self._max = float("-inf")  # guarded-by: _lock
         # crc32, not hash(): str hash is salted per process, which would
         # break the cross-restart reproducibility promised above
         self._rng = random.Random(0xC0FFEE ^ zlib.crc32(name.encode()))
@@ -140,7 +144,7 @@ class Histogram:
             tuple(sorted(float(b) for b in bucket_bounds))
             if bucket_bounds else None
         )
-        self._bins: List[int] = (
+        self._bins: List[int] = (  # guarded-by: _lock
             [0] * (len(self._bounds) + 1) if self._bounds else []
         )
 
@@ -166,11 +170,13 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self) -> float:
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def percentile(self, q: float) -> float:
         """Nearest-rank percentile over the reservoir, q in [0, 100]."""
@@ -246,10 +252,10 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.RLock()
-        self._metrics: Dict[str, Any] = {}
-        self._hooks: List[Callable[[int, Dict[str, float]], None]] = []
-        self._jsonl_path: Optional[str] = None
-        self._last_export: Optional[tuple] = None
+        self._metrics: Dict[str, Any] = {}  # guarded-by: _lock
+        self._hooks: List[Callable[[int, Dict[str, float]], None]] = []  # guarded-by: _lock
+        self._jsonl_path: Optional[str] = None  # guarded-by: _lock
+        self._last_export: Optional[tuple] = None  # guarded-by: _lock
 
     # ------------------------------------------------------------ instruments
     def _get_or_create(self, name: str, kind, **kwargs):
@@ -280,12 +286,14 @@ class MetricsRegistry:
         )
 
     def get(self, name: str):
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
 
     def histogram_sum(self, name: str) -> float:
         """Cumulative sum of a histogram, 0.0 if it doesn't exist yet (the
         goodput tracker deltas span histograms that may not have fired)."""
-        m = self._metrics.get(name)
+        with self._lock:
+            m = self._metrics.get(name)
         return m.sum if isinstance(m, Histogram) else 0.0
 
     def items_snapshot(self) -> List[tuple]:
@@ -368,7 +376,8 @@ class MetricsRegistry:
                     ) -> Optional[Dict[str, float]]:
         """The most recent ``export()`` payload; with ``step`` given, only
         if it matches (consumers use this to detect a fresh publish)."""
-        last = self._last_export
+        with self._lock:
+            last = self._last_export
         if last is None:
             return None
         if step is not None and last[0] != step:

@@ -146,10 +146,10 @@ class RequestTracer:
             "serve.preemptions_per_request"
         )
         self._lock = threading.Lock()
-        self._inflight: Dict[str, RequestTimeline] = {}
+        self._inflight: Dict[str, RequestTimeline] = {}  # guarded-by: _lock
         # finished timelines kept for chrome export / debugging, bounded so a
         # long-running pump never accumulates one timeline per request served
-        self._finished: deque = deque(maxlen=max_finished)
+        self._finished: deque = deque(maxlen=max_finished)  # guarded-by: _lock
         self.epoch = time.perf_counter()
 
     # ------------------------------------------------------------ transitions

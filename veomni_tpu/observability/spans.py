@@ -37,15 +37,19 @@ logger = get_logger(__name__)
 _enabled = False
 _profiler_active = False
 _epoch_ns: Optional[int] = None
-_events: deque = deque(maxlen=100_000)  # (name, t0_ns, dur_ns, tid)
-_dropped = 0  # ring evictions: a chrome trace missing its head is truncated,
-_warned_dropped = False  # not short — say so once (rank 0) + count forever
+# (name, t0_ns, dur_ns, tid) ring
+_events: deque = deque(maxlen=100_000)  # guarded-by: _ring_lock
+# ring evictions: a chrome trace missing its head is truncated,
+_dropped = 0  # guarded-by: _ring_lock
+# not short — say so once (rank 0) + count forever
+_warned_dropped = False  # guarded-by: _ring_lock
 # serializes the full-ring check + append + drop accounting: spans exit on
 # several threads (prefetch worker, commit thread), and an unlocked
 # check-then-act would undercount evictions right at the full boundary
 _ring_lock = threading.Lock()
 _tid_lock = threading.Lock()
-_tids: dict = {}  # thread ident -> small stable int
+# thread ident -> small stable int
+_tids: dict = {}  # guarded-by: _tid_lock
 
 
 class _NullSpan:
@@ -97,8 +101,10 @@ class _Span:
         ev = (self.name, self._t0, dur_ns, _tid())
         global _dropped, _warned_dropped
         evicted = warn = False
+        cap = 0
         with _ring_lock:
-            if len(_events) == _events.maxlen:
+            cap = _events.maxlen
+            if len(_events) == cap:
                 # once full (steady state on a long run) EVERY exit evicts:
                 # only the bookkeeping ints live under the lock — registry
                 # lookup and logging happen after release so concurrent
@@ -110,17 +116,20 @@ class _Span:
                     warn = True
             _events.append(ev)
         if evicted:
-            _note_dropped(1, warn)
+            _note_dropped(1, warn, cap)
         return False
 
 
-def _note_dropped(n: int, warn: bool) -> None:
+def _note_dropped(n: int, warn: bool, cap: int) -> None:
     """``n`` events were just evicted (full-ring append, or a shrink via
-    ``enable_spans``); the caller already bumped ``_dropped`` and claimed the
-    one-time warning under ``_ring_lock``. This mirrors the loss into the
-    ``span.dropped`` counter and warns ONCE (rank 0) — without this a
-    truncated chrome trace reads as a short run, not a long one missing its
-    head. Deliberately called OUTSIDE the ring lock."""
+    ``enable_spans``); the caller already bumped ``_dropped``, claimed the
+    one-time warning, and read the ring capacity ``cap`` under
+    ``_ring_lock``. This mirrors the loss into the ``span.dropped`` counter
+    and warns ONCE (rank 0) — without this a truncated chrome trace reads
+    as a short run, not a long one missing its head. Deliberately called
+    OUTSIDE the ring lock (registry + logging I/O must not serialize
+    concurrent span exits), which is why the capacity is passed in instead
+    of read from the guarded ring here."""
     get_registry().counter("span.dropped").inc(n)
     if warn:
         logger.warning_rank0(
@@ -128,7 +137,7 @@ def _note_dropped(n: int, warn: bool) -> None:
             "dropped — a chrome-trace dump will be missing its HEAD, not its "
             "tail. Raise enable_spans(max_events=...) or dump earlier; "
             "`span.dropped` counts the loss from here on.",
-            _events.maxlen,
+            cap,
         )
 
 
@@ -139,7 +148,8 @@ def span(name: str):
 
 def dropped_events() -> int:
     """Span-ring evictions so far (mirrors the ``span.dropped`` counter)."""
-    return _dropped
+    with _ring_lock:
+        return _dropped
 
 
 def chrome_epoch_ns() -> Optional[int]:
@@ -166,13 +176,13 @@ def enable_spans(max_events: int = 100_000) -> None:
     """Turn tracing on; resizes the event ring if ``max_events`` changed.
     The chrome-trace epoch is pinned on first enable so ts offsets stay
     comparable across enable/disable cycles in one process."""
-    global _enabled, _epoch_ns, _events
+    global _enabled, _epoch_ns, _events, _dropped, _warned_dropped
     if _epoch_ns is None:
         _epoch_ns = time.perf_counter_ns()
-    if _events.maxlen != max_events:
-        global _dropped, _warned_dropped
-        warn = False
-        with _ring_lock:
+    warn = False
+    evicted = 0
+    with _ring_lock:
+        if _events.maxlen != max_events:
             before = len(_events)
             _events = deque(_events, maxlen=max_events)
             # shrinking evicts the oldest entries: count them, same
@@ -183,8 +193,8 @@ def enable_spans(max_events: int = 100_000) -> None:
                 if not _warned_dropped:
                     _warned_dropped = True
                     warn = True
-        if evicted:
-            _note_dropped(evicted, warn)
+    if evicted:
+        _note_dropped(evicted, warn, max_events)
     _enabled = True
 
 
@@ -216,12 +226,13 @@ def dump_chrome_trace(path: str) -> int:
     rank = _process_index()
     with _ring_lock:  # a concurrent span exit mutates the deque mid-list()
         events = list(_events)
+        dropped = _dropped  # same locked pass: count matches the snapshot
     trace = [{
         "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
         # dropped rides along so a viewer of a truncated trace can see HOW
         # truncated (satellite of the one-time warning above)
         "args": {"name": f"veomni host spans (rank {rank})",
-                 "dropped_events": _dropped},
+                 "dropped_events": dropped},
     }]
     with _tid_lock:  # a thread registering its first span mutates the dict
         tids = sorted(_tids.items(), key=lambda kv: kv[1])

@@ -129,7 +129,15 @@ class InferenceEngine:
     ``submit()`` enqueues, ``step()`` advances every in-flight request by
     one token (and every in-flight prefill by one chunk), ``generate()``
     streams events, ``run()`` drains to completion. Single-threaded by
-    design: callers own the pump loop."""
+    design: callers own the pump loop.
+
+    Threading contract (lock-discipline audit, docs/static-analysis.md):
+    the engine holds no locks because only the pump thread touches its
+    state. Anything another thread needs — the exporter's HTTP handlers,
+    bench readers — goes through the thread-safe surfaces the engine
+    *publishes into*: the metrics registry gauges/counters and the
+    RequestTracer (both internally locked). Do not hand live engine or
+    scheduler attributes to another thread."""
 
     def __init__(self, params, cfg: TransformerConfig,
                  config: Optional[EngineConfig] = None):

@@ -116,6 +116,14 @@ class Watchdog:
     may be a bounded hiccup (slow shared fs) the retry layer absorbs, and the
     dump is the observability artifact either way. Re-arms after firing, so a
     long stall produces periodic dumps rather than one.
+
+    Threading contract (lock-discipline audit, docs/static-analysis.md):
+    no lock-guarded state, so no ``# guarded-by:`` annotations. Arming and
+    petting ride two ``threading.Event`` objects; ``stall_count`` /
+    ``last_dump`` / ``last_postmortem_path`` are written only by the
+    watchdog thread and read by observers AFTER a stall is signalled
+    (bench reads them from ``on_stall``, which the watchdog thread itself
+    invokes) — single-writer, causally-ordered reads.
     """
 
     # the post-mortem write gets its own deadline: when the stall IS a hung
