@@ -430,6 +430,39 @@ def run_bench(
         ckpt_quarantined = _integ["quarantined"]
         ckpt_fallbacks = _integ["fallbacks"]
 
+        # numerics-tier cost (observability/numerics.py): time the
+        # instrumented sibling step on the same batch and report its
+        # per-step overhead over the hot step — the continuously-measured
+        # price of a train.observability_numerics_interval step. Never
+        # fatal: a failure reports 0.0 and the bench line says why.
+        numerics_overhead_frac = 0.0
+        try:
+            if os.environ.get("BENCH_NUMERICS", "1") in ("0", ""):
+                raise RuntimeError("disabled via BENCH_NUMERICS=0")
+            from veomni_tpu.observability.numerics import NumericsSpec
+
+            num_step = build_train_step(
+                model.loss_fn, opt, ps,
+                state_shardings=shardings, batch_shardings=batch_shardings,
+                numerics_spec=NumericsSpec(),
+            )
+            # warmup compile, then a short timed loop (the sibling never
+            # donates, so `state` stays live for the delete below)
+            _s, _m, _h = num_step(state, batch)
+            _ = float(_m["loss"])
+            n_num = max(2, min(8, steps))
+            tn0 = time.perf_counter()
+            for _ in range(n_num):
+                _s, _m, _h = num_step(state, batch)
+            _ = float(_m["loss"])
+            t_num = (time.perf_counter() - tn0) / n_num
+            t_plain = dt / max(1, steps)
+            numerics_overhead_frac = max(0.0, t_num / t_plain - 1.0)
+            del _s, _m, _h
+        except Exception as e:
+            print(f"# numerics overhead probe unavailable: {e}",
+                  file=sys.stderr, flush=True)
+
         tokens = micro_bs * seq_len * steps
         tok_per_sec_chip = tokens / dt / n_chips
         analytic_per_step = FlopsCounter.from_config(cfg).batch_flops(
@@ -454,6 +487,7 @@ def run_bench(
                 "goodput_pct": gp.get("goodput_pct", 0.0),
                 "data_wait_frac": gp.get("data_wait_frac", 0.0),
                 "recompiles": recompiles,
+                "numerics_overhead_frac": numerics_overhead_frac,
                 "restore_verify_s": restore_verify_s,
                 "ckpt_quarantined": ckpt_quarantined,
                 "ckpt_fallbacks": ckpt_fallbacks,

@@ -50,6 +50,9 @@ def merge_dumps(paths):
             "reason": doc.get("reason", ""),
             "events": len(doc["events"]),
             "dropped": doc.get("dropped", 0),
+            # numerics observatory provenance (when the tier was armed):
+            # the first non-finite group this rank's anomaly re-run named
+            "numerics": doc.get("numerics"),
         })
         for ev in doc["events"]:
             merged.append({
@@ -72,6 +75,18 @@ def format_timeline(doc, tail=0):
             f"# rank {r['rank']}: {r['reason'] or '<no reason>'} — "
             f"{r['events']} events ({r['dropped']} dropped) [{r['path']}]"
         )
+        prov = (r.get("numerics") or {}).get("provenance") or {}
+        first = prov.get("first_nonfinite")
+        if first:
+            layer = (f" layer {first['layer']}"
+                     if first.get("layer") is not None else "")
+            lines.append(
+                f"# rank {r['rank']} numerics: first non-finite = "
+                f"{first['kind']} {first['group']}{layer} at step "
+                f"{prov.get('step')} "
+                f"({int(first.get('nonfinite_count', 0))} elements"
+                f"{', injected drill' if prov.get('injected') else ''})"
+            )
     events = doc["events"]
     if tail > 0:
         skipped = max(0, len(events) - tail)

@@ -55,6 +55,7 @@ for required in ("veomni_tpu.serving", "veomni_tpu.serving.engine",
                  "veomni_tpu.observability.flight_recorder",
                  "veomni_tpu.observability.request_trace",
                  "veomni_tpu.observability.cost",
+                 "veomni_tpu.observability.numerics",
                  "veomni_tpu.observability.devmem",
                  "veomni_tpu.observability.comm",
                  "veomni_tpu.observability.fleet"):

@@ -48,6 +48,10 @@ ALLOWED_GLOBAL_MUTATION = {"TRACE_COUNTS", "LAST_TRACE_SHAPES"}
 #: one is analyzer rot, reported as trace-purity/scan-sanity
 SANITY_TRACED = {
     ("veomni_tpu/train/train_step.py", "build_train_step.step_fn"),
+    # the numerics observatory's health summary runs INSIDE the jitted
+    # instrumented sibling step (ISSUE 14): losing it from the traced walk
+    # would let host syncs creep into the per-group stats unobserved
+    ("veomni_tpu/observability/numerics.py", "tree_health"),
     ("veomni_tpu/models/decode.py", "_prefill_impl"),
     ("veomni_tpu/models/decode.py", "_decode_impl"),
     ("veomni_tpu/models/decode.py", "paged_decode_step"),

@@ -215,6 +215,26 @@ class TrainingArguments:
     # a rank whose window-mean step time exceeds the fleet median by this
     # factor is named a straggler (rank-0 warning + flight event)
     observability_straggler_factor: float = 2.0
+    # numerics & training-health observatory (observability/numerics.py):
+    # every N steps the trainer runs the INSTRUMENTED sibling train step
+    # (same update math, one extra compiled program) that additionally
+    # emits per-param-group grad/param RMS, absmax, non-finite counts,
+    # update/weight ratio and dtype overflow-margin bits (scan-stacked
+    # layers as per-layer vectors), published as numerics.* gauges +
+    # /debug/numerics. When the resilience supervisor flags an anomalous
+    # step, the same already-fetched batch is re-run through it to produce
+    # a non-finite provenance doc (first offending group, grad vs param vs
+    # update) for the flight recorder and the anomaly post-mortem.
+    # 0 (default) = off: the training trajectory is byte-identical to a
+    # build without the tier.
+    observability_numerics_interval: int = 0
+    # cardinality cap on numerics param groups (deterministic coarsening:
+    # leaf paths collapse toward subtree roots, overflow merges into a
+    # '...rest' bucket)
+    observability_numerics_max_groups: int = 64
+    # health summaries retained in the in-memory history ring that rides
+    # into provenance docs, post-mortems and /debug/numerics
+    observability_numerics_history: int = 32
     enable_profiling: bool = False
     # VEOMNI_PROFILE_START / VEOMNI_PROFILE_END env vars override the window
     profile_start_step: int = 3

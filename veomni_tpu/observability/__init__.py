@@ -38,6 +38,15 @@ full profiler:
                  high-watermark tracking with a CPU fallback, KV-pool
                  capacity stats, and the OOM post-mortem payload
                  (``/debug/memory``).
+* ``numerics`` — numerics & training-health observatory: the instrumented
+                 sibling train step's per-param-group grad/param RMS,
+                 absmax, non-finite counts, update/weight ratio and
+                 overflow-margin bits (scan-stacked layers as per-layer
+                 vectors), a bounded health-history ring, and the
+                 non-finite provenance doc the resilience supervisor's
+                 anomaly re-run produces (``/debug/numerics``, the
+                 ``numerics.nonfinite`` flight event, the anomaly
+                 post-mortem).
 * ``comm``     — live collective census riding the cost census's compile:
                  per-program bytes by collective kind, predicted comm time
                  against the ICI peak, overlappable-vs-serialized pair
@@ -102,6 +111,13 @@ from veomni_tpu.observability.metrics import (
     get_registry,
     set_registry,
 )
+from veomni_tpu.observability.numerics import (
+    NumericsMonitor,
+    NumericsSpec,
+    attach_numerics_extra,
+    debug_numerics_doc,
+    tree_health,
+)
 from veomni_tpu.observability.request_trace import RequestTimeline, RequestTracer
 from veomni_tpu.observability.spans import (
     disable_spans,
@@ -125,12 +141,16 @@ __all__ = [
     "Histogram",
     "MetricsExporter",
     "MetricsRegistry",
+    "NumericsMonitor",
+    "NumericsSpec",
     "RecompileDetector",
     "RequestTimeline",
     "RequestTracer",
+    "attach_numerics_extra",
     "attach_oom_extra",
     "buffer_census",
     "configure_flight_recorder",
+    "debug_numerics_doc",
     "disable_spans",
     "dump_chrome_trace",
     "dump_postmortem",
@@ -152,6 +172,7 @@ __all__ = [
     "set_registry",
     "span",
     "spans_enabled",
+    "tree_health",
     "update_memory_gauges",
     "write_heartbeat",
 ]

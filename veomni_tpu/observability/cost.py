@@ -451,6 +451,13 @@ def get_cost_census() -> CostCensus:
 
 
 # --------------------------------------------------------------- window MFU
+#: jit sites the MFU window ignores by default: diagnostic programs whose
+#: occasional invocations would otherwise inflate the achieved-FLOPs sum of
+#: the window they land in (the numerics observatory's instrumented sibling
+#: step re-runs a batch the train-step site already counted)
+DIAGNOSTIC_SITES: Tuple[str, ...] = ("numerics_step",)
+
+
 class CostWindow:
     """Census-delta MFU/bandwidth over a wall-clock window.
 
@@ -458,12 +465,17 @@ class CostWindow:
     each program's new invocations by its census FLOPs/bytes and divides by
     the elapsed wall and the per-device peaks — the continuous analogue of
     ``bench.py``'s offline ``flops / dt / peak``. Census FLOPs are already
-    per device (partitioned module), so no world-size factor appears."""
+    per device (partitioned module), so no world-size factor appears.
+    ``exclude_sites`` (default :data:`DIAGNOSTIC_SITES`) keeps diagnostic
+    programs out of the utilization math; an explicit ``sites`` allowlist
+    wins over the exclusion."""
 
     def __init__(self, census: Optional[CostCensus] = None,
-                 sites: Optional[Tuple[str, ...]] = None):
+                 sites: Optional[Tuple[str, ...]] = None,
+                 exclude_sites: Optional[Tuple[str, ...]] = DIAGNOSTIC_SITES):
         self.census = census or get_cost_census()
         self.sites = tuple(sites) if sites else None
+        self.exclude_sites = tuple(exclude_sites) if exclude_sites else ()
         self._t0: Optional[float] = None
         self._base: Dict[Tuple[str, str], int] = {}
 
@@ -483,6 +495,8 @@ class CostWindow:
         ran = 0
         for key, calls in cur.items():
             if self.sites is not None and key[0] not in self.sites:
+                continue
+            if self.sites is None and key[0] in self.exclude_sites:
                 continue
             delta = calls - self._base.get(key, 0)
             if delta <= 0:

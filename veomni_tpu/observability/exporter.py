@@ -10,7 +10,11 @@ request timelines (``requests_fn``); ``/debug/memory`` the live buffer
 census + HBM watermark (plus the KV pool capacity document when
 ``memory_fn`` is wired — ``scripts/serve.py`` passes the engine's
 ``kv_capacity``); ``/debug/cost`` the compiled-program cost census
-with a scrape-to-scrape live MFU window; and ``/debug/fleet`` the
+with a scrape-to-scrape live MFU window; ``/debug/numerics`` the
+numerics observatory's latest per-group training-health summary,
+history ring and non-finite provenance (the process's active
+``NumericsMonitor``; a disabled stub names the knob); and
+``/debug/fleet`` the
 cross-rank view (per-rank step-time skew table, heartbeat freshness,
 collective census — ``fleet_fn`` or the process's active
 ``FleetMonitor``). Usable by both the trainer
@@ -212,6 +216,14 @@ class MetricsExporter:
                         )
 
                         doc = debug_cost_doc()
+                        self._send(200, json.dumps(doc, default=str).encode(),
+                                   "application/json")
+                    elif route == "/debug/numerics":
+                        from veomni_tpu.observability.numerics import (
+                            debug_numerics_doc,
+                        )
+
+                        doc = debug_numerics_doc()
                         self._send(200, json.dumps(doc, default=str).encode(),
                                    "application/json")
                     elif route == "/debug/fleet":

@@ -1,0 +1,463 @@
+"""Numerics & training-health observatory: per-layer-group telemetry with
+non-finite provenance.
+
+The four earlier observability tiers made the *system* transparent (spans,
+flight events, cost census, fleet skew); the training *math* stayed a black
+box — the supervisor sees one device-side ``step_ok`` bit and can only
+skip/rollback/abort without knowing which layer first went non-finite. At
+pjit/TPU scale, loss spikes and silent divergence dominate long-run failures
+(the TPUv4 pjit paper, PAPERS.md), and veScale's debuggability-first SPMD
+argument is exactly the case for making anomaly *attribution* a framework
+layer rather than a notebook exercise.
+
+Two-program design, so the steady-state hot path is untouched:
+
+* :func:`tree_health` runs INSIDE the jitted instrumented sibling step
+  (``train/train_step.py::build_train_step(numerics_spec=...)``) and
+  summarizes, per stable param-tree group (scan-stacked subtrees — any path
+  component ending in ``layers`` — keep their leading layer dim, so stats
+  are per-layer vectors): grad RMS / absmax / non-finite counts, param RMS
+  and non-finite counts, update/weight ratio, and dtype overflow-margin
+  bits. Group cardinality is capped with deterministic coarsening (drop
+  trailing path components, then merge the sorted tail into ``...rest``).
+* :class:`NumericsMonitor` is the host side: it fetches the health tree on
+  the trainer's ``train.observability_numerics_interval`` cadence, keeps a
+  bounded history ring, publishes worst-layer ``numerics.*`` gauges, and —
+  when the resilience supervisor flags an anomalous step — turns a re-run
+  of the *same already-fetched batch* through the instrumented step into a
+  provenance document: the first non-finite group (param beats grad beats
+  update, since a rotten param is upstream of everything), the offending
+  layer for stacked groups, and the recent health history. The doc lands in
+  the flight recorder (``numerics.nonfinite``), the anomaly post-mortem
+  (:func:`attach_numerics_extra`) and ``/debug/numerics``
+  (:func:`debug_numerics_doc`).
+
+Everything in :func:`tree_health` and below it must stay trace-pure — the
+graftlint trace-purity walk pins it as a jit-reachable root
+(``analysis/purity.py::SANITY_TRACED``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+# bare-name imports: these run at TRACE time inside the jitted sibling step
+# on static pytree structure only — binding them as plain names keeps the
+# static-analysis tracedness taint (anything assigned from a jax.* call)
+# away from the pure-python group bookkeeping they feed
+from jax.tree_util import tree_leaves, tree_leaves_with_path
+
+from veomni_tpu.observability.flight_recorder import record as flight_record
+from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: merged overflow bucket when the cardinality cap still can't hold every
+#: coarsened group name (deterministic: the sorted tail lands here)
+REST_GROUP = "...rest"
+
+#: provenance priority: a non-finite PARAM is upstream of every grad, and a
+#: non-finite grad is upstream of the update it produces
+PROVENANCE_KINDS = ("param", "grad", "update")
+
+
+@dataclass(frozen=True)
+class NumericsSpec:
+    """Static (trace-time) configuration of the health summary."""
+
+    max_groups: int = 64
+    eps: float = 1e-12
+
+
+# --------------------------------------------------------------- group naming
+def _path_str(path) -> str:
+    """KeyPath -> dotted string (same rendering as
+    ``parallel_plan.param_path_str``, duplicated to keep this module
+    importable without the parallel layer)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return ".".join(parts)
+
+
+def _is_stacked(path) -> bool:
+    """Scan-stacked subtree detection: the repo-wide convention is per-layer
+    tensors stacked on a leading dim under a ``layers``-suffixed key
+    (``layers``, ``dense_layers``, a tower's ``vision.layers``, ...)."""
+    for k in path:
+        name = str(getattr(k, "key", getattr(k, "idx", k)))
+        if name.endswith("layers"):
+            return True
+    return False
+
+
+def build_groups(paths: Sequence[Any],
+                 max_groups: int = 64) -> List[Tuple[str, List[int]]]:
+    """Deterministic (name, member-leaf-indices) groups for a flattened
+    param tree, cardinality-capped.
+
+    Starts at full leaf-path granularity; while over the cap, coarsens by
+    dropping the trailing path component (``layers.q_proj`` stays a group,
+    a 200-leaf MoE tree collapses toward its subtree roots); if depth-1
+    granularity still exceeds the cap, the sorted tail merges into
+    :data:`REST_GROUP`. Pure string work — safe at trace time."""
+    max_groups = max(1, max_groups)
+    names = [_path_str(p) for p in paths]
+    depth = max((n.count(".") + 1 for n in names), default=1)
+    while depth > 1 and len(set(names)) > max_groups:
+        depth -= 1
+        names = [".".join(n.split(".")[:depth]) for n in names]
+    distinct = sorted(set(names))
+    if len(distinct) > max_groups:
+        # keep is empty at max_groups=1: everything lands in ...rest, so
+        # the cap holds EXACTLY (keep + the rest bucket <= max_groups)
+        keep = set(distinct[: max_groups - 1])
+        names = [n if n in keep else REST_GROUP for n in names]
+    groups: Dict[str, List[int]] = {}
+    for i, n in enumerate(names):
+        groups.setdefault(n, []).append(i)
+    return sorted(groups.items())
+
+
+# ---------------------------------------------------------- device-side stats
+def _leaf_stats(x, stacked: bool):
+    """(sumsq, count, absmax, nonfinite) for one leaf, reduced over every
+    axis but the leading layer dim when ``stacked`` (per-layer vectors)."""
+    x = x.astype(jnp.float32)
+    axes = tuple(range(1, x.ndim)) if stacked and x.ndim >= 1 else None
+    n = 1.0
+    shape = x.shape[1:] if axes is not None else x.shape
+    for d in shape:
+        n *= d
+    finite = jnp.isfinite(x)
+    safe = jnp.where(finite, x, 0.0)
+    return (
+        jnp.sum(safe * safe, axis=axes),
+        jnp.full((x.shape[0],) if axes is not None else (), n, jnp.float32),
+        jnp.max(jnp.abs(safe), axis=axes, initial=0.0),
+        jnp.sum((~finite).astype(jnp.float32), axis=axes),
+    )
+
+
+def _dtype_max(dtypes):
+    """Smallest finite max across the group's float member dtypes (the
+    first dtype to overflow is the margin that matters); f32's if none.
+    Pure python scalars — no host cast on a traced value."""
+    import ml_dtypes  # bf16/fp8 finfo (numpy's rejects them)
+    import numpy as np
+
+    best = None
+    for dt in dtypes:
+        try:
+            m = float(ml_dtypes.finfo(dt).max)
+        except ValueError:
+            try:
+                m = float(np.finfo(dt).max)
+            except ValueError:  # int leaf (frozen lookup tables)
+                continue
+        if best is None or m < best:
+            best = m
+    return best if best is not None else 3.4028235e38  # f32 max
+
+
+def tree_health(params, grads, updates, *, max_groups: int = 64,
+                eps: float = 1e-12) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """Per-group training-health summary, computed on device inside the
+    instrumented train step.
+
+    Returns ``{group: {stat: array}}`` where stacked groups carry per-layer
+    vectors and flat groups scalars:
+
+    * ``grad_rms`` / ``grad_absmax`` / ``grad_nonfinite`` — over the
+      token-normalized, mask-applied, PRE-clip gradients (the clip would
+      hide exactly the blow-up magnitude this tier exists to see);
+    * ``param_rms`` / ``param_nonfinite``;
+    * ``update_ratio`` (update RMS over param RMS — the classic
+      learning-health dial) and ``update_nonfinite``;
+    * ``overflow_margin_bits`` — ``log2(dtype_max / grad_absmax)``: how many
+      magnitude doublings remain before the group's narrowest float dtype
+      overflows (a divergence early-warning that moves *before* the NaN).
+
+    RMS/absmax are computed over the finite elements only (non-finite mass
+    is reported separately in the ``*_nonfinite`` counts — a single inf must
+    not erase the magnitude trend that led to it). Group structure is a
+    pure function of the param-tree paths, so the summary traces once per
+    program and never retraces steady-state.
+    """
+    flat = tree_leaves_with_path(params)
+    paths = [p for p, _ in flat]
+    p_leaves = [x for _, x in flat]
+    g_leaves = tree_leaves(grads)
+    u_leaves = tree_leaves(updates)
+    out: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for name, members in build_groups(paths, max_groups):
+        # a group mixing stacked and flat members (or mixed layer counts —
+        # the ...rest bucket can) degrades to fully-reduced scalars
+        stacked = all(_is_stacked(paths[i]) for i in members)
+        if stacked:
+            lens = {p_leaves[i].shape[0] if p_leaves[i].ndim else 1
+                    for i in members}
+            stacked = len(lens) == 1
+        acc = {}
+        for kind, leaves in (("grad", g_leaves), ("param", p_leaves),
+                             ("update", u_leaves)):
+            sumsq = cnt = absmax = nonfin = None
+            for i in members:
+                s, c, a, nf = _leaf_stats(leaves[i], stacked)
+                sumsq = s if sumsq is None else sumsq + s
+                cnt = c if cnt is None else cnt + c
+                absmax = a if absmax is None else jnp.maximum(absmax, a)
+                nonfin = nf if nonfin is None else nonfin + nf
+            acc[kind] = (sumsq, cnt, absmax, nonfin)
+        g_sumsq, g_cnt, g_absmax, g_nonfin = acc["grad"]
+        p_sumsq, p_cnt, _p_absmax, p_nonfin = acc["param"]
+        u_sumsq, u_cnt, _u_absmax, u_nonfin = acc["update"]
+        grad_rms = jnp.sqrt(g_sumsq / jnp.maximum(g_cnt, 1.0))
+        param_rms = jnp.sqrt(p_sumsq / jnp.maximum(p_cnt, 1.0))
+        update_rms = jnp.sqrt(u_sumsq / jnp.maximum(u_cnt, 1.0))
+        dmax = _dtype_max([p_leaves[i].dtype for i in members])
+        out[name] = {
+            "grad_rms": grad_rms,
+            "grad_absmax": g_absmax,
+            "grad_nonfinite": g_nonfin,
+            "param_rms": param_rms,
+            "param_nonfinite": p_nonfin,
+            "update_ratio": update_rms / (param_rms + eps),
+            "update_nonfinite": u_nonfin,
+            "overflow_margin_bits": (
+                math.log2(dmax) - jnp.log2(jnp.maximum(g_absmax, eps))
+            ),
+        }
+    return out
+
+
+# ------------------------------------------------------------------ host side
+#: per-stat worst-layer reduction the gauges publish for stacked groups
+_GAUGE_REDUCE = {
+    "grad_rms": max, "grad_absmax": max, "grad_nonfinite": max,
+    "param_rms": max, "param_nonfinite": max, "update_ratio": max,
+    "update_nonfinite": max,
+    # margin: the layer CLOSEST to overflow is the one that matters
+    "overflow_margin_bits": min,
+}
+
+
+class NumericsMonitor:
+    """Host-side consumer of :func:`tree_health` outputs.
+
+    ``observe`` (the interval cadence) fetches, rings, and publishes
+    worst-layer ``numerics.*`` gauges; ``diagnose`` (the supervisor's
+    anomaly re-run) additionally builds the non-finite provenance document.
+    Thread-safe: the exporter's ``/debug/numerics`` scrapes from its own
+    thread."""
+
+    def __init__(self, history: int = 32, registry=None):
+        self._lock = threading.Lock()
+        self._history: deque = deque(maxlen=max(1, history))  # guarded-by: _lock
+        self._registry = registry
+        self.last_provenance: Optional[Dict[str, Any]] = None  # guarded-by: _lock
+        self.observed_steps = 0  # guarded-by: _lock
+
+    def _reg(self):
+        return self._registry or get_registry()
+
+    @staticmethod
+    def _to_doc(health) -> Dict[str, Dict[str, Any]]:
+        """Device health tree -> plain floats/lists. ONE batched
+        ``device_get`` for the whole tree — per-stat fetches would be
+        ~groups x stats blocking round trips on every numerics step."""
+        import numpy as np
+
+        host = jax.device_get(health)
+        doc = {}
+        for group, stats in host.items():
+            doc[group] = {
+                k: (float(v) if np.ndim(v) == 0
+                    else np.asarray(v, dtype=np.float64).tolist())
+                for k, v in stats.items()
+            }
+        return doc
+
+    # ------------------------------------------------------------- observation
+    def observe(self, step: int, health) -> Dict[str, Dict[str, Any]]:
+        """Fetch one interval summary: ring it + publish gauges."""
+        doc = self._to_doc(health)
+        with self._lock:
+            self._history.append({"step": int(step), "groups": doc})
+            self.observed_steps += 1
+        reg = self._reg()
+        for group, stats in doc.items():
+            for stat, val in stats.items():
+                if isinstance(val, list):
+                    val = _GAUGE_REDUCE.get(stat, max)(val) if val else 0.0
+                reg.gauge(f"numerics.{group}.{stat}").set(val)
+        reg.gauge("numerics.last_step").set(float(step))
+        return doc
+
+    @staticmethod
+    def first_nonfinite(doc: Dict[str, Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+        """First offending (kind, group[, layer]) in deterministic order:
+        param-kind first (upstream of everything), then grad, then update;
+        groups in sorted-name order; stacked groups name the first bad
+        layer."""
+        for kind in PROVENANCE_KINDS:
+            for group in sorted(doc):
+                nf = doc[group].get(f"{kind}_nonfinite", 0.0)
+                vals = nf if isinstance(nf, list) else [nf]
+                total = sum(vals)
+                if total > 0:
+                    out = {"group": group, "kind": kind,
+                           "nonfinite_count": float(total)}
+                    if isinstance(nf, list):
+                        out["layer"] = next(
+                            i for i, v in enumerate(vals) if v > 0
+                        )
+                    return out
+        return None
+
+    # --------------------------------------------------------------- diagnosis
+    def diagnose(self, step: int, health,
+                 injected: bool = False) -> Dict[str, Any]:
+        """Build (and retain) the provenance document for an anomalous step
+        the supervisor re-ran through the instrumented step."""
+        doc = self._to_doc(health)
+        first = self.first_nonfinite(doc)
+        with self._lock:
+            history = list(self._history)
+        prov: Dict[str, Any] = {
+            "step": int(step),
+            "injected": bool(injected),
+            "first_nonfinite": first,
+            "groups": doc,
+            "history": history,
+        }
+        with self._lock:
+            self.last_provenance = prov
+        reg = self._reg()
+        reg.counter("numerics.diagnoses").inc()
+        if first is not None:
+            reg.counter("numerics.nonfinite_steps").inc()
+            flight_record(
+                "numerics.nonfinite", cid=str(step),
+                group=first["group"], tensor_kind=first["kind"],
+                layer=first.get("layer"),
+                count=first["nonfinite_count"],
+            )
+            logger.warning_rank0(
+                "NUMERICS: step %d first non-finite tensor is %s group %r%s "
+                "(%d non-finite elements) — provenance retained for the "
+                "post-mortem and /debug/numerics",
+                step, first["kind"], first["group"],
+                f" layer {first['layer']}" if "layer" in first else "",
+                int(first["nonfinite_count"]),
+            )
+        else:
+            flight_record("numerics.clean_diagnosis", cid=str(step),
+                          injected=injected)
+            logger.warning_rank0(
+                "NUMERICS: anomalous step %d re-ran clean — no non-finite "
+                "tensor in grads/params/updates (host-injected drill, or a "
+                "transient the re-run did not reproduce)", step,
+            )
+        return prov
+
+    # ------------------------------------------------------------------ egress
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            history = list(self._history)
+            prov = self.last_provenance
+            observed = self.observed_steps
+        return {
+            "enabled": True,
+            "observed_steps": observed,
+            "latest": history[-1] if history else None,
+            "history": history,
+            "provenance": prov,
+        }
+
+
+_ACTIVE: Optional[NumericsMonitor] = None  # guarded-by: _ACTIVE_LOCK
+_ACTIVE_LOCK = threading.Lock()
+
+
+def set_active_monitor(monitor: Optional[NumericsMonitor]
+                       ) -> Optional[NumericsMonitor]:
+    """Install/uninstall the process's live monitor (the trainer's loop owns
+    one per run); returns the previous one."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        prev, _ACTIVE = _ACTIVE, monitor
+    return prev
+
+
+def get_active_monitor() -> Optional[NumericsMonitor]:
+    with _ACTIVE_LOCK:
+        return _ACTIVE
+
+
+def debug_numerics_doc() -> Dict[str, Any]:
+    """``/debug/numerics`` body: the live monitor's snapshot, or a disabled
+    stub naming the knob that turns the tier on."""
+    mon = get_active_monitor()
+    if mon is None:
+        return {"enabled": False,
+                "hint": "set train.observability_numerics_interval > 0"}
+    return mon.snapshot()
+
+
+def attach_numerics_extra(extra: Dict[str, Any]) -> None:
+    """Fold the provenance/history into a post-mortem ``extra`` payload
+    (trainer ``_postmortem_extra``). No-op when the tier is off; must never
+    raise — forensics can't mask the original failure."""
+    mon = get_active_monitor()
+    if mon is None:
+        return
+    snap = mon.snapshot()
+    if snap.get("provenance") or snap.get("latest"):
+        extra["numerics"] = {
+            "provenance": snap.get("provenance"),
+            "history": snap.get("history"),
+        }
+
+
+# ------------------------------------------------------------- chaos drilling
+def poison_param_group(params, pattern: str = ""):
+    """Overwrite ONE element of the first float param leaf whose dotted path
+    contains ``pattern`` (sorted-path order; empty pattern = first float
+    leaf) with NaN. The ``step.params`` fault drill: unlike the host-side
+    ``step.loss`` observation poison, this plants a REAL non-finite value
+    the provenance machinery must find and name.
+
+    Returns ``(poisoned_params, dotted_path)``; ``(params, "")`` when no
+    leaf matches."""
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    target = None
+    for path, leaf in sorted(flat, key=lambda kv: _path_str(kv[0])):
+        name = _path_str(path)
+        if pattern and pattern not in name:
+            continue
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            target = name
+            break
+    if target is None:
+        return params, ""
+
+    def _poison(path, leaf):
+        if _path_str(path) != target:
+            return leaf
+        return leaf.at[(0,) * leaf.ndim].set(jnp.nan)
+
+    return jax.tree_util.tree_map_with_path(_poison, params), target

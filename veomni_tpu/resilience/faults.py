@@ -26,6 +26,12 @@ Fault points wired through the stack:
 ``data.record`` per streaming record read, BEFORE decode (context: the shard
                 file) — the ``corrupt`` drill point for poisoned data records
 ``step.loss``   host-side observation of the train step's finite-loss flag
+``step.params`` once per trainer-loop iteration, before dispatch — ``nan``
+                mode plants a REAL NaN in one element of the first float
+                param leaf whose dotted path contains the spec's ``group``
+                (the numerics observatory's provenance drill: the following
+                step genuinely blows up on device and the attribution
+                machinery must find and name the poisoned group)
 ``step.delay``  once per trainer-loop iteration, host side, before dispatch —
                 the ``delay`` drill point: a straggler (one rank slower than
                 the fleet) is injected deterministically so the fleet
@@ -67,7 +73,9 @@ Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
   context file itself when the site names one;
 * ``offset``  corrupt/bitflip only: byte offset to flip (default -1 = the
   middle byte — deterministic, and never the final partial page a truncate
-  test would also catch).
+  test would also catch);
+* ``group``   ``step.params``/nan only: dotted-path substring selecting the
+  param leaf to poison (empty = first float leaf in sorted-path order).
 
 Hit counters are per point and shared across specs targeting the same point,
 so "fail hits 2-4" composes with "hang hit 7" on one point deterministically.
@@ -88,7 +96,8 @@ logger = get_logger(__name__)
 ENV_PLAN = "VEOMNI_FAULT_PLAN"
 
 KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "ckpt.reshard",
-                "data.fetch", "data.record", "step.loss", "step.delay")
+                "data.fetch", "data.record", "step.loss", "step.delay",
+                "step.params")
 
 _MODES = ("exception", "nan", "hang", "delay", "corrupt")
 
@@ -127,6 +136,7 @@ class _FaultSpec:
     op: str = "bitflip"
     file: str = ""
     offset: int = -1
+    group: str = ""
 
     def covers(self, hit: int) -> bool:
         return self.hit <= hit < self.hit + self.times
@@ -157,12 +167,16 @@ def _parse_specs(raw: Any) -> List[_FaultSpec]:
         mode = entry.get("mode", "exception")
         if mode not in _MODES:
             raise ValueError(f"unknown fault mode {mode!r}; choose from {_MODES}")
-        if mode == "nan" and point != "step.loss":
-            # only the supervisor's step.loss observation interprets "nan";
-            # anywhere else the returned action is ignored, yet it would log
-            # "fault injected" — a drill that believes it tested something
+        if mode == "nan" and point not in ("step.loss", "step.params"):
+            # only the supervisor's step.loss observation (poisons the
+            # OBSERVED flag) and the trainer's step.params site (plants a
+            # REAL NaN in one param leaf — the numerics-provenance drill)
+            # interpret "nan"; anywhere else the returned action is
+            # ignored, yet it would log "fault injected" — a drill that
+            # believes it tested something
             raise ValueError(
-                f"mode 'nan' only applies to point 'step.loss', not {point!r}"
+                f"mode 'nan' only applies to points 'step.loss'/"
+                f"'step.params', not {point!r}"
             )
         op = entry.get("op", "bitflip")
         if op not in _CORRUPT_OPS:
@@ -187,6 +201,7 @@ def _parse_specs(raw: Any) -> List[_FaultSpec]:
             op=op,
             file=str(entry.get("file", "")),
             offset=int(entry.get("offset", -1)),
+            group=str(entry.get("group", "")),
         ))
     return specs
 
@@ -320,6 +335,10 @@ def fault_point(name: str,
         if spec.point != name or not spec.covers(hit):
             continue
         action = FaultAction(point=name, mode=spec.mode, hit=hit)
+        if spec.mode == "nan" and spec.group:
+            # step.params: the target param-group substring rides on the
+            # action for the trainer's poison site
+            action.target = spec.group
         if spec.mode == "corrupt":
             target = _resolve_corrupt_target(spec, context)
             if target is None:

@@ -101,6 +101,22 @@ class TrainSupervisor:
         self.stalls = 0
         self.anomaly_steps: List[int] = []
         self.last_verdict = "ok"
+        # True when the MOST RECENT observe() call's step carried a host-
+        # injected step.loss poison: the trainer stamps the published
+        # step_ok flag false for that step (so window accumulators and the
+        # train.step_ok gauge agree with the supervisor)
+        self.last_injected = False
+        # Whether the most recently CHECKED anomalous entry was host-
+        # injected. Distinct from last_injected: the dispatch-depth queue
+        # drains an entry steps AFTER it was observed, so when a non-ok
+        # verdict surfaces, the current observe() call's injected flag
+        # describes the wrong step. Set unconditionally on every anomalous
+        # _check (never reset), so by the time the trainer reads it a
+        # non-ok verdict guarantees it was stamped by an anomaly of the
+        # same observe/drain window. The numerics provenance doc keys its
+        # `injected` marker off this one (a drill must never read as
+        # organic rot in a post-mortem).
+        self.last_anomaly_injected = False
 
     # ---------------------------------------------------------- observation
     def observe(self, step: int, metrics: Dict[str, Any]) -> str:
@@ -110,6 +126,7 @@ class TrainSupervisor:
         own unit coverage with a genuinely non-finite loss."""
         act = fault_point("step.loss")
         injected = act is not None and act.mode == "nan"
+        self.last_injected = injected
         self._inflight.append(
             (step, metrics.get("loss"), metrics.get("step_ok"), injected)
         )
@@ -142,6 +159,7 @@ class TrainSupervisor:
             self.consec_start = None
             return "ok"
         self.anomalies += 1
+        self.last_anomaly_injected = injected
         get_registry().counter("resilience.anomalies").inc()
         flight_record("supervisor.anomaly", cid=str(step),
                       injected=injected, consecutive=self.consecutive + 1,

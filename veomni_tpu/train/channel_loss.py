@@ -142,6 +142,21 @@ class ChannelLossCallback(Callback):
         counts = state.metrics.pop("channel_token_counts", None)
         if sums is None:
             return
+        # gate window accumulation on step_ok: a non-finite step's sums are
+        # NaN-adjacent garbage, and one NaN added here poisons the running
+        # channel averages for the rest of the run (the device already
+        # refused the param update — the accounting must refuse too). The
+        # flag is a host scalar on sync steps (and python False when the
+        # supervisor saw a host-injected step.loss drill); between log
+        # steps it is a device future, masked lazily so the loop stays
+        # async — no extra host syncs.
+        ok = state.metrics.get("step_ok")
+        if isinstance(ok, (bool, int, float)):
+            if not ok:
+                return  # drop the anomalous step's contribution
+        elif ok is not None:  # device future: mask lazily
+            sums = jnp.where(ok, sums, jnp.zeros((), sums.dtype))
+            counts = jnp.where(ok, counts, jnp.zeros((), counts.dtype))
         # add without materializing: between log steps these are device
         # futures and fetching them would block the async loop
         self._acc_sums = sums if self._acc_sums is None else self._acc_sums + sums

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from veomni_tpu.observability.numerics import tree_health
 from veomni_tpu.parallel.parallel_plan import ParallelPlan
 from veomni_tpu.parallel.parallel_state import ParallelState
 from veomni_tpu.utils.env import env_bool
@@ -36,8 +37,12 @@ logger = get_logger(__name__)
 # the jitted step body increments at TRACE time only, so a steady-state run
 # holds the count flat and any later bump is a recompile. The observability
 # recompile detector (``observability/goodput.py``) watches these and logs
-# the offending shapes from LAST_TRACE_SHAPES.
-TRACE_COUNTS: Dict[str, int] = {"train_step": 0, "eval_step": 0}
+# the offending shapes from LAST_TRACE_SHAPES. ``numerics_step`` is the
+# instrumented sibling program (numerics observatory): the trace-count gate
+# bounds the tier to exactly ONE extra compiled program per batch shape.
+TRACE_COUNTS: Dict[str, int] = {
+    "train_step": 0, "eval_step": 0, "numerics_step": 0,
+}
 LAST_TRACE_SHAPES: Dict[str, Any] = {}
 
 
@@ -89,6 +94,7 @@ def build_train_step(
     max_grad_norm: float = 1.0,
     grad_mask: Optional[Any] = None,
     skip_nonfinite: bool = False,
+    numerics_spec: Optional[Any] = None,
 ) -> Callable:
     """Returns jitted ``train_step(state, batch) -> (state, metrics)``.
 
@@ -107,7 +113,21 @@ def build_train_step(
     update itself is gated on that flag ON DEVICE: a blown-up step leaves
     params/opt_state untouched (the ``where`` select is exact, so finite
     steps are bitwise-identical to the ungated program).
+
+    ``numerics_spec`` (an ``observability.numerics.NumericsSpec``) builds
+    the INSTRUMENTED SIBLING step of the numerics observatory instead: same
+    update math, but the step additionally returns a third output — the
+    per-param-group training-health tree from ``numerics.tree_health``
+    (grad/param RMS, absmax, non-finite counts, update/weight ratio,
+    overflow-margin bits; scan-stacked subtrees as per-layer vectors). The
+    sibling registers its compiles under its own ``numerics_step`` cost-
+    census site (so occasional numerics steps never pollute the train-step
+    MFU window) and its own ``TRACE_COUNTS`` key (so the trace-count gates
+    can prove the tier costs exactly one extra compiled program). It never
+    donates its inputs: the supervisor's anomaly diagnosis re-runs the same
+    already-fetched batch and DISCARDS the returned state.
     """
+    site = "train_step" if numerics_spec is None else "numerics_step"
 
     def grads_one_micro(params, micro):
         (loss_sum, metrics), grads = jax.value_and_grad(
@@ -130,8 +150,8 @@ def build_train_step(
         return grads, loss_sum, metrics["ntokens"], extras
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
-        TRACE_COUNTS["train_step"] += 1  # trace-time only
-        LAST_TRACE_SHAPES["train_step"] = {
+        TRACE_COUNTS[site] += 1  # trace-time only
+        LAST_TRACE_SHAPES[site] = {
             k: tuple(v.shape) for k, v in batch.items()
         }
         params = state.params
@@ -157,11 +177,21 @@ def build_train_step(
         if grad_mask is not None:
             grads = jax.tree.map(lambda g, m: g * m, grads, grad_mask)
         grad_norm = optax.global_norm(grads)
+        # numerics observatory reads the token-normalized, mask-applied,
+        # PRE-clip gradients: the clip would hide exactly the blow-up
+        # magnitude the health summary exists to see
+        health_grads = grads
         if max_grad_norm:
             scale = jnp.minimum(1.0, max_grad_norm / (grad_norm + 1e-6))
             grads = jax.tree.map(lambda g: g * scale, grads)
         updates, new_opt = optimizer.update(grads, state.opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        health = None
+        if numerics_spec is not None:
+            health = tree_health(
+                params, health_grads, updates,
+                max_groups=numerics_spec.max_groups, eps=numerics_spec.eps,
+            )
         # grad_norm is NaN/Inf whenever ANY grad leaf is (sqrt-of-sum-of-
         # squares propagates), so loss+grad_norm finiteness covers the tree
         step_ok = jnp.isfinite(loss_sum) & jnp.isfinite(grad_norm)
@@ -182,17 +212,28 @@ def build_train_step(
             # averaged over micro-steps
             **extras,
         }
+        if numerics_spec is not None:
+            return new_state, metrics, health
         return new_state, metrics
 
-    donate = (0,) if env_bool("VEOMNI_DONATE_STATE") else ()
+    # the numerics sibling never donates: the supervisor's anomaly diagnosis
+    # calls it and keeps the CALLER's state (the returned one is discarded)
+    donate = (
+        (0,) if env_bool("VEOMNI_DONATE_STATE") and numerics_spec is None
+        else ()
+    )
     if state_shardings is not None:
         # metrics must be explicitly replicated: fully-replicated globals are
         # host-fetchable on every process (multihost float(metrics[...]))
         replicated = NamedSharding(pstate.mesh, P())
+        out_shardings = (
+            (state_shardings, replicated) if numerics_spec is None
+            else (state_shardings, replicated, replicated)
+        )
         jitted = jax.jit(
             step_fn,
             in_shardings=(state_shardings, batch_shardings),
-            out_shardings=(state_shardings, replicated),
+            out_shardings=out_shardings,
             donate_argnums=donate,
         )
     else:
@@ -211,7 +252,7 @@ def build_train_step(
     from veomni_tpu.observability.cost import instrument_jit
 
     return instrument_jit(
-        "train_step", jitted, bucket_fn=lambda args: _batch_bucket(args[1])
+        site, jitted, bucket_fn=lambda args: _batch_bucket(args[1])
     )
 
 

@@ -418,6 +418,11 @@ class BaseTrainer:
             skip_nonfinite=t.resilience_skip_nonfinite,
         )
         self._loss_fn = loss_fn  # forward-only reuse (evaluate)
+        # numerics observatory (observability/numerics.py): the instrumented
+        # sibling step is built lazily on first use — with the interval knob
+        # off it is never constructed, never compiled, never traced
+        self._numerics_step = None
+        self._numerics = None
         self.meter = EnvironMeter(
             flops_counter=FlopsCounter.from_config(model.config),
             world_size=ps.world_size,
@@ -653,7 +658,10 @@ class BaseTrainer:
         """Post-mortem payload for an exception escaping train(). A device
         allocator failure (RESOURCE_EXHAUSTED) additionally captures the
         live-buffer census and the compiled-program cost census — the two
-        tables an OOM forensic needs (observability/devmem.py). Must never
+        tables an OOM forensic needs (observability/devmem.py) — and any
+        run with the numerics observatory armed attaches its non-finite
+        provenance + health history (observability/numerics.py), so a
+        supervisor abort names the first offending param group. Must never
         raise: forensics can't be allowed to mask the original failure."""
         extra: Dict[str, Any] = {"error": str(e)[:2000],
                                  "global_step": global_step}
@@ -663,7 +671,72 @@ class BaseTrainer:
             attach_oom_extra(e, extra)
         except Exception as forensic_err:  # even the import must be safe
             extra["oom_report_error"] = str(forensic_err)
+        try:
+            from veomni_tpu.observability.numerics import attach_numerics_extra
+
+            attach_numerics_extra(extra)
+        except Exception as forensic_err:
+            extra["numerics_report_error"] = str(forensic_err)
         return extra
+
+    # -------------------------------------------------------------- numerics
+    def _get_numerics_step(self):
+        """The INSTRUMENTED sibling train step (numerics observatory), built
+        on first use through the same ``build_train_step`` as the hot step —
+        same loss fn (incl. subclass DPO/RL/distill rebinds), same
+        shardings, same clip/mask/skip config — so the cost census sees it
+        as its own ``numerics_step`` site and the trace-count gates bound
+        the tier to exactly one extra compiled program. Never donates:
+        anomaly diagnosis discards the returned state."""
+        if self._numerics_step is None:
+            from veomni_tpu.observability.numerics import NumericsSpec
+
+            t = self.args.train
+            self._numerics_step = build_train_step(
+                self._loss_fn, self.optimizer, self.parallel_state,
+                state_shardings=self.state_shardings,
+                batch_shardings=self.batch_shardings,
+                max_grad_norm=t.max_grad_norm,
+                grad_mask=self.grad_mask,
+                skip_nonfinite=t.resilience_skip_nonfinite,
+                numerics_spec=NumericsSpec(
+                    max_groups=t.observability_numerics_max_groups
+                ),
+            )
+        return self._numerics_step
+
+    def _diagnose_numerics(self, ctl, batch) -> None:
+        """Supervisor anomaly tie-in: re-run the same already-fetched batch
+        through the instrumented step and turn the health tree into a
+        provenance doc (first non-finite group, grad vs param vs update,
+        recent history ring) BEFORE the verdict escalates. With
+        ``skip_nonfinite`` the anomalous update never landed, so the re-run
+        reproduces the exact blown-up computation; the returned state is
+        discarded (the sibling step does not donate). Best-effort: the
+        in-flight drain can lag detection by a few steps, in which case the
+        most recent batch stands in for the anomalous one. Never raises —
+        diagnosis must not out-fail the anomaly it explains."""
+        if self._numerics is None:
+            return
+        try:
+            with span("numerics.diagnose"):
+                _state, _metrics, health = self._get_numerics_step()(
+                    self.train_state, batch
+                )
+                # last_anomaly_injected, NOT last_injected: the dispatch-
+                # depth queue drains an entry steps after it was observed,
+                # so the anomalous entry behind this verdict is older than
+                # the current observe() call's injection flag
+                doc = self._numerics.diagnose(
+                    ctl.global_step, health,
+                    injected=self._supervisor.last_anomaly_injected,
+                )
+            del _state, _metrics
+            first = doc.get("first_nonfinite")
+            ctl.resilience = {**ctl.resilience,
+                              "numerics_first_nonfinite": first}
+        except Exception as e:
+            logger.warning_rank0("numerics diagnosis failed: %s", e)
 
     def _rollback(self, ctl, sup):
         """Supervisor escalation: restore the latest committed checkpoint
@@ -741,6 +814,21 @@ class BaseTrainer:
         sup = TrainSupervisor(SupervisorPolicy.from_train_args(t))
         # the observability callback wires /healthz to the supervisor state
         self._supervisor = sup
+        # numerics observatory (observability/numerics.py): host-side
+        # monitor for the interval health summaries + anomaly provenance;
+        # registered as the process's active monitor so /debug/numerics and
+        # the post-mortem attach see it. Knob off = tier fully absent.
+        numerics_interval = max(0, t.observability_numerics_interval)
+        if numerics_interval:
+            from veomni_tpu.observability.numerics import (
+                NumericsMonitor,
+                set_active_monitor,
+            )
+
+            self._numerics = NumericsMonitor(
+                history=t.observability_numerics_history
+            )
+            set_active_monitor(self._numerics)
         with use_parallel_state(self.parallel_state):
             try:
                 self._fire("on_train_begin", ctl)
@@ -765,6 +853,12 @@ class BaseTrainer:
                 # resources (exporter thread, profiler trace)
                 self._close_prefetcher()
                 self._close_callbacks()
+                if self._numerics is not None:
+                    from veomni_tpu.observability.numerics import (
+                        set_active_monitor,
+                    )
+
+                    set_active_monitor(None)
                 raise
             # SIGTERM = cluster preemption notice: finish the current step,
             # take one final synchronous checkpoint, return (exit 0) so the
@@ -802,6 +896,39 @@ class BaseTrainer:
                             # straggler warning run under JAX_PLATFORMS=cpu
                             # in tier-1. Unarmed: one None check.
                             fault_point("step.delay")
+                            # numerics drill point: a `nan`-mode fault here
+                            # plants a REAL NaN in one param leaf (unlike
+                            # step.loss, which only poisons the host-side
+                            # observation) so the provenance machinery has a
+                            # genuine non-finite tensor to find and name
+                            # under JAX_PLATFORMS=cpu. Unarmed: None check.
+                            act = fault_point("step.params")
+                            if act is not None and act.mode == "nan":
+                                from veomni_tpu.observability.numerics import (
+                                    poison_param_group,
+                                )
+
+                                poisoned, target = poison_param_group(
+                                    self.train_state.params, act.target
+                                )
+                                if target:
+                                    self.train_state = self.train_state.replace(
+                                        params=poisoned
+                                    )
+                                    logger.warning_rank0(
+                                        "fault step.params poisoned param "
+                                        "leaf %r with NaN", target,
+                                    )
+                                else:
+                                    # mirror the corrupt mode's no-target
+                                    # warning: fault_point already logged
+                                    # "fault injected", and a drill that
+                                    # planted nothing must say so loudly
+                                    logger.warning_rank0(
+                                        "fault step.params poisoned "
+                                        "NOTHING: no float param leaf "
+                                        "matches group %r", act.target,
+                                    )
                             with span("host.callbacks"):
                                 self._fire("on_step_begin", ctl)
                             # each process holds [A, B_local, S]; stitch into
@@ -814,12 +941,43 @@ class BaseTrainer:
                             # the wedged step as dispatched-but-never-ended
                             flight_record("step.dispatch",
                                           cid=str(ctl.global_step + 1))
+                            # numerics cadence: every interval-th step runs
+                            # the instrumented sibling instead of the hot
+                            # step — same update math, one extra compiled
+                            # program, plus the per-group health tree the
+                            # monitor fetches and publishes
+                            health = None
+                            numerics_due = bool(
+                                numerics_interval
+                                and (ctl.global_step + 1) % numerics_interval
+                                == 0
+                            )
                             with span("step.dispatch"):
-                                self.train_state, metrics = self.train_step(
-                                    self.train_state, batch
-                                )
+                                if numerics_due:
+                                    (self.train_state, metrics,
+                                     health) = self._get_numerics_step()(
+                                        self.train_state, batch
+                                    )
+                                else:
+                                    self.train_state, metrics = self.train_step(
+                                        self.train_state, batch
+                                    )
                             ctl.global_step += 1
+                            if health is not None:
+                                with span("numerics.observe"):
+                                    self._numerics.observe(
+                                        ctl.global_step, health
+                                    )
                             verdict = sup.observe(ctl.global_step, metrics)
+                            if sup.last_injected:
+                                # a host-injected step.loss drill marks THIS
+                                # step anomalous without any device-side
+                                # non-finite value; stamp the published flag
+                                # so window accumulators (channel loss) and
+                                # the train.step_ok gauge agree with the
+                                # supervisor's verdict
+                                metrics = dict(metrics)
+                                metrics["step_ok"] = False
                             watchdog.pet()
                             # the step dispatches asynchronously; materializing
                             # a metric would block the host on device completion
@@ -865,6 +1023,14 @@ class BaseTrainer:
                                 self._fire("on_step_end", ctl)
                             flight_record("step.end", cid=str(ctl.global_step),
                                           synced=ctl.synced)
+                            if verdict != "ok":
+                                # anomaly observed: before the verdict
+                                # escalates, re-run the already-fetched
+                                # batch through the instrumented step so the
+                                # skip/rollback/abort is ATTRIBUTABLE (which
+                                # group first went non-finite) — no-op when
+                                # the numerics tier is off
+                                self._diagnose_numerics(ctl, batch)
                             if verdict == "rollback":
                                 data_iter = self._rollback(ctl, sup)
                             elif verdict == "abort":
@@ -935,4 +1101,16 @@ class BaseTrainer:
                 # still need teardown: an active jax.profiler trace or a
                 # live exporter thread must not leak past a crashed run
                 self._close_callbacks()
+                if self._numerics is not None:
+                    from veomni_tpu.observability.numerics import (
+                        get_active_monitor,
+                        set_active_monitor,
+                    )
+
+                    # only un-register our own monitor (a second trainer in
+                    # the process may have installed its own). NOTE: the
+                    # post-mortem dump in the except path above runs BEFORE
+                    # this finally, so the provenance attach still sees it.
+                    if get_active_monitor() is self._numerics:
+                        set_active_monitor(None)
         return ctl
