@@ -149,6 +149,12 @@ def _start_watchdog(timeout_s: float, metric: str = "train_tokens_per_sec_per_ch
     return wd
 
 
+def _pctl(vals, q):
+    """Percentile over a possibly-empty list (0.0 when empty) — shared by
+    the closed-loop and open-loop serve benches."""
+    return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
+
+
 BENCH_PRESETS = {
     # headline metric (largest of the family that fits one v5e with FULL
     # f32 AdamW state)
@@ -601,9 +607,6 @@ def run_serve_bench(
                            "spec_accepted")}
         return eng, ids, outs, dt, delta
 
-    def _pctl(vals, q):
-        return float(np.percentile(np.asarray(vals), q)) if vals else 0.0
-
     engine_cfg = EngineConfig(
         num_slots=num_slots, block_size=block_size, max_model_len=max_len,
         prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
@@ -723,6 +726,274 @@ def run_serve_bench(
     return result
 
 
+def run_serve_open_loop_bench(
+    *,
+    num_slots: int = 4,
+    block_size: int = 16,
+    n_requests: int = 32,
+    prompt_lens=(64, 128, 256),
+    max_new_tokens: int = 32,
+    preset: str = "qwen3_0p6b",
+    remat_policy: str = "dots",
+    arrival_rate_mults=(0.5, 1.0, 2.0),
+    arrival_rates=(),
+    queue_bound: int = 0,
+    deadline_s: float = 0.0,
+    interactive_frac: float = 0.5,
+    classes: str = "interactive:4,batch:1",
+    seed: int = 0,
+    _model=None,
+) -> dict:
+    """Open-loop Poisson overload bench: arrivals fire on a fixed schedule
+    regardless of whether the engine keeps up — the load model a closed
+    feedback loop (``run_serve_bench``) structurally cannot produce, and
+    the only one that exposes overload behavior: queue growth, shedding,
+    deadline misses, p99 blowup.
+
+    A closed-loop calibration run first measures the engine's completion
+    capacity (requests/s with every slot busy); ``arrival_rate_mults``
+    (default sweeps 0.5x/1x/2x, i.e. *past capacity*) scale it into the
+    open-loop arrival rates (``arrival_rates`` in req/s overrides). Each
+    rate drives the SAME request set — an interactive/batch mix
+    (``interactive_frac``; interactive requests carry ``deadline_s`` when
+    set) — against a QoS engine with a bounded queue (``queue_bound``; 0
+    defaults to ``4 * num_slots``), reporting per rate: reject rate,
+    deadline-miss rate, p50/p99 TTFT (overall + interactive-only), p99
+    TPOT, decode tok/s, max observed queue depth, and **goodput** —
+    tokens from requests that finished within their deadline per second
+    of wall time, the number that keeps honest under overload when raw
+    decode tok/s still looks fine.
+
+    ``_model`` injects a prebuilt ``(params, cfg)`` (tier-1 CPU smoke uses
+    a tiny model); by default the ``preset`` model is built fresh."""
+    import jax
+
+    from veomni_tpu.models import build_foundation_model
+    from veomni_tpu.serving import (
+        EngineConfig,
+        InferenceEngine,
+        Request,
+        SamplingParams,
+    )
+
+    if _model is not None:
+        params, cfg = _model
+    else:
+        _beat(phase="init")
+        _wait_for_backend()
+        _beat(phase="backend")
+        cfg = bench_config(remat_policy, preset)
+        model = build_foundation_model(config=cfg)
+        params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+        _beat(phase="params")
+
+    max_len = max(prompt_lens) + max_new_tokens
+    queue_bound = queue_bound or 4 * num_slots
+    rng = np.random.default_rng(seed)
+    # the interactive/batch roles map onto the CONFIGURED class spec: the
+    # first (highest-priority) class plays "interactive" and the last
+    # "batch", so a custom BENCH_SERVE_CLASSES sweep doesn't crash on
+    # labels the engine never configured
+    from veomni_tpu.serving import parse_classes
+
+    class_names = [n for n, _ in parse_classes(classes)]
+    hi_class, lo_class = class_names[0], class_names[-1]
+
+    def make_requests(n):
+        reqs = []
+        for i in range(n):
+            want = prompt_lens[i % len(prompt_lens)]
+            prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, want)]
+            interactive = bool(rng.random() < interactive_frac)
+            reqs.append(Request(
+                prompt_ids=prompt,
+                sampling=SamplingParams(max_new_tokens=max_new_tokens),
+                priority=hi_class if interactive else lo_class,
+                deadline_s=(deadline_s if interactive and deadline_s > 0
+                            else None),
+            ))
+        return reqs
+
+    def clone_requests(protos):
+        """Fresh Request objects over the same prompts/classes/deadlines:
+        every swept rate replays the IDENTICAL workload (cross-rate deltas
+        measure load response, not workload noise), while each engine
+        assigns its own request ids."""
+        return [Request(prompt_ids=list(r.prompt_ids), sampling=r.sampling,
+                        priority=r.priority, deadline_s=r.deadline_s)
+                for r in protos]
+
+    def engine_cfg(**kw):
+        return EngineConfig(num_slots=num_slots, block_size=block_size,
+                            max_model_len=max_len, **kw)
+
+    # ---- closed-loop calibration: completion capacity with full slots
+    # (shared warmup: compiles land here, not inside any timed window)
+    cal = InferenceEngine(params, cfg, engine_cfg(classes=classes))
+    warm = make_requests(len(prompt_lens))
+    for r in warm:
+        cal.run([r])
+    _beat(phase="serve_warmup")
+    proto = make_requests(n_requests)  # ONE workload, replayed per rate
+    # calibration strips deadlines: an expiry "completing" a request early
+    # would inflate the measured service capacity the sweep scales from
+    cal_reqs = [Request(prompt_ids=list(r.prompt_ids), sampling=r.sampling,
+                        priority=r.priority) for r in proto]
+    t0 = time.perf_counter()
+    cal.run(cal_reqs)
+    cal_dt = time.perf_counter() - t0
+    capacity_rps = n_requests / max(cal_dt, 1e-9)
+    _beat(phase="serve_capacity")
+
+    rates = [float(r) for r in arrival_rates] or [
+        m * capacity_rps for m in arrival_rate_mults
+    ]
+    sweep = []
+    for rate in rates:
+        eng = InferenceEngine(params, cfg, engine_cfg(
+            queue_bound=queue_bound, classes=classes,
+        ))
+        for r in warm:  # per-engine jit caches: warm each engine
+            eng.run([Request(prompt_ids=r.prompt_ids, sampling=r.sampling,
+                             priority=r.priority)])
+        reqs = clone_requests(proto)
+        # per-rate seeded arrivals: the Poisson pattern is reproducible for
+        # a given (seed, rate) independent of sweep order
+        arng = np.random.default_rng((seed, int(rate * 1e6)))
+        arrivals = np.cumsum(arng.exponential(1.0 / rate, size=n_requests))
+        m0 = eng.metrics()  # reset the goodput/throughput window
+        ids = []
+        max_queue = 0
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(reqs) or eng.has_work:
+            now = time.perf_counter() - t0
+            while i < len(reqs) and arrivals[i] <= now:
+                ids.append(eng.submit(reqs[i]))  # open loop: never blocks
+                i += 1
+            max_queue = max(max_queue, eng.scheduler.queue_depth)
+            if eng.has_work:
+                eng.step()
+            elif i < len(reqs):
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        dt = time.perf_counter() - t0
+        m1 = eng.metrics(reset_window=False)
+        outs = {rid: eng._outputs[rid] for rid in ids}
+        done = [o for o in outs.values()
+                if o.finish_reason in ("eos", "length")]
+        inter_ids = [rid for rid, r in zip(ids, reqs)
+                     if r.priority == hi_class]
+        ttfts = [o.ttft_s for o in done if o.ttft_s is not None]
+        inter_ttfts = [outs[rid].ttft_s for rid in inter_ids
+                       if outs[rid].ttft_s is not None]
+        tpots = [o.tpot_s for o in done if o.tpot_s is not None]
+        n_rej = sum(1 for o in outs.values()
+                    if o.finish_reason == "rejected")
+        n_miss = sum(1 for o in outs.values() if o.deadline_missed)
+        sweep.append({
+            "arrival_rate_rps": rate,
+            "rate_vs_capacity": rate / max(capacity_rps, 1e-9),
+            "reject_rate": n_rej / max(1, n_requests),
+            "deadline_miss_rate": n_miss / max(1, n_requests),
+            "completed": len(done),
+            "max_queue_depth": max_queue,
+            "ttft_p50_s": _pctl(ttfts, 50),
+            "ttft_p99_s": _pctl(ttfts, 99),
+            "ttft_p99_interactive_s": _pctl(inter_ttfts, 99),
+            "tpot_p99_s": _pctl(tpots, 99),
+            "decode_tok_s": sum(len(o.token_ids) for o in done) / dt,
+            # window deltas are warmup-proof (m0 reset the window); goodput
+            # divides by the open-loop wall, not the window elapsed
+            "goodput_tok_s": (m1["goodput_tokens"] - m0["goodput_tokens"])
+            / dt,
+            "shed_tokens": m1["shed_tokens"] - m0["shed_tokens"],
+        })
+        _beat(global_step=len(sweep), phase="serve_open_loop")
+    return {
+        "capacity_rps": capacity_rps,
+        "num_slots": num_slots,
+        "block_size": block_size,
+        "n_requests": n_requests,
+        "prompt_lens": list(prompt_lens),
+        "max_new_tokens": max_new_tokens,
+        "preset": preset,
+        "queue_bound": queue_bound,
+        "deadline_s": deadline_s,
+        "interactive_frac": interactive_frac,
+        "classes": classes,
+        "sweep": sweep,
+    }
+
+
+def _serve_open_loop_main(preset: str, watchdog=None):
+    """BENCH_SERVE_OPEN_LOOP=1 entry: one JSON line for the overload
+    trajectory (reject rate, p99 TTFT, goodput per arrival rate)."""
+    lens = tuple(
+        int(x) for x in
+        os.environ.get("BENCH_SERVE_PROMPT_LENS", "64,128,256").split(",")
+    )
+    rates = tuple(
+        float(x) for x in
+        os.environ.get("BENCH_SERVE_ARRIVAL_RATES", "").split(",")
+        if x.strip()
+    )
+    mults = tuple(
+        float(x) for x in
+        os.environ.get("BENCH_SERVE_RATE_MULTS", "0.5,1.0,2.0").split(",")
+        if x.strip()
+    )
+    r = run_serve_open_loop_bench(
+        num_slots=int(os.environ.get("BENCH_SERVE_SLOTS", 4)),
+        block_size=int(os.environ.get("BENCH_SERVE_BLOCK", 16)),
+        n_requests=int(os.environ.get("BENCH_SERVE_REQUESTS", 32)),
+        prompt_lens=lens,
+        max_new_tokens=int(os.environ.get("BENCH_SERVE_NEW_TOKENS", 32)),
+        preset=preset,
+        arrival_rates=rates,
+        arrival_rate_mults=mults,
+        queue_bound=int(os.environ.get("BENCH_SERVE_QUEUE_BOUND", 0)),
+        deadline_s=float(os.environ.get("BENCH_SERVE_DEADLINE_S", 0.0)),
+        interactive_frac=float(
+            os.environ.get("BENCH_SERVE_INTERACTIVE_FRAC", 0.5)
+        ),
+        classes=os.environ.get("BENCH_SERVE_CLASSES",
+                               "interactive:4,batch:1"),
+    )
+    if watchdog is not None:
+        watchdog.stop()
+    # headline = the HIGHEST swept rate, independent of the order the
+    # rates/mults were supplied in (sweep entries keep supplied order)
+    worst = max(r["sweep"], key=lambda e: e["arrival_rate_rps"],
+                default={})
+    print(json.dumps({
+        # headline: goodput at the HIGHEST swept rate — the number that
+        # stays honest when raw decode tok/s still looks fine past capacity
+        "metric": "serve_open_loop_goodput_tok_s",
+        "value": round(worst.get("goodput_tok_s", 0.0), 1),
+        "unit": (
+            f"deadline-met tokens/s ({r['preset']} bf16, "
+            f"slots={r['num_slots']}, "
+            f"rate={worst.get('arrival_rate_rps', 0.0):.2f}rps "
+            f"~{worst.get('rate_vs_capacity', 0.0):.1f}x capacity, "
+            f"queue_bound={r['queue_bound']})"
+        ),
+        "vs_baseline": 0.0,  # no published open-loop TPU baseline
+        "capacity_rps": round(r["capacity_rps"], 3),
+        "reject_rate": round(worst.get("reject_rate", 0.0), 4),
+        "deadline_miss_rate": round(worst.get("deadline_miss_rate", 0.0), 4),
+        "ttft_p99_s": round(worst.get("ttft_p99_s", 0.0), 5),
+        "ttft_p99_interactive_s": round(
+            worst.get("ttft_p99_interactive_s", 0.0), 5),
+        "max_queue_depth": worst.get("max_queue_depth", 0),
+        "sweep": [
+            {k: (round(v, 5) if isinstance(v, float) else v)
+             for k, v in entry.items()}
+            for entry in r["sweep"]
+        ],
+    }), flush=True)
+    _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
+
+
 def _serve_main(preset: str, watchdog=None):
     """BENCH_SERVE=1 entry: one JSON line for the serving trajectory."""
     lens = tuple(
@@ -817,9 +1088,11 @@ def main():
 
     apply_performance_flags()
     serve = os.environ.get("BENCH_SERVE", "0") not in ("0", "")
+    open_loop = os.environ.get("BENCH_SERVE_OPEN_LOOP", "0") not in ("0", "")
     watchdog = _start_watchdog(
         float(os.environ.get("BENCH_WATCHDOG_S", 900)),
-        "serve_decode_tokens_per_sec" if serve
+        "serve_open_loop_goodput_tok_s" if open_loop
+        else "serve_decode_tokens_per_sec" if serve
         else "train_tokens_per_sec_per_chip",
     )
     preset = os.environ.get("BENCH_PRESET", "qwen3_0p6b")
@@ -827,6 +1100,8 @@ def main():
         raise SystemExit(
             f"unknown BENCH_PRESET {preset!r}; choose from {sorted(BENCH_PRESETS)}"
         )
+    if open_loop:
+        return _serve_open_loop_main(preset, watchdog)
     if serve:
         return _serve_main(preset, watchdog)
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 4096))

@@ -13,6 +13,16 @@ Run:
   python scripts/serve.py --synthetic 8 --max-new 32
   python scripts/serve.py --prompt-ids 1,2,3 --prompt-ids 4,5 \
       --temperature 0.8 --top-p 0.9
+  python scripts/serve.py --requests-json mixed_traffic.json
+
+``--requests-json`` takes a JSON list of request objects carrying the
+per-request QoS surface: ``{"prompt_ids": [...], "priority":
+"interactive"|"batch", "tenant": "...", "deadline_s": 2.5,
+"max_new_tokens": 32, "temperature": 0.0, ...}`` (every field except
+``prompt_ids`` optional, ``-`` reads stdin). Requests load-shed by the
+bounded queue (``--queue-bound``) or cancelled past their deadline come
+back as distinct terminal statuses in the final JSON — the driver never
+waits on tokens a shed request will not produce.
 
 Env knobs (flags win): VEOMNI_SERVE_SLOTS, VEOMNI_SERVE_BLOCK,
 VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS, VEOMNI_SERVE_PREFIX_CACHE
@@ -21,10 +31,19 @@ VEOMNI_SERVE_MAX_LEN, VEOMNI_SERVE_LOG_STEPS, VEOMNI_SERVE_PREFIX_CACHE
 VEOMNI_SERVE_SPEC_K (draft-then-verify speculation: max drafted tokens per
 slot per tick, 0 = off) with VEOMNI_SERVE_SPEC_DRAFT selecting the drafting
 strategy (`ngram` prompt-lookup default, `off` disables),
+VEOMNI_SERVE_QUEUE_BOUND (max waiting requests before submissions are
+load-shed with a terminal "rejected" status; 0 = unbounded),
+VEOMNI_SERVE_CLASSES (QoS classes "name:weight,..." highest priority
+first; a single class restores plain FIFO), VEOMNI_SERVE_TENANT_INFLIGHT
+(per-tenant waiting+running cap, 0 = uncapped),
 VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD). VEOMNI_METRICS_PORT
-serves Prometheus /metrics + /healthz while the pump runs; /debug/requests
+serves Prometheus /metrics + /healthz while the pump runs (healthz carries
+rejected/deadline-miss counts); /debug/requests
 rows carry each request's cached_tokens, and /debug/fleet the collective
 census of the engine's compiled programs (docs/observability.md).
+VEOMNI_FAULT_PLAN arms the serving fault points (serve.admit /
+serve.prefill / serve.decode_tick, docs/resilience.md) for overload and
+stall drills.
 """
 
 import argparse
@@ -101,6 +120,33 @@ def main():
                                            "ngram"),
                     help="drafting strategy registry impl (`ngram` "
                          "prompt-lookup, `off`)")
+    ap.add_argument("--queue-bound", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_QUEUE_BOUND",
+                                               0)),
+                    help="max waiting requests before submissions are "
+                         "load-shed (terminal 'rejected' status; 0 = "
+                         "unbounded)")
+    ap.add_argument("--classes",
+                    default=os.environ.get("VEOMNI_SERVE_CLASSES",
+                                           "interactive:4,batch:1"),
+                    help="QoS classes 'name:weight,...', highest priority "
+                         "first; a single class restores plain FIFO")
+    ap.add_argument("--tenant-inflight", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_TENANT_INFLIGHT",
+                                               0)),
+                    help="per-tenant waiting+running cap (0 = uncapped)")
+    ap.add_argument("--priority", default="interactive",
+                    help="QoS class for CLI-built requests")
+    ap.add_argument("--tenant", default="",
+                    help="tenant id for CLI-built requests")
+    ap.add_argument("--deadline-s", type=float, default=0.0,
+                    help="end-to-end deadline for CLI-built requests "
+                         "(0 = none)")
+    ap.add_argument("--requests-json", default="",
+                    help="JSON list of request objects (prompt_ids + "
+                         "optional priority/tenant/deadline_s/"
+                         "max_new_tokens/temperature/top_k/top_p/eos_id/"
+                         "seed); '-' reads stdin")
     args = ap.parse_args()
 
     import numpy as np
@@ -112,6 +158,12 @@ def main():
         SamplingParams,
     )
 
+    # VEOMNI_FAULT_PLAN: serving drills (serve.admit / serve.prefill /
+    # serve.decode_tick) arm exactly like the trainer's
+    from veomni_tpu.resilience.faults import arm_from_env
+
+    arm_from_env()
+
     params, cfg = _build_model(args)
     engine = InferenceEngine(params, cfg, EngineConfig(
         num_slots=args.slots, block_size=args.block_size,
@@ -119,6 +171,8 @@ def main():
         prefix_cache=bool(args.prefix_cache),
         prefill_chunk=args.prefill_chunk,
         spec_k=args.spec_k, spec_draft=args.spec_draft,
+        classes=args.classes, queue_bound=args.queue_bound,
+        tenant_max_inflight=args.tenant_inflight,
     ))
     # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz + /debug/flight +
     # /debug/requests (per-request timelines) for the pump loop (the engine
@@ -143,6 +197,11 @@ def main():
         "healthy": True,
         "queue_depth": get_registry().gauge("serve.queue_depth").value,
         "num_running": get_registry().gauge("serve.num_running").value,
+        # overload outcomes (thread-safe registry counters, same rule):
+        # a probe sees shedding/deadline pressure without log scraping
+        "rejected": get_registry().counter("serve.rejected").value,
+        "deadline_misses":
+            get_registry().counter("serve.deadline_misses").value,
     }, requests_fn=engine.tracer.snapshot,
         # /debug/memory gains the KV pool capacity document (pool bytes +
         # estimated max-concurrent sequences) next to the buffer census
@@ -152,16 +211,52 @@ def main():
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         max_new_tokens=args.max_new, eos_id=args.eos_id, seed=args.seed,
     )
+    cli_deadline = args.deadline_s if args.deadline_s > 0 else None
     prompts = [[int(t) for t in s.split(",")] for s in args.prompt_ids]
     rng = np.random.default_rng(args.seed)
     prompts += [
         [int(t) for t in rng.integers(1, cfg.vocab_size, args.synthetic_len)]
         for _ in range(args.synthetic)
     ]
-    if not prompts:
-        ap.error("nothing to do: pass --prompt-ids and/or --synthetic N")
-
-    reqs = [Request(prompt_ids=p, sampling=sampling) for p in prompts]
+    reqs = [Request(prompt_ids=p, sampling=sampling, priority=args.priority,
+                    tenant=args.tenant, deadline_s=cli_deadline)
+            for p in prompts]
+    if args.requests_json:
+        if args.requests_json == "-":
+            docs = json.load(sys.stdin)
+        else:
+            with open(args.requests_json) as f:
+                docs = json.load(f)
+        for d in docs:
+            # same convention as --deadline-s: absent falls back to the
+            # CLI default, <= 0 means "no deadline" (an explicit 0 in the
+            # JSON opts OUT of the CLI default rather than setting an
+            # instantly-expired deadline)
+            if d.get("deadline_s") is None:
+                dl = cli_deadline
+            else:
+                dl = float(d["deadline_s"])
+                dl = dl if dl > 0 else None
+            reqs.append(Request(
+                prompt_ids=[int(t) for t in d["prompt_ids"]],
+                sampling=SamplingParams(
+                    temperature=float(d.get("temperature",
+                                            args.temperature)),
+                    top_k=int(d.get("top_k", args.top_k)),
+                    top_p=float(d.get("top_p", args.top_p)),
+                    max_new_tokens=int(d.get("max_new_tokens",
+                                             args.max_new)),
+                    eos_id=int(d.get("eos_id", args.eos_id)),
+                    seed=int(d.get("seed", args.seed)),
+                ),
+                request_id=str(d.get("request_id", "")),
+                priority=str(d.get("priority", args.priority)),
+                tenant=str(d.get("tenant", args.tenant)),
+                deadline_s=dl,
+            ))
+    if not reqs:
+        ap.error("nothing to do: pass --prompt-ids, --synthetic N "
+                 "and/or --requests-json")
     try:
         for ev in engine.generate(reqs):
             line = {"request_id": ev.request_id, "index": ev.index,
@@ -189,15 +284,32 @@ def main():
     print(json.dumps({"metrics": engine.metrics()}), flush=True)
     if exporter is not None:
         exporter.stop()
+    # terminal-status census first: shed/expired requests are reported
+    # DISTINCTLY (they produced no final token event to learn it from)
+    by_status = {"ok": 0, "rejected": 0, "deadline": 0, "cancelled": 0}
+    for o in outs.values():
+        key = o.finish_reason if o.finish_reason in by_status else "ok"
+        by_status[key] += 1
+    print(json.dumps({
+        "completed": by_status["ok"],
+        "rejected": by_status["rejected"],
+        "deadline_cancelled": by_status["deadline"],
+        "cancelled": by_status["cancelled"],
+        "deadline_missed": sum(1 for o in outs.values()
+                               if o.deadline_missed),
+    }), flush=True)
     for rid in sorted(outs):
         o = outs[rid]
-        print(json.dumps({
+        line = {
             "request_id": rid, "tokens": o.token_ids,
             "finish_reason": o.finish_reason,
             "ttft_s": round(o.ttft_s, 4) if o.ttft_s is not None else None,
             "cached_tokens": o.cached_tokens,
             "spec_accepted_tokens": o.spec_accepted_tokens,
-        }), flush=True)
+        }
+        if o.deadline_missed:
+            line["deadline_missed"] = True
+        print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":

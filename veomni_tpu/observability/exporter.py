@@ -54,7 +54,9 @@ def _prom_name(name: str) -> str:
 #: (`<name>_hist_bucket{le=...}`): the serving latency SLOs need
 #: `histogram_quantile(0.99, rate(..._hist_bucket[5m]))` to work in
 #: PromQL — the summary's fixed p50/p95 quantiles can't answer a p99
-#: query. Rendered under a `_hist` sibling name because one metric name
+#: query. Covers TTFT, TPOT and queue wait (the third serving-SLO family:
+#: queue wait is the signal the QoS layer's shedding/deadline decisions
+#: act on). Rendered under a `_hist` sibling name because one metric name
 #: cannot be both TYPE summary and TYPE histogram. The bounds table lives
 #: in metrics.py (SLO_BUCKET_BOUNDS) so the registry attaches EXACT
 #: per-bucket counters at observe() time — rate() over these series needs

@@ -53,6 +53,10 @@ LATENCY_BUCKETS = (
 SLO_BUCKET_BOUNDS: Dict[str, tuple] = {
     "serve.ttft_s": LATENCY_BUCKETS,
     "serve.tpot_s": LATENCY_BUCKETS,
+    # queue wait is the third serving-SLO family: under the PR 15 QoS
+    # layer it is the signal shedding/deadline decisions act on, so p99
+    # queries over it must work in PromQL like the other two
+    "serve.queue_wait_s": LATENCY_BUCKETS,
 }
 
 

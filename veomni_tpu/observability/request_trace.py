@@ -161,6 +161,19 @@ class RequestTracer:
             self._inflight[request_id] = tl
         _flight_record("serve.queued", cid=request_id)
 
+    def on_rejected(self, request_id: str) -> None:
+        """A request load-shed at submit (bounded queue / tenant cap): it
+        was never queued, so its timeline is a single terminal ``rejected``
+        mark straight into the finished ring — ``/debug/requests`` shows
+        WHO was turned away during an overload window, with timestamps."""
+        tl = RequestTimeline(request_id=request_id)
+        t = tl.mark("rejected")
+        tl.finished_t = t
+        tl.finish_reason = "rejected"
+        with self._lock:
+            self._finished.append(tl)
+        _flight_record("serve.rejected", cid=request_id)
+
     def on_admitted(self, request_id: str, slot: int) -> None:
         with self._lock:
             tl = self._inflight.get(request_id)

@@ -37,6 +37,20 @@ Fault points wired through the stack:
                 the fleet) is injected deterministically so the fleet
                 observatory's skew detection runs under JAX_PLATFORMS=cpu in
                 tier-1 like every other recovery path
+``serve.admit`` per ``InferenceEngine.submit()`` call, before intake
+                validation — drills the serving front door (an
+                ``exception`` here is a failed admission the client sees;
+                ``delay`` models a slow intake path)
+``serve.prefill`` per prefill tick (one sequence advancing one chunk), host
+                side, before the jitted chunk dispatch — drills slow/failed
+                prefill under load (TTFT degradation, mid-prefill
+                cancellation windows)
+``serve.decode_tick`` per batched decode tick, host side, before the jitted
+                step dispatch — the serving straggler/stall drill: ``delay``
+                makes every running request's TPOT degrade together,
+                ``hang`` drives the watchdog/flight-recorder post-mortem
+                path deterministically on CPU (mirrors what ``step.loss``
+                hangs do for the trainer)
 ==============  ==============================================================
 
 Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
@@ -97,7 +111,8 @@ ENV_PLAN = "VEOMNI_FAULT_PLAN"
 
 KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "ckpt.reshard",
                 "data.fetch", "data.record", "step.loss", "step.delay",
-                "step.params")
+                "step.params", "serve.admit", "serve.prefill",
+                "serve.decode_tick")
 
 _MODES = ("exception", "nan", "hang", "delay", "corrupt")
 

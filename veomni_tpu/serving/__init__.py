@@ -1,13 +1,16 @@
 """Continuous-batching inference engine with a paged KV cache.
 
-Layering: ``api`` (request/response dataclasses) -> ``kv_block_manager``
+Layering: ``api`` (request/response dataclasses, incl. the per-request QoS
+surface: priority class / tenant / deadline) -> ``kv_block_manager``
 (host block accounting: shared refcounted blocks) -> ``prefix_cache``
 (radix tree sharing prompt KV blocks across requests) -> ``scheduler``
-(admission/preemption policy, cache-aware) -> ``spec_decode`` (host-side
-draft strategies for speculative decoding, registry-dispatched) ->
-``engine`` (jitted chunked prefill over cached prefixes + batched paged
-decode, one-token or draft-then-verify). See ``docs/serving.md`` for the
-architecture and the compile-count story.
+(QoS admission: class weights + tenant fairness + bounded intake /
+load-shedding, class-aware preemption, cache-aware) -> ``spec_decode``
+(host-side draft strategies for speculative decoding,
+registry-dispatched) -> ``engine`` (jitted chunked prefill over cached
+prefixes + batched paged decode, one-token or draft-then-verify;
+deadline expiry + goodput accounting). See ``docs/serving.md`` for the
+architecture, the QoS/overload semantics, and the compile-count story.
 """
 
 from veomni_tpu.serving import spec_decode  # registers the spec_draft op
@@ -20,10 +23,17 @@ from veomni_tpu.serving.api import (
 from veomni_tpu.serving.engine import EngineConfig, InferenceEngine
 from veomni_tpu.serving.kv_block_manager import KVBlockManager
 from veomni_tpu.serving.prefix_cache import PrefixCache
-from veomni_tpu.serving.scheduler import Scheduler, SequenceState
+from veomni_tpu.serving.scheduler import (
+    DEFAULT_CLASSES,
+    Scheduler,
+    SequenceState,
+    parse_classes,
+)
 
 __all__ = [
+    "DEFAULT_CLASSES",
     "EngineConfig",
+    "parse_classes",
     "InferenceEngine",
     "KVBlockManager",
     "PrefixCache",

@@ -31,6 +31,22 @@ class Request:
     prompt_ids: List[int]
     sampling: SamplingParams = field(default_factory=SamplingParams)
     request_id: str = ""  # engine assigns "req-N" when empty
+    # ---- QoS / fairness surface (docs/serving.md "QoS, fairness & overload")
+    # SLO class name; must name one of the engine's configured classes
+    # (EngineConfig.classes). With a single-class engine ANY label is
+    # accepted into that one queue — the seed-FIFO configuration.
+    priority: str = "interactive"
+    # fairness key: admission round-robins across tenants inside each class
+    # and per-tenant in-flight caps count against it. "" = the default
+    # tenant (single-tenant deployments never need to set it).
+    tenant: str = ""
+    # optional end-to-end deadline in seconds from submit. A request still
+    # WAITING (or still prefilling) past its deadline is cancelled — blocks
+    # released, terminal finish_reason "deadline" — instead of burning pool
+    # capacity on an answer nobody is waiting for. A request that finishes
+    # late keeps its tokens but is marked deadline_missed (and excluded
+    # from goodput_tokens_per_sec). None = no deadline.
+    deadline_s: Optional[float] = None
 
 
 @dataclass
@@ -43,7 +59,9 @@ class StreamEvent:
     token: int
     index: int
     finished: bool = False
-    finish_reason: str = ""  # "eos" | "length" when finished
+    finish_reason: str = ""  # "eos" | "length" when finished (cancellation
+    # and rejection produce no token, hence no StreamEvent — read the
+    # terminal status off RequestOutput.finish_reason)
 
 
 @dataclass
@@ -52,7 +70,15 @@ class RequestOutput:
     prompt_ids: List[int]
     token_ids: List[int] = field(default_factory=list)
     finished: bool = False
+    # "eos" | "length" for normal completion; terminal QoS statuses:
+    # "rejected"  — load-shed at submit (bounded queue / tenant cap; the
+    #               429-equivalent: no tokens were ever produced),
+    # "deadline"  — cancelled while waiting/prefilling past deadline_s,
+    # "cancelled" — explicit InferenceEngine.cancel()
     finish_reason: str = ""
+    # finished after its deadline_s elapsed (tokens kept, but the request
+    # does not count toward serve.goodput_tokens_per_sec)
+    deadline_missed: bool = False
     ttft_s: Optional[float] = None  # wall time submit -> first token
     # per-request lifecycle rollup (observability/request_trace.py): total
     # time spent waiting for a decode slot (initial + every post-preemption

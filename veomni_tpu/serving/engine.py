@@ -2,10 +2,18 @@
 
 Execution model (one ``step()`` tick):
 
-1. **Admit**: free slots are filled FIFO from the waiting queue; admission
-   matches each prompt against the prefix cache (when enabled) and
-   allocates only the uncached suffix's blocks — shared prompt blocks are
-   referenced, not recomputed.
+0. **Expire**: waiting (and still-prefilling) requests past their
+   ``deadline_s`` are cancelled — blocks released, terminal ``deadline``
+   status — before they can burn pool capacity nobody is waiting for.
+1. **Admit**: free slots are filled from the waiting queue by the QoS pick
+   (per-class stride weights, per-tenant round robin — plain FIFO with a
+   single configured class); admission matches each prompt against the
+   prefix cache (when enabled) and allocates only the uncached suffix's
+   blocks — shared prompt blocks are referenced, not recomputed. Intake is
+   bounded: past ``queue_bound`` waiting requests (or a tenant's
+   ``tenant_max_inflight``), ``submit()`` load-sheds — the request comes
+   back as a terminal ``rejected`` output (429-equivalent) instead of
+   growing the queue without bound.
 2. **Prefill (chunked)**: every admitted-but-unfinished prefill advances by
    ONE chunk per tick, so a long arriving prompt never blocks the running
    requests' next token for more than a chunk's worth of work. The chunk
@@ -63,6 +71,7 @@ from veomni_tpu.models.decode import supports_cached_decode
 from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.observability.request_trace import RequestTracer
 from veomni_tpu.observability.spans import span
+from veomni_tpu.resilience.faults import fault_point
 from veomni_tpu.serving.api import (
     Request,
     RequestOutput,
@@ -71,7 +80,11 @@ from veomni_tpu.serving.api import (
 )
 from veomni_tpu.serving.kv_block_manager import KVBlockManager
 from veomni_tpu.serving.prefix_cache import PrefixCache
-from veomni_tpu.serving.scheduler import Scheduler, SequenceState
+from veomni_tpu.serving.scheduler import (
+    Scheduler,
+    SequenceState,
+    parse_classes,
+)
 from veomni_tpu.utils.helper import host_floats
 from veomni_tpu.utils.logging import get_logger
 
@@ -102,6 +115,18 @@ class EngineConfig:
     # byte-identical; the `off` strategy disables drafting even with k > 0.
     spec_k: int = 0
     spec_draft: str = "ngram"  # registry impl name (serving/spec_decode.py)
+    # QoS classes, "name:weight,..." highest priority first (parsed by
+    # scheduler.parse_classes). Two defaults ship: interactive (weight 4)
+    # and batch (1). A SINGLE-class spec (e.g. "default") restores the
+    # seed FIFO scheduler exactly and admits any priority label; with the
+    # default two-class spec, an all-interactive stream (every Request's
+    # default) is likewise behavior-identical to the seed.
+    classes: str = "interactive:4,batch:1"
+    # admission control / load-shedding: max waiting requests before
+    # submit() sheds (terminal "rejected" status). 0 = unbounded (seed).
+    queue_bound: int = 0
+    # per-tenant cap on waiting+running requests. 0 = uncapped (seed).
+    tenant_max_inflight: int = 0
     # serving-side recompile detection: after this many step() ticks the
     # decode/prefill TRACE_COUNTS baselines are armed, and any later bucket
     # growth emits the trainer's loud rank-0 RECOMPILE warning + the
@@ -118,6 +143,14 @@ class EngineConfig:
             raise ValueError("prefill_chunk must be >= 0 (0 disables)")
         if self.spec_k < 0:
             raise ValueError("spec_k must be >= 0 (0 disables)")
+        if self.queue_bound < 0:
+            raise ValueError("queue_bound must be >= 0 (0 = unbounded)")
+        if self.tenant_max_inflight < 0:
+            raise ValueError(
+                "tenant_max_inflight must be >= 0 (0 = uncapped)"
+            )
+        # malformed class specs fail at construction, not mid-serve
+        parse_classes(self.classes)
         if self.num_blocks <= 0:
             per_seq = -(-self.max_model_len // self.block_size)
             self.num_blocks = 1 + self.num_slots * per_seq
@@ -189,7 +222,10 @@ class InferenceEngine:
         self.scheduler = Scheduler(ec.num_slots, self.blocks,
                                    tracer=self.tracer,
                                    prefix_cache=self.prefix_cache,
-                                   spec_headroom_blocks=spec_headroom)
+                                   spec_headroom_blocks=spec_headroom,
+                                   classes=parse_classes(ec.classes),
+                                   queue_bound=ec.queue_bound,
+                                   tenant_max_inflight=ec.tenant_max_inflight)
 
         # prefill is the SAME jitted program greedy_generate uses (shared
         # prompt buckets, shared TRACE_COUNTS["prefill"])
@@ -231,6 +267,15 @@ class InferenceEngine:
         self._spec_accepted_total = 0
         self._win_spec_proposed = 0
         self._win_spec_accepted = 0
+        # QoS / overload accounting: load-shed + deadline outcomes
+        # (lifetime totals) and the goodput window — tokens from requests
+        # that finished WITHIN their deadline (deadline-free requests
+        # always qualify), attributed to the window their finish lands in
+        self._rejected_total = 0
+        self._shed_tokens_total = 0
+        self._deadline_miss_total = 0
+        self._goodput_tokens_total = 0
+        self._win_goodput_tokens = 0
         # observability registry: same surface the trainer exports through,
         # so one /metrics endpoint covers both (docs/observability.md)
         reg = get_registry()
@@ -251,6 +296,14 @@ class InferenceEngine:
         self._m_spec_proposed = reg.counter("serve.spec_proposed")
         self._m_spec_accepted = reg.counter("serve.spec_accepted")
         self._m_spec_rate = reg.gauge("serve.spec_acceptance_rate")
+        # overload / QoS outcomes: requests load-shed at submit (the
+        # 429-equivalent), the offered tokens those sheds turned away,
+        # deadline outcomes (cancelled waiting/prefilling + finished-late),
+        # and goodput — tokens from requests that met their deadline
+        self._m_rejected = reg.counter("serve.rejected")
+        self._m_shed_tokens = reg.counter("serve.shed_tokens")
+        self._m_deadline_misses = reg.counter("serve.deadline_misses")
+        self._m_goodput = reg.gauge("serve.goodput_tokens_per_sec")
         # HBM capacity accounting (observability/devmem.py): pool bytes are
         # static per engine; the concurrent-sequence estimates answer "how
         # many max-length users fit" (total, and with the blocks free now)
@@ -359,7 +412,16 @@ class InferenceEngine:
     def submit(self, request: Union[Request, Iterable[int]],
                sampling: Optional[SamplingParams] = None) -> str:
         """Enqueue a request (a ``Request`` or a bare prompt-id iterable).
-        Returns the request id; tokens arrive via ``step()`` events."""
+        Returns the request id; tokens arrive via ``step()`` events.
+
+        Under overload (waiting queue at ``queue_bound`` or the tenant at
+        ``tenant_max_inflight``) the request is **load-shed**: the returned
+        id's ``RequestOutput`` is already terminal with
+        ``finish_reason="rejected"`` (the 429-equivalent; no exception — an
+        overloaded server refusing work is an outcome, not an error).
+        Malformed requests (empty prompt, over-length, unknown priority
+        class) still raise ``ValueError``."""
+        fault_point("serve.admit")
         if not isinstance(request, Request):
             request = Request(prompt_ids=[int(t) for t in request],
                               sampling=sampling or SamplingParams())
@@ -387,17 +449,34 @@ class InferenceEngine:
                 f"request needs {self.blocks.blocks_for(total)} blocks; pool "
                 f"has {self.config.num_blocks - 1}"
             )
+        if request.deadline_s is not None and request.deadline_s < 0:
+            raise ValueError("deadline_s must be >= 0 (None disables)")
         seq = SequenceState(
             request=request,
             rng=np.asarray(jax.random.PRNGKey(sp.seed)),
         )
-        self.scheduler.add(seq)
-        self._m_requests.inc()
-        self._m_queue.set(self.scheduler.queue_depth)
-        self._outputs[request.request_id] = RequestOutput(
+        out = RequestOutput(
             request_id=request.request_id,
             prompt_ids=list(request.prompt_ids),
         )
+        # may raise ValueError (unknown priority class) BEFORE the output
+        # registers — malformed is an error, overloaded is an outcome
+        accepted = self.scheduler.add(seq)
+        self._outputs[request.request_id] = out
+        self._m_requests.inc()
+        if not accepted:
+            # load-shed: terminal REJECTED, counted with the offered work
+            # (prompt + requested generation) it turned away
+            out.finished = True
+            out.finish_reason = "rejected"
+            shed = len(request.prompt_ids) + sp.max_new_tokens
+            self._rejected_total += 1
+            self._shed_tokens_total += shed
+            self._m_rejected.inc()
+            self._m_shed_tokens.inc(shed)
+            self.tracer.on_rejected(request.request_id)
+            return request.request_id
+        self._m_queue.set(self.scheduler.queue_depth)
         return request.request_id
 
     # ------------------------------------------------------------------ drive
@@ -406,10 +485,12 @@ class InferenceEngine:
         return self.scheduler.has_work
 
     def step(self) -> List[StreamEvent]:
-        """One engine tick: admit, advance every in-flight prefill by one
-        chunk, secure blocks, batched decode. Returns every token event
-        produced this tick."""
+        """One engine tick: expire deadlines, admit, advance every in-flight
+        prefill by one chunk, secure blocks, batched decode. Returns every
+        token event produced this tick (cancellations produce none — their
+        terminal status lands on the RequestOutput)."""
         events: List[StreamEvent] = []
+        self._expire_deadlines()
         for seq in self.scheduler.admit():
             self._start_prefill(seq)
         # one chunk per prefilling sequence per tick: decode of running
@@ -487,6 +568,70 @@ class InferenceEngine:
             raise ValueError(f"request {request_id!r} is still in flight")
         return self._outputs.pop(request_id, None)
 
+    # ----------------------------------------------------- QoS / cancellation
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Cancel an in-flight (waiting, prefilling, or decoding) request:
+        its blocks — including partially-claimed chunked-prefill blocks and
+        a pinned copy-on-write source — return to the pool, and its output
+        turns terminal with ``finish_reason=reason``. Tokens already
+        emitted stay on the output. Returns False when the id is unknown
+        or already finished."""
+        out = self._outputs.get(request_id)
+        if out is None or out.finished:
+            return False
+        seq = self._find_seq(request_id)
+        if seq is None:
+            return False
+        self._cancel_seq(seq, reason)
+        return True
+
+    def _find_seq(self, request_id: str) -> Optional[SequenceState]:
+        for s in self.scheduler.waiting:
+            if s.seq_id == request_id:
+                return s
+        for _, s in self.scheduler.running():
+            if s.seq_id == request_id:
+                return s
+        return None
+
+    def _expire_deadlines(self) -> None:
+        """Cancel waiting/prefilling requests past their deadline (terminal
+        ``deadline`` status) so pool capacity goes to requests that can
+        still meet theirs. Runs at the top of every tick, BEFORE admission,
+        so freed blocks admit someone else the same tick."""
+        for seq in self.scheduler.expired():
+            self._cancel_seq(seq, "deadline")
+
+    def _cancel_seq(self, seq: SequenceState, reason: str) -> None:
+        self.scheduler.cancel(seq)
+        out = self._outputs[seq.seq_id]
+        out.finished = True
+        out.finish_reason = reason
+        if reason == "deadline":
+            out.deadline_missed = True
+            self._deadline_miss_total += 1
+            self._m_deadline_misses.inc()
+        # offered work the cancellation turned away, symmetric with the
+        # submit-time rejection accounting: a cancel that produced NOTHING
+        # (expired in the queue / mid-initial-prefill) sheds prompt +
+        # requested generation exactly like a reject; one that already
+        # emitted tokens sheds only the un-generated remainder (the
+        # delivered tokens stay on the output and were counted generated)
+        if seq.generated:
+            shed = seq.request.sampling.max_new_tokens - len(seq.generated)
+        else:
+            shed = (len(seq.request.prompt_ids)
+                    + seq.request.sampling.max_new_tokens)
+        if shed > 0:
+            self._shed_tokens_total += shed
+            self._m_shed_tokens.inc(shed)
+        tl = self.tracer.on_finished(seq.seq_id, reason, len(seq.generated))
+        if tl is not None:
+            out.queue_wait_s = tl.queue_wait_s
+            out.tpot_s = tl.tpot_s
+            out.preemptions = tl.preemptions
+        self._m_queue.set(self.scheduler.queue_depth)
+
     # --------------------------------------------------------------- internals
     def _start_prefill(self, seq: SequenceState) -> None:
         """Per-admission bookkeeping: prefix-cache accounting and the
@@ -518,6 +663,7 @@ class InferenceEngine:
         """Advance one sequence's prefill by one chunk. The legacy
         monolithic path (cache miss + chunking off) is kept verbatim so a
         cache-off engine is byte-identical to the pre-cache one."""
+        fault_point("serve.prefill")
         if seq.cached_tokens == 0 and self.config.prefill_chunk <= 0:
             return self._prefill_monolithic(seq)
         return self._prefill_chunk(seq)
@@ -616,6 +762,7 @@ class InferenceEngine:
     def _decode_tick(
         self, running: List[Tuple[int, SequenceState]]
     ) -> List[StreamEvent]:
+        fault_point("serve.decode_tick")
         if self._spec_enabled:
             return self._spec_decode_tick(running)
         return self._plain_decode_tick(running)
@@ -825,6 +972,16 @@ class InferenceEngine:
             self.scheduler.finish(seq)
             out.finished = True
             out.finish_reason = reason
+            # goodput: every token of a request that finished WITHIN its
+            # deadline counts (no deadline = trivially met); a late finish
+            # keeps its tokens but is a deadline miss and contributes none
+            if seq.deadline_expired(time.perf_counter()):
+                out.deadline_missed = True
+                self._deadline_miss_total += 1
+                self._m_deadline_misses.inc()
+            else:
+                self._goodput_tokens_total += len(seq.generated)
+                self._win_goodput_tokens += len(seq.generated)
             tl = self.tracer.on_finished(seq.seq_id, reason,
                                          len(seq.generated))
             if tl is not None:
@@ -880,6 +1037,14 @@ class InferenceEngine:
             "spec_acceptance_rate": (
                 self._win_spec_accepted / max(1, self._win_spec_proposed)
             ),
+            # QoS / overload outcomes (lifetime totals; bench takes deltas)
+            # + the window goodput rate — tokens from requests that met
+            # their deadline, the overload bench's headline figure
+            "rejected": float(self._rejected_total),
+            "shed_tokens": float(self._shed_tokens_total),
+            "deadline_misses": float(self._deadline_miss_total),
+            "goodput_tokens": float(self._goodput_tokens_total),
+            "goodput_tokens_per_sec": self._win_goodput_tokens / dt,
         }
         if self._win_ttft_n:
             m["ttft_avg_s"] = self._win_ttft_sum / self._win_ttft_n
@@ -890,7 +1055,9 @@ class InferenceEngine:
             # reading to the exporter gauge
             self._m_tps.set(m["decode_tokens_per_sec"])
             self._m_spec_rate.set(m["spec_acceptance_rate"])
+            self._m_goodput.set(m["goodput_tokens_per_sec"])
             self._window_tokens = 0
+            self._win_goodput_tokens = 0
             self._window_t0 = now
             self._win_ttft_sum = 0.0
             self._win_ttft_n = 0

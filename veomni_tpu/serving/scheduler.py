@@ -1,48 +1,113 @@
-"""Continuous-batching scheduler: admission, slot assignment, preemption.
+"""Continuous-batching scheduler: QoS admission, slot assignment, preemption.
 
-Policy (vLLM-style, recompute preemption):
+Policy (vLLM-style recompute preemption, PR 15 QoS layer on top):
 
-- **FIFO admission with head-of-line blocking**: waiting requests are
-  admitted in arrival order into free decode slots whenever the block pool
-  can hold their (re)compute prompt plus one block of headroom. The head is
-  never skipped — out-of-order admission would make greedy outputs depend
-  on pool pressure, which would break token-parity guarantees.
-- **Prefix-cache-aware admission**: with a
-  :class:`~veomni_tpu.serving.prefix_cache.PrefixCache` attached, admission
-  matches the recompute prompt against the radix tree first and charges
-  only the **uncached suffix** — matched full blocks are shared by
-  reference. A prompt whose every full block is cached would have nothing
-  left to run (the engine still needs the last token's logits), so its
-  divergence block is taken **copy-on-write**: the last matched block is
-  pinned as a copy source, a fresh replacement is allocated, and only the
-  final token is recomputed.
-- **LIFO recompute preemption**: when a running sequence needs a block and
-  the pool is dry (free list AND evictable cached blocks — eviction always
-  reclaims cached blocks before a preemption fires), the most recently
-  admitted running sequence is evicted. Its full blocks are **inserted into
-  the prefix cache** before its references drop, so re-admission is a
-  near-free cache hit instead of a full re-prefill; it is requeued at the
-  FRONT of the waiting queue with ``prompt + generated-so-far`` as its
-  recompute prompt. Greedy decoding is deterministic, so recompute resumes
-  the exact token stream; already-emitted tokens are never re-emitted.
+- **Per-class weighted admission**: waiting requests live in one arrival-
+  ordered queue but are *picked* by QoS class. Each class (e.g.
+  ``interactive``/``batch``, ``EngineConfig.classes``) owns a stride-
+  scheduling pass value advanced by ``1/weight`` per admission, so a
+  4:1-weighted interactive class gets ~4 of every 5 admissions while batch
+  still progresses (no starvation in either direction — interactive can't
+  starve behind a batch backlog, batch can't be frozen out). With a single
+  configured class (or no classes) the pick degenerates to the queue head:
+  **behavior-identical to the seed FIFO scheduler**.
+- **Per-tenant fairness inside each class**: admission round-robins across
+  tenants (deficit round robin with unit quantum: pick the waiting tenant
+  with the lowest served count, newly active tenants joining at the current
+  level so they can't burst on stale credit). One tenant flooding the queue
+  cannot starve another's trickle; a single-tenant stream is plain FIFO.
+- **Head-of-line within the pick**: the selected candidate is never admitted
+  around — if its blocks don't fit, admission stops for this tick (out-of-
+  order admission would make greedy outputs depend on pool pressure and
+  break token-parity guarantees). Selection state (stride passes, tenant
+  credits) commits only on successful admission.
+- **Bounded queue + per-tenant in-flight caps (load-shedding)**: past
+  ``queue_bound`` waiting requests (or ``tenant_max_inflight`` waiting+
+  running for one tenant), :meth:`add` REFUSES the request (returns False —
+  the engine turns that into a terminal ``rejected`` output, the
+  429-equivalent) instead of growing the queue without bound. Preemption
+  requeues are exempt: admitted work is never shed by its own recompute.
+- **Prefix-cache-aware admission**: unchanged from PR 9 — admission matches
+  the recompute prompt against the radix tree and charges only the uncached
+  suffix; a fully-covered prompt takes its divergence block copy-on-write.
+- **Class-aware LIFO recompute preemption**: when a running sequence needs a
+  block and the pool is dry (free list AND evictable cached blocks), the
+  victim is the most recently admitted sequence of the LOWEST-priority
+  class — batch preempts before interactive regardless of admission order;
+  within a class, LIFO exactly as before. Victims requeue at the FRONT of
+  the waiting order with ``prompt + generated-so-far`` as their recompute
+  prompt; greedy decoding resumes the exact token stream.
+- **Deadline expiry**: :meth:`expired` names waiting (and still-prefilling)
+  sequences past their ``Request.deadline_s``; the engine cancels them via
+  :meth:`cancel`, which releases any partially-claimed blocks AND a still-
+  pinned copy-on-write source — a shed mid-chunked-prefill request can
+  never leak pool blocks.
 
 The scheduler is pure host bookkeeping — it owns no device state and is
-unit-testable without building a model. When a
+unit-testable without building a model. Only the engine's pump thread
+touches it (the exporter reads the thread-safe registry gauges the engine
+publishes, never live scheduler state — docs/static-analysis.md). When a
 :class:`~veomni_tpu.observability.request_trace.RequestTracer` is attached
 (the engine does), the scheduler reports its transitions — queued, admitted
 (with slot), preempted — so every request carries a lifecycle timeline; the
-engine reports the rest (prefill-done, first token, finished).
+engine reports the rest (prefill-done, first token, finished/cancelled).
 """
 
 from __future__ import annotations
 
 import time
-from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Deque, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from veomni_tpu.serving.api import Request
 from veomni_tpu.serving.kv_block_manager import KVBlockManager
+
+#: default QoS classes, highest priority first: interactive gets 4 of every
+#: 5 admission picks under contention, batch the remaining 1
+DEFAULT_CLASSES: Tuple[Tuple[str, int], ...] = (("interactive", 4),
+                                                ("batch", 1))
+
+
+def parse_classes(spec: Union[str, Sequence, None]
+                  ) -> List[Tuple[str, int]]:
+    """``"interactive:4,batch:1"`` (or an already-structured sequence of
+    ``(name, weight)``) -> ordered class list, FIRST = highest priority
+    (both for admission tie-breaks and for preemption: later classes are
+    preempted first). Weights must be positive ints; names unique."""
+    if spec is None or spec == "":
+        return list(DEFAULT_CLASSES)
+    if isinstance(spec, str):
+        entries = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.partition(":")
+            name = name.strip()
+            if not name:
+                raise ValueError(f"empty class name in classes spec {spec!r}")
+            try:
+                weight = int(w) if w.strip() else 1
+            except ValueError:
+                raise ValueError(
+                    f"class weight must be an integer in {part!r} "
+                    f"(classes spec {spec!r})"
+                ) from None
+            entries.append((name, weight))
+    else:
+        entries = [(str(n), int(w)) for n, w in spec]
+    if not entries:
+        raise ValueError(f"classes spec {spec!r} defines no classes")
+    names = [n for n, _ in entries]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate class name in classes spec {spec!r}")
+    for name, weight in entries:
+        if weight < 1:
+            raise ValueError(
+                f"class {name!r} has non-positive weight {weight} "
+                "(weights are admission shares, must be >= 1)"
+            )
+    return entries
 
 
 @dataclass
@@ -59,6 +124,8 @@ class SequenceState:
     preemptions: int = 0
     submit_time: float = field(default_factory=time.perf_counter)
     first_token_time: Optional[float] = None
+    # QoS class index into the scheduler's class list (0 with classes off)
+    class_idx: int = 0
     # chunked-prefill / prefix-cache state for the CURRENT admission
     prefilling: bool = False  # admitted, prefill not finished (chunks left)
     prefill_pos: int = 0  # next uncomputed position (rows [0, here) valid)
@@ -68,6 +135,18 @@ class SequenceState:
     @property
     def seq_id(self) -> str:
         return self.request.request_id
+
+    @property
+    def tenant(self) -> str:
+        return getattr(self.request, "tenant", "")
+
+    @property
+    def deadline_s(self) -> Optional[float]:
+        return getattr(self.request, "deadline_s", None)
+
+    def deadline_expired(self, now: float) -> bool:
+        dl = self.deadline_s
+        return dl is not None and (now - self.submit_time) > dl
 
     @property
     def recompute_prompt(self) -> List[int]:
@@ -90,18 +169,47 @@ class Scheduler:
     def __init__(self, num_slots: int, block_manager: KVBlockManager,
                  tracer: Optional[Any] = None,
                  prefix_cache: Optional[Any] = None,
-                 spec_headroom_blocks: int = 0):
+                 spec_headroom_blocks: int = 0,
+                 classes: Optional[Sequence[Tuple[str, int]]] = None,
+                 queue_bound: int = 0,
+                 tenant_max_inflight: int = 0):
         if num_slots < 1:
             raise ValueError("need at least one decode slot")
+        if queue_bound < 0:
+            raise ValueError("queue_bound must be >= 0 (0 = unbounded)")
+        if tenant_max_inflight < 0:
+            raise ValueError("tenant_max_inflight must be >= 0 (0 = uncapped)")
         self.blocks = block_manager
         self.cache = prefix_cache
-        self.waiting: Deque[SequenceState] = deque()
+        # arrival-ordered waiting list (front = next within its class and
+        # tenant; preemption requeues at the very front). The QoS pick
+        # selects INTO this order — it never reorders it, so within one
+        # (class, tenant) stream admission is exactly the seed FIFO.
+        self._waiting: List[SequenceState] = []
         self.slots: List[Optional[SequenceState]] = [None] * num_slots
         self.preemption_count = 0
         self._admit_counter = 0
         # optional RequestTracer (duck-typed: anything with on_queued /
         # on_admitted / on_preempted) — None keeps the scheduler trace-free
         self.tracer = tracer
+        # QoS classes, highest priority first. None (or a single class) is
+        # the seed scheduler: one FIFO queue, any priority label admitted.
+        self.classes = list(classes) if classes else None
+        self._weights = {i: w for i, (_, w) in enumerate(self.classes or ())}
+        self._class_idx = {n: i for i, (n, _) in
+                          enumerate(self.classes or ())}
+        # stride-scheduling state across classes: pass values advance by
+        # 1/weight per admission; _vtime floors a newly active class so an
+        # idle class can't burst on stale credit
+        self._pass: Dict[int, float] = {}
+        self._vtime = 0.0
+        # per-(class, tenant) served counts (unit-quantum DRR) + per-class
+        # floor a newly active tenant joins at
+        self._tenant_served: Dict[Tuple[int, str], int] = {}
+        self._tenant_floor: Dict[int, int] = {}
+        # admission control: 0 disables either bound (seed behavior)
+        self.queue_bound = queue_bound
+        self.tenant_max_inflight = tenant_max_inflight
         # extra admission headroom when the engine decodes speculatively:
         # a running sequence can grow by ceil(spec_k / block_size) blocks
         # per tick on top of the usual one, so admission keeps that many
@@ -112,8 +220,15 @@ class Scheduler:
 
     # ---------------------------------------------------------------- queries
     @property
+    def waiting(self) -> List["SequenceState"]:
+        """Waiting sequences in arrival order (requeued preemptions at the
+        front) — a read-only view for tests/introspection; the QoS pick
+        decides the actual admission order."""
+        return list(self._waiting)
+
+    @property
     def queue_depth(self) -> int:
-        return len(self.waiting)
+        return len(self._waiting)
 
     @property
     def num_running(self) -> int:
@@ -121,29 +236,117 @@ class Scheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting) or self.num_running > 0
+        return bool(self._waiting) or self.num_running > 0
 
     def running(self) -> List[Tuple[int, SequenceState]]:
         """(slot, seq) pairs in slot order — the decode batch row order."""
         return [(i, s) for i, s in enumerate(self.slots) if s is not None]
 
+    def resolve_class(self, priority: str) -> int:
+        """Class index for a request's priority label. A single-class (or
+        class-less) scheduler accepts ANY label into its one queue — the
+        seed-FIFO configuration; a multi-class one refuses unknown labels
+        loudly (a typo'd priority silently landing in the wrong tier would
+        be an SLO bug nobody can see)."""
+        if self.classes is None or len(self.classes) == 1:
+            return 0
+        try:
+            return self._class_idx[priority]
+        except KeyError:
+            raise ValueError(
+                f"unknown priority class {priority!r}; configured classes: "
+                f"{[n for n, _ in self.classes]}"
+            ) from None
+
+    def tenant_inflight(self, tenant: str) -> int:
+        """Waiting + running sequences charged to one tenant (the in-flight
+        cap's accounting unit)."""
+        n = sum(1 for s in self._waiting if s.tenant == tenant)
+        n += sum(1 for _, s in self.running() if s.tenant == tenant)
+        return n
+
     # ------------------------------------------------------------ transitions
-    def add(self, seq: SequenceState) -> None:
-        self.waiting.append(seq)
+    def add(self, seq: SequenceState) -> bool:
+        """Enqueue a fresh request. Returns False — the load-shedding
+        refusal, the engine's 429-equivalent — when the waiting queue is at
+        ``queue_bound`` or the sequence's tenant is at
+        ``tenant_max_inflight``; the caller owns turning that into a
+        terminal REJECTED output. Accepted sequences get their class index
+        resolved here (unknown labels raise, see :meth:`resolve_class`)."""
+        seq.class_idx = self.resolve_class(
+            getattr(seq.request, "priority", "interactive")
+        )
+        if self.queue_bound and len(self._waiting) >= self.queue_bound:
+            return False
+        if self.tenant_max_inflight and (
+                self.tenant_inflight(seq.tenant) >= self.tenant_max_inflight):
+            return False
+        self._waiting.append(seq)
         if self.tracer is not None:
             self.tracer.on_queued(seq.seq_id)
+        return True
+
+    # ------------------------------------------------------------- QoS pick
+    def _pick_candidate(self) -> Optional[SequenceState]:
+        """Next admission candidate under the QoS policy. Pure — selection
+        state commits in :meth:`_commit_pick` only after the candidate's
+        blocks actually fit, so a head-of-line wait doesn't burn credit."""
+        if not self._waiting:
+            return None
+        if self.classes is None or len(self.classes) == 1:
+            return self._waiting[0]  # seed FIFO exactly
+        # stride pick across active classes: lowest effective pass wins,
+        # ties break toward the higher-priority (earlier) class. An idle
+        # class's stale pass is floored at _vtime so it can't burst.
+        active = sorted({s.class_idx for s in self._waiting})
+        c = min(active, key=lambda i: (max(self._pass.get(i, 0.0),
+                                           self._vtime), i))
+        # unit-quantum DRR across the class's active tenants: lowest served
+        # count wins, ties break toward the earliest-waiting tenant
+        order: List[str] = []
+        for s in self._waiting:
+            if s.class_idx == c and s.tenant not in order:
+                order.append(s.tenant)
+        t = min(order, key=lambda tn: (
+            max(self._tenant_served.get((c, tn), 0),
+                self._tenant_floor.get(c, 0)),
+            order.index(tn),
+        ))
+        for s in self._waiting:
+            if s.class_idx == c and s.tenant == t:
+                return s
+        raise AssertionError("picked (class, tenant) has no waiting seq")
+
+    def _commit_pick(self, seq: SequenceState) -> None:
+        """Remove the admitted candidate from the waiting order and charge
+        its class stride + tenant credit."""
+        self._waiting.remove(seq)
+        if self.classes is None or len(self.classes) == 1:
+            return
+        c = seq.class_idx
+        base = max(self._pass.get(c, 0.0), self._vtime)
+        self._vtime = base
+        self._pass[c] = base + 1.0 / self._weights[c]
+        served = max(self._tenant_served.get((c, seq.tenant), 0),
+                     self._tenant_floor.get(c, 0))
+        self._tenant_served[(c, seq.tenant)] = served + 1
+        # newly active tenants join at the level of the last pick: fair
+        # from now on, no retroactive catch-up burst
+        self._tenant_floor[c] = served
 
     def admit(self) -> List[SequenceState]:
-        """Fill free slots from the waiting queue (FIFO, head-of-line).
-        Admission matches the recompute prompt against the prefix cache,
-        shares the matched blocks, and allocates only the uncached suffix —
-        plus one extra free block of headroom so a fresh admission isn't
-        preempted on its very first decode step just to grow someone else."""
+        """Fill free slots from the waiting queue (QoS pick; plain FIFO
+        head-of-line with a single class). Admission matches the recompute
+        prompt against the prefix cache, shares the matched blocks, and
+        allocates only the uncached suffix — plus one extra free block of
+        headroom so a fresh admission isn't preempted on its very first
+        decode step just to grow someone else. The picked candidate is
+        never admitted around: if it doesn't fit, admission stops."""
         admitted = []
         for slot in range(len(self.slots)):
-            if self.slots[slot] is not None or not self.waiting:
+            if self.slots[slot] is not None or not self._waiting:
                 continue
-            head = self.waiting[0]
+            head = self._pick_candidate()
             prompt = head.recompute_prompt
             p = len(prompt)
             n_total = self.blocks.blocks_for(p)
@@ -174,8 +377,8 @@ class Scheduler:
             if cow_src is not None and self.blocks.refcount(cow_src) == 0:
                 pinned.append(cow_src)
             if self.blocks.num_free - len(pinned) < n_new + headroom:
-                break  # head-of-line: never admit around the queue head
-            self.waiting.popleft()
+                break  # head-of-line: never admit around the picked head
+            self._commit_pick(head)
             self.blocks.allocate_shared(head.seq_id, shared, n_new,
                                         cow_src=cow_src)
             head.cow_src = cow_src
@@ -194,13 +397,20 @@ class Scheduler:
                 self.tracer.on_admitted(head.seq_id, slot)
         return admitted
 
+    def _preempt_victim(self) -> SequenceState:
+        """Class-aware LIFO: the newest admission of the LOWEST-priority
+        running class — batch is evicted before interactive no matter who
+        arrived first; within one class this is exactly the seed LIFO."""
+        return max((s for _, s in self.running()),
+                   key=lambda s: (s.class_idx, s.admit_order))
+
     def ensure_decode_capacity(self) -> List[SequenceState]:
         """Grow each decoding sequence to cover its next write position,
-        preempting (LIFO) when the pool — free list plus evictable cached
-        blocks — runs dry. Mid-prefill sequences already hold their whole
-        prompt allocation and are skipped for growth (but stay preemptable).
-        Returns the preempted sequences (already requeued at the front of
-        the waiting queue)."""
+        preempting (class-aware LIFO) when the pool — free list plus
+        evictable cached blocks — runs dry. Mid-prefill sequences already
+        hold their whole prompt allocation and are skipped for growth (but
+        stay preemptable). Returns the preempted sequences (already
+        requeued at the front of the waiting queue)."""
         preempted: List[SequenceState] = []
         for _, seq in self.running():
             if seq.slot < 0 or seq.prefilling:  # preempted / still prefilling
@@ -210,9 +420,7 @@ class Scheduler:
                 if self.blocks.can_allocate(1):
                     self.blocks.grow(seq.seq_id, 1)
                     continue
-                victim = max(
-                    (s for _, s in self.running()), key=lambda s: s.admit_order
-                )
+                victim = self._preempt_victim()
                 self._preempt(victim)
                 preempted.append(victim)
                 if victim is seq:
@@ -246,6 +454,27 @@ class Scheduler:
         # the mandatory ensure_decode_capacity pass handles that case)
         return max(0, min(k, have * bs - 1 - seq.pos)), claimed
 
+    def expired(self, now: Optional[float] = None) -> List[SequenceState]:
+        """Sequences past their deadline that have produced NOTHING yet:
+        still waiting for their first admission, or admitted but still
+        mid-initial-prefill. A sequence that has emitted tokens keeps
+        running to completion no matter where it sits — including a
+        preempted one waiting to re-admit: cancelling a partially-streamed
+        request mid-stream would waste the delivered tokens AND make the
+        client-visible outcome depend on pool pressure (whether a
+        preemption happened to land), exactly the coupling the head-of-line
+        admission rule exists to prevent. Late finishers are merely marked
+        deadline_missed and excluded from goodput. The engine cancels each
+        returned sequence via :meth:`cancel`."""
+        if now is None:
+            now = time.perf_counter()
+        out = [s for s in self._waiting
+               if not s.generated and s.deadline_expired(now)]
+        out += [s for _, s in self.running()
+                if s.prefilling and not s.generated
+                and s.deadline_expired(now)]
+        return out
+
     def cache_insert(self, seq: SequenceState) -> int:
         """Register the sequence's full KV blocks in the prefix cache, keyed
         on the tokens they hold. Called at prefill completion (prompt blocks
@@ -263,9 +492,16 @@ class Scheduler:
 
     def _release(self, seq: SequenceState) -> None:
         """Drop the sequence's block references, caching its full blocks
-        first so they stay warm for re-admission or other requests."""
+        first so they stay warm for re-admission or other requests. A
+        still-pinned copy-on-write source (admission happened but the
+        engine's device copy hasn't landed — possible when a sequence is
+        cancelled between the two) releases here too: the shed-mid-prefill
+        path must leak NOTHING."""
         self.cache_insert(seq)
         self.blocks.free_seq(seq.seq_id)
+        if seq.cow_src is not None:
+            self.blocks.release_block(seq.cow_src)
+            seq.cow_src = None
 
     def _preempt(self, seq: SequenceState) -> None:
         self._release(seq)
@@ -278,14 +514,46 @@ class Scheduler:
         seq.prefilling = False
         seq.prefill_pos = 0
         seq.cached_tokens = 0
-        seq.cow_src = None
         seq.pos = 0
-        self.waiting.appendleft(seq)
+        # requeue at the FRONT, bypassing the admission-control bounds:
+        # admitted work is never shed by its own recompute
+        self._waiting.insert(0, seq)
         if self.tracer is not None:
             self.tracer.on_preempted(seq.seq_id)
+
+    def cancel(self, seq: SequenceState) -> None:
+        """Remove a sequence wherever it is — waiting (deadline expiry,
+        explicit cancel) or running (shed mid-chunked-prefill) — releasing
+        every block reference it holds, including partially-claimed prefill
+        blocks and a pinned copy-on-write source. Idempotent."""
+        if seq.slot >= 0:
+            self._release(seq)
+            self.slots[seq.slot] = None
+            seq.slot = -1
+        else:
+            try:
+                self._waiting.remove(seq)
+            except ValueError:
+                pass  # already admitted/cancelled — nothing to remove
+        self._prune_tenant(seq.tenant)
 
     def finish(self, seq: SequenceState) -> None:
         self._release(seq)
         if seq.slot >= 0:
             self.slots[seq.slot] = None
         seq.slot = -1
+        self._prune_tenant(seq.tenant)
+
+    def _prune_tenant(self, tenant: str) -> None:
+        """Drop a fully-drained tenant's DRR credit entries: a long-running
+        server sees unboundedly many distinct tenant ids, and keeping one
+        counter per (class, tenant) forever would leak. Safe for fairness —
+        a rejoining tenant is re-floored at the class's current credit
+        level (``max(served, _tenant_floor[c])``), exactly as if its stale
+        entry had been kept."""
+        if any(s.tenant == tenant for s in self._waiting):
+            return
+        if any(s.tenant == tenant for _, s in self.running()):
+            return
+        for key in [k for k in self._tenant_served if k[1] == tenant]:
+            del self._tenant_served[key]
