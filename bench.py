@@ -517,6 +517,8 @@ def run_serve_bench(
     prefix_cache: bool = True,
     spec_ks=(),
     spec_draft: str = "ngram",
+    kv_quant: str = "",
+    weight_quant: str = "none",
 ) -> dict:
     """Continuous-batching inference throughput: N requests with a cycled
     prompt-length mix through the serving engine. Returns decode tokens/s
@@ -536,7 +538,17 @@ def run_serve_bench(
     the sweep records decode tok/s and the verify acceptance rate at each
     k, with the k=0 run doubling as the ``nospec_*`` baseline — the
     accepted-tokens-per-verify-width tradeoff curve the ROADMAP's
-    speculative-decoding item regresses against."""
+    speculative-decoding item regresses against.
+
+    ``kv_quant`` (BENCH_SERVE_KV_QUANT, e.g. ``int8``; optionally paired
+    with ``weight_quant`` via BENCH_SERVE_WEIGHT_QUANT) additionally
+    drives the SAME timed request set through a quantized engine
+    (mirroring the nocache_*/nospec_* comparisons): the JSON line then
+    carries quantized-vs-f32 decode tok/s and TTFT, the measured per-block
+    byte sizes (int8 payload + scale sidecar, straight from the pool's
+    ``nbytes``), the fixed-pool-bytes capacity ratio, and the fixed-seed
+    quality-gate stats — so a capacity win can never be reported without
+    its quality cost in the same record."""
     import jax
     import jax.numpy as jnp
 
@@ -723,6 +735,56 @@ def run_serve_bench(
                 result["nospec_tpot_p50_s"] = entry["tpot_p50_s"]
         result["spec_sweep"] = sweep
         result["spec_draft"] = spec_draft
+    if kv_quant:
+        # the SAME timed request set through a quantized engine (mirrors
+        # the nocache_*/nospec_* comparisons above). Byte sizes come from
+        # the live pools via kv_capacity() (QuantizedKV.nbytes = int8
+        # payload + f32 scale sidecar), and the fixed-seed quality gate
+        # rides in the same record: capacity and quality move together.
+        from veomni_tpu.serving.quality import fixed_corpus, quality_stats
+
+        eng_q, ids_q, outs_q, dt_q, _ = drive(
+            EngineConfig(num_slots=num_slots, block_size=block_size,
+                         max_model_len=max_len, prefix_cache=prefix_cache,
+                         prefill_chunk=prefill_chunk, kv_quant=kv_quant,
+                         weight_quant=weight_quant),
+            warm, timed_prompts,
+        )
+        _beat(phase="serve_kv_quant")
+        total_q = sum(len(outs_q[rid].token_ids) for rid in ids_q)
+        q_ttfts = [outs_q[rid].ttft_s for rid in ids_q
+                   if outs_q[rid].ttft_s is not None]
+        cap_f32 = eng.kv_capacity()
+        cap_q = eng_q.kv_capacity()
+        # fixed-pool-BYTES capacity: max-length sequences the quantized
+        # blocks fit inside the f32 pool's byte budget vs what f32 fits —
+        # the "2x the users in the same HBM" headline (block 0 stays the
+        # reserved null block in both denominators)
+        per_seq = max(1.0, cap_f32["blocks_per_max_len_seq"])
+        q_blocks_in_f32_bytes = cap_f32["pool_bytes"] // max(
+            1.0, cap_q["block_bytes"])
+        q_seqs = (q_blocks_in_f32_bytes - 1) // per_seq
+        stats = quality_stats(
+            params, cfg, fixed_corpus(cfg.vocab_size),
+            kv_quant=kv_quant, weight_quant=weight_quant,
+            block_size=block_size,
+        )
+        result.update({
+            "kv_quant": kv_quant,
+            "weight_quant": weight_quant,
+            "kvq_decode_tok_s": total_q / dt_q,
+            "kvq_ttft_p50_s": _pctl(q_ttfts, 50),
+            "kvq_ttft_p99_s": _pctl(q_ttfts, 99),
+            "kv_block_bytes": cap_q["block_bytes"],
+            "kv_block_bytes_f32": cap_f32["block_bytes"],
+            "kv_capacity_ratio": (
+                q_seqs / max(1.0, cap_f32["max_concurrent_seqs"])
+            ),
+            "quality_ppl_ref": stats["ppl_ref"],
+            "quality_ppl_quant": stats["ppl_quant"],
+            "quality_ppl_rel_delta": stats["ppl_rel_delta"],
+            "quality_topk_overlap": stats["topk_overlap"],
+        })
     return result
 
 
@@ -742,6 +804,8 @@ def run_serve_open_loop_bench(
     interactive_frac: float = 0.5,
     classes: str = "interactive:4,batch:1",
     seed: int = 0,
+    kv_quant: str = "",
+    weight_quant: str = "none",
     _model=None,
 ) -> dict:
     """Open-loop Poisson overload bench: arrivals fire on a fixed schedule
@@ -763,6 +827,12 @@ def run_serve_open_loop_bench(
     tokens from requests that finished within their deadline per second
     of wall time, the number that keeps honest under overload when raw
     decode tok/s still looks fine.
+
+    ``kv_quant`` (BENCH_SERVE_KV_QUANT) adds a quantized leg at FIXED
+    pool bytes: the int8 pool is sized to the f32 pool's exact byte
+    budget (more, smaller blocks), the same Poisson arrivals replay at
+    the same rates, and each ``kvq_sweep`` entry carries the
+    goodput-under-overload and reject-rate deltas vs the f32 leg.
 
     ``_model`` injects a prebuilt ``(params, cfg)`` (tier-1 CPU smoke uses
     a tiny model); by default the ``preset`` model is built fresh."""
@@ -848,10 +918,10 @@ def run_serve_open_loop_bench(
     rates = [float(r) for r in arrival_rates] or [
         m * capacity_rps for m in arrival_rate_mults
     ]
-    sweep = []
-    for rate in rates:
+
+    def run_rate(rate, **cfg_kw):
         eng = InferenceEngine(params, cfg, engine_cfg(
-            queue_bound=queue_bound, classes=classes,
+            queue_bound=queue_bound, classes=classes, **cfg_kw,
         ))
         for r in warm:  # per-engine jit caches: warm each engine
             eng.run([Request(prompt_ids=r.prompt_ids, sampling=r.sampling,
@@ -890,7 +960,7 @@ def run_serve_open_loop_bench(
         n_rej = sum(1 for o in outs.values()
                     if o.finish_reason == "rejected")
         n_miss = sum(1 for o in outs.values() if o.deadline_missed)
-        sweep.append({
+        return {
             "arrival_rate_rps": rate,
             "rate_vs_capacity": rate / max(capacity_rps, 1e-9),
             "reject_rate": n_rej / max(1, n_requests),
@@ -907,9 +977,13 @@ def run_serve_open_loop_bench(
             "goodput_tok_s": (m1["goodput_tokens"] - m0["goodput_tokens"])
             / dt,
             "shed_tokens": m1["shed_tokens"] - m0["shed_tokens"],
-        })
+        }
+
+    sweep = []
+    for rate in rates:
+        sweep.append(run_rate(rate))
         _beat(global_step=len(sweep), phase="serve_open_loop")
-    return {
+    result = {
         "capacity_rps": capacity_rps,
         "num_slots": num_slots,
         "block_size": block_size,
@@ -923,6 +997,47 @@ def run_serve_open_loop_bench(
         "classes": classes,
         "sweep": sweep,
     }
+    if kv_quant:
+        # quantized leg at FIXED pool bytes: size the quantized pool to the
+        # f32 pool's exact byte budget (int8 blocks are smaller, so more of
+        # them fit), then replay the SAME Poisson arrivals at the SAME
+        # swept rates — the per-rate goodput/reject deltas isolate what the
+        # extra KV capacity buys under overload, at constant HBM spend
+        import jax.numpy as jnp
+
+        from veomni_tpu.ops.quantization import kv_block_nbytes
+
+        kb = (cfg.num_hidden_layers, block_size,
+              cfg.num_key_value_heads, cfg.head_dim)
+        dtype_bytes = jnp.dtype(cfg.dtype).itemsize
+        f32_block = kv_block_nbytes(*kb, kv_quant="none",
+                                    dtype_bytes=dtype_bytes)
+        q_block = kv_block_nbytes(*kb, kv_quant=kv_quant,
+                                  dtype_bytes=dtype_bytes)
+        f32_blocks = engine_cfg().num_blocks  # the defaulted f32 pool
+        q_blocks = max(f32_blocks, (f32_blocks * f32_block) // q_block)
+        q_sweep = []
+        for rate in rates:
+            q_sweep.append(run_rate(
+                rate, kv_quant=kv_quant, weight_quant=weight_quant,
+                num_blocks=int(q_blocks),
+            ))
+            _beat(global_step=len(q_sweep), phase="serve_open_loop_kvq")
+        for base, q in zip(sweep, q_sweep):
+            q["goodput_delta_tok_s"] = (
+                q["goodput_tok_s"] - base["goodput_tok_s"]
+            )
+            q["reject_rate_delta"] = q["reject_rate"] - base["reject_rate"]
+        result.update({
+            "kv_quant": kv_quant,
+            "weight_quant": weight_quant,
+            "kv_block_bytes": float(q_block),
+            "kv_block_bytes_f32": float(f32_block),
+            "kvq_num_blocks": int(q_blocks),
+            "f32_num_blocks": int(f32_blocks),
+            "kvq_sweep": q_sweep,
+        })
+    return result
 
 
 def _serve_open_loop_main(preset: str, watchdog=None):
@@ -958,6 +1073,10 @@ def _serve_open_loop_main(preset: str, watchdog=None):
         ),
         classes=os.environ.get("BENCH_SERVE_CLASSES",
                                "interactive:4,batch:1"),
+        # BENCH_SERVE_KV_QUANT=int8 adds the fixed-pool-bytes quantized
+        # leg (optionally BENCH_SERVE_WEIGHT_QUANT=int8 for tier 2 too)
+        kv_quant=os.environ.get("BENCH_SERVE_KV_QUANT", ""),
+        weight_quant=os.environ.get("BENCH_SERVE_WEIGHT_QUANT", "none"),
     )
     if watchdog is not None:
         watchdog.stop()
@@ -990,6 +1109,21 @@ def _serve_open_loop_main(preset: str, watchdog=None):
              for k, v in entry.items()}
             for entry in r["sweep"]
         ],
+        # fixed-pool-bytes quantized leg when BENCH_SERVE_KV_QUANT is set:
+        # same arrivals, same byte budget, per-rate goodput/reject deltas
+        **({
+            "kv_quant": r["kv_quant"],
+            "weight_quant": r["weight_quant"],
+            "kv_block_bytes": r["kv_block_bytes"],
+            "kv_block_bytes_f32": r["kv_block_bytes_f32"],
+            "kvq_num_blocks": r["kvq_num_blocks"],
+            "f32_num_blocks": r["f32_num_blocks"],
+            "kvq_sweep": [
+                {k: (round(v, 5) if isinstance(v, float) else v)
+                 for k, v in entry.items()}
+                for entry in r["kvq_sweep"]
+            ],
+        } if "kv_quant" in r else {}),
     }), flush=True)
     _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 
@@ -1026,6 +1160,10 @@ def _serve_main(preset: str, watchdog=None):
         not in ("0", ""),
         spec_ks=spec_ks,
         spec_draft=os.environ.get("BENCH_SERVE_SPEC_DRAFT", "ngram"),
+        # BENCH_SERVE_KV_QUANT=int8 adds the quantized-engine comparison
+        # leg (optionally BENCH_SERVE_WEIGHT_QUANT=int8 for tier 2 too)
+        kv_quant=os.environ.get("BENCH_SERVE_KV_QUANT", ""),
+        weight_quant=os.environ.get("BENCH_SERVE_WEIGHT_QUANT", "none"),
     )
     if watchdog is not None:
         watchdog.stop()
@@ -1079,6 +1217,22 @@ def _serve_main(preset: str, watchdog=None):
         if "nospec_decode_tok_s" in r:
             line["nospec_decode_tok_s"] = round(r["nospec_decode_tok_s"], 1)
             line["nospec_tpot_p50_s"] = round(r["nospec_tpot_p50_s"], 5)
+    if "kv_quant" in r:
+        # quantized serving tier (ops/quantization.py): same timed set
+        # through an int8-KV (and optionally int8-weight) engine, with the
+        # measured per-block bytes, the fixed-pool-bytes capacity ratio,
+        # and the fixed-seed quality-gate stats riding in the same record
+        line["kv_quant"] = r["kv_quant"]
+        line["weight_quant"] = r["weight_quant"]
+        line["kvq_decode_tok_s"] = round(r["kvq_decode_tok_s"], 1)
+        line["kvq_ttft_p50_s"] = round(r["kvq_ttft_p50_s"], 5)
+        line["kvq_ttft_p99_s"] = round(r["kvq_ttft_p99_s"], 5)
+        line["kv_block_bytes"] = r["kv_block_bytes"]
+        line["kv_block_bytes_f32"] = r["kv_block_bytes_f32"]
+        line["kv_capacity_ratio"] = round(r["kv_capacity_ratio"], 3)
+        line["quality_ppl_rel_delta"] = round(
+            r["quality_ppl_rel_delta"], 6)
+        line["quality_topk_overlap"] = round(r["quality_topk_overlap"], 4)
     print(json.dumps(line), flush=True)
     _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 

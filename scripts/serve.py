@@ -33,6 +33,10 @@ slot per tick, 0 = off) with VEOMNI_SERVE_SPEC_DRAFT selecting the drafting
 strategy (`ngram` prompt-lookup default, `off` disables),
 VEOMNI_SERVE_QUEUE_BOUND (max waiting requests before submissions are
 load-shed with a terminal "rejected" status; 0 = unbounded),
+VEOMNI_SERVE_KV_QUANT (KV block storage: `none` default | `int8` —
+int8 blocks + f32 scale sidecar, ~4x concurrent sequences per pool byte
+at f32, quality-gated), VEOMNI_SERVE_WEIGHT_QUANT (decode weight
+storage: `none` default | `int8` per-channel, dequantized in-kernel),
 VEOMNI_SERVE_CLASSES (QoS classes "name:weight,..." highest priority
 first; a single class restores plain FIFO), VEOMNI_SERVE_TENANT_INFLIGHT
 (per-tenant waiting+running cap, 0 = uncapped),
@@ -120,6 +124,17 @@ def main():
                                            "ngram"),
                     help="drafting strategy registry impl (`ngram` "
                          "prompt-lookup, `off`)")
+    ap.add_argument("--kv-quant", choices=("none", "int8", "fp8"),
+                    default=os.environ.get("VEOMNI_SERVE_KV_QUANT", "none"),
+                    help="KV block storage mode: int8 stores blocks as "
+                         "int8 + f32 scale sidecar (~4x concurrent "
+                         "sequences per pool byte at f32; NOT bit-exact — "
+                         "ships under the fixed-seed quality gate)")
+    ap.add_argument("--weight-quant", choices=("none", "int8"),
+                    default=os.environ.get("VEOMNI_SERVE_WEIGHT_QUANT",
+                                           "none"),
+                    help="decode-path weight storage: int8 per-channel, "
+                         "dequantized in-kernel (decode_matmul/xla_q8)")
     ap.add_argument("--queue-bound", type=int,
                     default=int(os.environ.get("VEOMNI_SERVE_QUEUE_BOUND",
                                                0)),
@@ -173,7 +188,18 @@ def main():
         spec_k=args.spec_k, spec_draft=args.spec_draft,
         classes=args.classes, queue_bound=args.queue_bound,
         tenant_max_inflight=args.tenant_inflight,
+        kv_quant=args.kv_quant, weight_quant=args.weight_quant,
     ))
+    # startup echo of the quant tier next to the capacity it buys: the
+    # operator sees the storage mode AND the "users that fit" figure the
+    # quantized pool actually provides, before any request lands
+    cap = engine.kv_capacity()
+    print(json.dumps({
+        "kv_quant": args.kv_quant, "weight_quant": args.weight_quant,
+        "kv_pool_bytes": cap["pool_bytes"],
+        "kv_block_bytes": cap["block_bytes"],
+        "kv_max_concurrent_seqs": cap["max_concurrent_seqs"],
+    }), flush=True)
     # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz + /debug/flight +
     # /debug/requests (per-request timelines) for the pump loop (the engine
     # feeds the same registry the trainer exports through)
@@ -306,6 +332,10 @@ def main():
             "ttft_s": round(o.ttft_s, 4) if o.ttft_s is not None else None,
             "cached_tokens": o.cached_tokens,
             "spec_accepted_tokens": o.spec_accepted_tokens,
+            # quant tier echoed per request: a scraped response line is
+            # self-describing about whether it came off a quantized engine
+            "kv_quant": args.kv_quant,
+            "weight_quant": args.weight_quant,
         }
         if o.deadline_missed:
             line["deadline_missed"] = True

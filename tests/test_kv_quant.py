@@ -1,0 +1,448 @@
+"""Quantized serving tier: int8 KV blocks + int8 decode weights.
+
+The contract under test has two halves. Quantization changes VALUES, so
+token-exact parity with the f32 engine is replaced by the fixed-seed
+quality gate (``tests/tools/quality_gate.py``: bounded perplexity delta +
+top-k overlap). It must NOT change STRUCTURE, so every exact identity of
+the unquantized engine — no-leak block accounting, free+cached == pool,
+deterministic replay, preemption-recompute self-parity, CoW isolation,
+spec-decode self-parity, bucket-bounded compile counts — is asserted
+bit-for-bit on the quantized engine across the same hard drill matrix the
+f32 engine earns its keep on.
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veomni_tpu.models import TransformerConfig, build_foundation_model
+from veomni_tpu.models import decode as decode_mod
+from veomni_tpu.ops.quantization import (
+    DECODE_QUANT_KEYS,
+    QuantizedKV,
+    QuantizedWeight,
+    decode_dot,
+    dequantize_rows,
+    kv_block_nbytes,
+    make_kv_pool,
+    quantize_decode_params,
+    quantize_rows,
+    quantize_weight,
+)
+from veomni_tpu.serving import EngineConfig, InferenceEngine, Request, SamplingParams
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tools"))
+from quality_gate import (  # noqa: E402
+    PPL_REL_DELTA_BOUND,
+    TOPK_OVERLAP_BOUND,
+    assert_quality_gate,
+)
+
+QWEN3 = dict(
+    model_type="qwen3", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, head_dim=16, qk_norm=True,
+)
+GPT_OSS_ISH = dict(
+    model_type="gpt_oss", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_hidden_layers=4, num_attention_heads=4,
+    num_key_value_heads=2, head_dim=16, attention_sinks=True,
+    attention_bias=True, o_bias=True, sliding_window=8,
+    layer_types=["sliding_attention", "full_attention"] * 2,
+    hidden_act="gpt_oss_glu",
+)
+QWEN3_MOE = dict(
+    model_type="qwen3_moe", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+    num_key_value_heads=2, head_dim=16, qk_norm=True, num_experts=4,
+    num_experts_per_tok=2, moe_intermediate_size=32,
+)
+
+#: the three shipped quantization modes: KV-only, weights-only, both
+MODES = [("int8", "none"), ("none", "int8"), ("int8", "int8")]
+
+
+@pytest.fixture(scope="module")
+def qwen3():
+    cfg = TransformerConfig(dtype=jnp.float32, **QWEN3)
+    model = build_foundation_model(config=cfg)
+    return model.family.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _prompts(lengths, seed=0, vocab=128):
+    rng = np.random.default_rng(seed)
+    return [[int(t) for t in rng.integers(1, vocab, n)] for n in lengths]
+
+
+def _drill(params, cfg, prompts, max_new_tokens=6, **ec):
+    """One staggered-arrival drill: first wave, two ticks, second wave,
+    drain. Returns (engine, per-request token lists in submit order)."""
+    eng = InferenceEngine(params, cfg, EngineConfig(**ec))
+    ids = [eng.submit(Request(prompt_ids=p,
+                              sampling=SamplingParams(
+                                  max_new_tokens=max_new_tokens)))
+           for p in prompts[:2]]
+    for _ in range(2):
+        eng.step()
+    ids += [eng.submit(Request(prompt_ids=p,
+                               sampling=SamplingParams(
+                                   max_new_tokens=max_new_tokens)))
+            for p in prompts[2:]]
+    outs = eng.run()
+    return eng, [outs[rid].token_ids for rid in ids]
+
+
+def _assert_no_leak(eng):
+    """The exact structural identities quantization must not disturb."""
+    bm = eng.blocks
+    assert bm.num_used == 0
+    assert bm.num_free_uncached + bm.num_cached == bm.num_blocks - 1
+
+
+# ------------------------------------------------------------------ unit layer
+def test_quantize_rows_roundtrip_and_zero_rows():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(3, 5, 16)), jnp.float32)
+    q, s = quantize_rows(x)
+    assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+    assert q.shape == x.shape and s.shape == x.shape[:-1]
+    # symmetric absmax over the last dim: error bounded by half an LSB of
+    # the per-row scale
+    err = np.abs(np.asarray(dequantize_rows(q, s) - x))
+    bound = np.asarray(s)[..., None] * 0.5 + 1e-7
+    assert (err <= bound).all()
+    # zero rows round-trip to EXACT zeros (fresh pool contract)
+    zq, zs = quantize_rows(jnp.zeros((4, 16)))
+    assert not np.asarray(zq).any() and not np.asarray(zs).any()
+    assert not np.asarray(dequantize_rows(zq, zs)).any()
+
+
+def test_quantized_kv_indexer_and_cow_copy_is_bit_exact():
+    pool = make_kv_pool((2, 4, 8, 2, 16), "int8", jnp.float32)
+    assert isinstance(pool, QuantizedKV)
+    rows = jnp.asarray(np.random.default_rng(1).normal(size=(8, 2, 16)),
+                       jnp.float32)
+    # float write quantizes on the way in (prefill scatter / decode append)
+    p1 = pool.at[0, 1].set(rows)
+    q, s = quantize_rows(rows)
+    assert (np.asarray(p1.data[0, 1]) == np.asarray(q)).all()
+    assert (np.asarray(p1.scale[0, 1]) == np.asarray(s)).all()
+    # QuantizedKV write copies payload + sidecar bit-exactly (the CoW path
+    # must never re-quantize: that would compound rounding per copy)
+    p2 = p1.at[0, 2].set(p1[0, 1])
+    assert (np.asarray(p2.data[0, 2]) == np.asarray(p2.data[0, 1])).all()
+    assert (np.asarray(p2.scale[0, 2]) == np.asarray(p2.scale[0, 1])).all()
+    # the logical surface the serving paths rely on
+    assert p2.shape == (2, 4, 8, 2, 16) and p2.ndim == 5
+    assert p2[0].shape == (4, 8, 2, 16)
+
+
+def test_kv_pool_nbytes_accounting_matches_sizing_primitive():
+    shape = (2, 5, 8, 2, 16)  # [L, NB, BS, hkv, d]
+    qpool = make_kv_pool(shape, "int8", jnp.float32)
+    fpool = make_kv_pool(shape, "none", jnp.float32)
+    # the live pool reports payload + sidecar; the pre-allocation sizing
+    # primitive (k + v, per block) must agree exactly with 2x pool / NB
+    assert qpool.nbytes == int(qpool.data.nbytes) + int(qpool.scale.nbytes)
+    for pool, mode in ((qpool, "int8"), (fpool, "none")):
+        per_block = kv_block_nbytes(2, 8, 2, 16, kv_quant=mode,
+                                    dtype_bytes=4)
+        assert 2 * pool.nbytes == per_block * shape[1], mode
+    with pytest.raises(NotImplementedError):
+        make_kv_pool(shape, "fp8", jnp.float32)
+    with pytest.raises(ValueError):
+        make_kv_pool(shape, "int4", jnp.float32)
+
+
+def test_quantize_decode_params_structure_and_dispatch(qwen3):
+    params, cfg = qwen3
+    qp = quantize_decode_params(params)
+    layers = qp["layers"]
+    for name in DECODE_QUANT_KEYS & set(layers):
+        assert isinstance(layers[name], QuantizedWeight), name
+        assert layers[name].data.dtype == jnp.int8
+        # scale keeps the leading layer axis so lax.scan slices both
+        assert layers[name].scale.shape[0] == layers[name].data.shape[0]
+    # everything outside the eligible set is the SAME object — embeddings,
+    # norms, biases and the lm head stay full-width, bit-identical
+    for name, w in params["layers"].items():
+        if name not in DECODE_QUANT_KEYS or isinstance(w, dict):
+            assert qp["layers"][name] is w, name
+    assert qp["embed_tokens"] is params["embed_tokens"]
+    assert qp["norm"] is params["norm"]
+    # type-based registry dispatch: dense -> xla, QuantizedWeight -> xla_q8,
+    # and the q8 product stays within the per-channel rounding envelope
+    w = params["layers"]["q_proj"]
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(3, w.shape[1])),
+                    jnp.float32)
+    dense = decode_dot(x, w[0])
+    quant = decode_dot(x[None], quantize_decode_params(params)["layers"]
+                       ["q_proj"][0])[0]
+    assert np.allclose(np.asarray(dense), np.asarray(quant),
+                       atol=0.05, rtol=0.05)
+
+
+def test_moe_experts_and_shared_experts_stay_unquantized():
+    cfg = TransformerConfig(dtype=jnp.float32, **QWEN3_MOE)
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_decode_params(params)
+    for seg in ("layers", "dense_layers"):
+        tree = params.get(seg)
+        if not isinstance(tree, dict):
+            continue
+        for name, w in tree.items():
+            if isinstance(w, dict) or getattr(w, "ndim", 0) != 3:
+                # expert stacks (4-D, grouped-GEMM) and nested subtrees
+                # (shared_experts) pass through untouched
+                assert qp[seg][name] is w, (seg, name)
+
+
+# ------------------------------------------------------------- config surface
+def test_engine_config_validation_and_fp8_scaffold(qwen3):
+    params, cfg = qwen3
+    with pytest.raises(ValueError, match="kv_quant"):
+        EngineConfig(kv_quant="int4")
+    with pytest.raises(ValueError, match="weight_quant"):
+        EngineConfig(weight_quant="fp8")
+    # fp8 KV is a declared-but-unshipped storage mode: the config accepts
+    # it, the pool allocation refuses it loudly at engine construction
+    with pytest.raises(NotImplementedError, match="fp8"):
+        InferenceEngine(params, cfg, EngineConfig(
+            num_slots=2, block_size=8, max_model_len=64, kv_quant="fp8",
+        ))
+
+
+# ------------------------------------------------------------- quality gate
+@pytest.mark.parametrize("kv_quant,weight_quant", MODES)
+def test_quality_gate_bounds(qwen3, kv_quant, weight_quant):
+    """The shipping gate: fixed-seed teacher-forced perplexity delta and
+    top-k overlap vs the f32 path, through the REAL paged serving path."""
+    params, cfg = qwen3
+    stats = assert_quality_gate(params, cfg, kv_quant=kv_quant,
+                                weight_quant=weight_quant, block_size=8)
+    assert stats["ppl_ref"] > 0 and stats["ppl_quant"] > 0
+    assert stats["ppl_rel_delta"] <= PPL_REL_DELTA_BOUND
+    assert stats["topk_overlap"] >= TOPK_OVERLAP_BOUND
+
+
+def test_quality_gate_catches_scale_corruption(qwen3):
+    """The gate is not a rubber stamp: inflating one projection's stored
+    scales (a wrong-axis / wrong-constant quantization bug) must blow
+    through the bounds it certifies the real modes against."""
+    from veomni_tpu.serving import quality
+
+    params, cfg = qwen3
+    qp = quantize_decode_params(params)
+    broken = dict(qp, layers=dict(
+        qp["layers"],
+        down_proj=QuantizedWeight(qp["layers"]["down_proj"].data,
+                                  qp["layers"]["down_proj"].scale * 4.0),
+    ))
+    corpus = quality.fixed_corpus(cfg.vocab_size)
+    nll_ref, nll_bad, overlaps = [], [], []
+    for toks in corpus:
+        ref = quality.teacher_forced_logits(params, cfg, toks, block_size=8)
+        bad = quality.teacher_forced_logits(broken, cfg, toks, block_size=8)
+        nll_ref.append(np.log(quality._ppl(ref, toks)))
+        nll_bad.append(np.log(quality._ppl(bad, toks)))
+        overlaps.append(quality._topk_overlap(ref, bad, 8))
+    ppl_ref = float(np.exp(np.mean(nll_ref)))
+    ppl_bad = float(np.exp(np.mean(nll_bad)))
+    delta = abs(ppl_bad - ppl_ref) / ppl_ref
+    # a 4x scale blowup on one projection must trip at least one bound
+    assert (delta > PPL_REL_DELTA_BOUND
+            or float(np.mean(overlaps)) < TOPK_OVERLAP_BOUND), (
+        delta, float(np.mean(overlaps)))
+
+
+# ------------------------------------------------------------- drill matrix
+@pytest.mark.parametrize("kv_quant,weight_quant", MODES)
+def test_quant_engine_staggered_identities(qwen3, kv_quant, weight_quant):
+    """Staggered arrivals + prefix cache + chunked prefill through a
+    quantized engine: full token counts, deterministic replay (fresh engine,
+    same config -> bit-identical streams), exact no-leak identities."""
+    params, cfg = qwen3
+    prompts = _prompts((5, 9, 17, 12), seed=0)
+    ec = dict(num_slots=2, block_size=8, max_model_len=64,
+              prefix_cache=True, prefill_chunk=8,
+              kv_quant=kv_quant, weight_quant=weight_quant)
+    eng, toks = _drill(params, cfg, prompts, **ec)
+    assert all(len(t) == 6 for t in toks)
+    assert all(0 <= x < cfg.vocab_size for t in toks for x in t)
+    _assert_no_leak(eng)
+    # determinism: quantize-on-write is a pure function of the written rows
+    eng2, toks2 = _drill(params, cfg, prompts, **ec)
+    assert toks == toks2
+    _assert_no_leak(eng2)
+
+
+def test_quant_engine_preemption_recompute_self_parity(qwen3):
+    """A pool too small for the load forces preemption; recompute through
+    quantized blocks must resume every stream exactly where a roomy
+    quantized engine would have taken it — rounding is deterministic, so
+    recompute parity is still an EXACT identity, not a gated one."""
+    params, cfg = qwen3
+    prompts = _prompts((9, 11, 7), seed=1)
+    roomy = InferenceEngine(params, cfg, EngineConfig(
+        num_slots=3, block_size=8, max_model_len=40, kv_quant="int8",
+    ))
+    want = {}
+    for p in prompts:
+        rid = roomy.submit(Request(prompt_ids=p,
+                                   sampling=SamplingParams(max_new_tokens=10)))
+        want[tuple(p)] = roomy.run()[rid].token_ids
+    tight = InferenceEngine(params, cfg, EngineConfig(
+        num_slots=3, block_size=8, max_model_len=40, num_blocks=8,
+        kv_quant="int8",
+    ))
+    ids = [tight.submit(Request(prompt_ids=p,
+                                sampling=SamplingParams(max_new_tokens=10)))
+           for p in prompts]
+    outs = tight.run()
+    assert tight.scheduler.preemption_count > 0
+    for rid, p in zip(ids, prompts):
+        assert outs[rid].token_ids == want[tuple(p)]
+    _assert_no_leak(tight)
+
+
+def test_quant_engine_cow_mid_block_isolation(qwen3):
+    """CoW divergence on quantized blocks: the private copy is bit-exact
+    (never re-quantized), the shared cached block is never corrupted, and
+    the cache accounting matches the f32 engine's exactly."""
+    params, cfg = qwen3
+    rng = np.random.default_rng(12)
+    base = [int(t) for t in rng.integers(1, cfg.vocab_size, 16)]
+    diverged = base[:12] + [int(t) for t in rng.integers(1, 128, 4)]
+    ec = EngineConfig(num_slots=2, block_size=8, max_model_len=64,
+                      prefix_cache=True, kv_quant="int8")
+    eng = InferenceEngine(params, cfg, ec)
+    r1 = eng.submit(Request(prompt_ids=base,
+                            sampling=SamplingParams(max_new_tokens=5)))
+    first = eng.run()[r1].token_ids
+    assert eng.blocks.cow_count == 0
+    r2 = eng.submit(Request(prompt_ids=base,
+                            sampling=SamplingParams(max_new_tokens=5)))
+    r3 = eng.submit(Request(prompt_ids=diverged,
+                            sampling=SamplingParams(max_new_tokens=5)))
+    outs = eng.run()
+    assert eng.blocks.cow_count == 1
+    assert outs[r2].cached_tokens == 15  # P-1, same as the f32 engine
+    assert outs[r3].cached_tokens == 8
+    # cached replay == fresh computation: the CoW'd quantized block holds
+    # exactly what a fresh prefill would have written
+    assert outs[r2].token_ids == first
+    # and a third replay still matches (the shared block is uncorrupted)
+    r4 = eng.submit(Request(prompt_ids=base,
+                            sampling=SamplingParams(max_new_tokens=5)))
+    assert eng.run()[r4].token_ids == first
+    fresh = InferenceEngine(params, cfg, ec)
+    rd = fresh.submit(Request(prompt_ids=diverged,
+                              sampling=SamplingParams(max_new_tokens=5)))
+    assert fresh.run()[rd].token_ids == outs[r3].token_ids
+    _assert_no_leak(eng)
+
+
+def test_quant_engine_spec_decode_rollback_self_parity(qwen3):
+    """Draft-then-verify over quantized blocks: the verify step scores
+    against the same quantized rows the one-token path writes, so spec
+    decoding stays EXACTLY lossless vs the non-spec quantized engine —
+    including across rollback — and rollback leaves no block behind."""
+    params, cfg = qwen3
+    prompts = _prompts((9, 13, 5), seed=2)
+    base_ec = dict(num_slots=2, block_size=8, max_model_len=64,
+                   prefix_cache=True, kv_quant="int8", weight_quant="int8")
+    plain = InferenceEngine(params, cfg, EngineConfig(**base_ec))
+    want = {}
+    for p in prompts:
+        rid = plain.submit(Request(prompt_ids=p,
+                                   sampling=SamplingParams(max_new_tokens=8)))
+        want[tuple(p)] = plain.run()[rid].token_ids
+    spec = InferenceEngine(params, cfg, EngineConfig(
+        spec_k=3, spec_draft="ngram", **base_ec,
+    ))
+    ids = [spec.submit(Request(prompt_ids=p,
+                               sampling=SamplingParams(max_new_tokens=8)))
+           for p in prompts]
+    outs = spec.run()
+    for rid, p in zip(ids, prompts):
+        assert outs[rid].token_ids == want[tuple(p)]
+    m = spec.metrics()
+    assert m["spec_proposed"] > 0  # the draft path actually engaged
+    _assert_no_leak(spec)
+
+
+@pytest.mark.parametrize("spec", ["gpt_oss_ish", "qwen3_moe"])
+def test_quant_engine_dialect_identities_and_gate(spec):
+    """The dialect extremes (sinks + alternating sliding windows; MoE MLP
+    segments with unquantized expert stacks) through the fully quantized
+    engine: deterministic replay, no-leak identities, quality gate green."""
+    conf = {"gpt_oss_ish": GPT_OSS_ISH, "qwen3_moe": QWEN3_MOE}[spec]
+    cfg = TransformerConfig(dtype=jnp.float32, **conf)
+    model = build_foundation_model(config=cfg)
+    params = model.family.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = _prompts((9, 13, 5, 11), seed=6)
+    ec = dict(num_slots=2, block_size=8, max_model_len=64,
+              prefix_cache=True, prefill_chunk=8,
+              kv_quant="int8", weight_quant="int8")
+    eng, toks = _drill(params, cfg, prompts, **ec)
+    eng2, toks2 = _drill(params, cfg, prompts, **ec)
+    assert toks == toks2 and all(len(t) == 6 for t in toks)
+    _assert_no_leak(eng)
+    _assert_no_leak(eng2)
+    assert_quality_gate(params, cfg, kv_quant="int8", weight_quant="int8",
+                        block_size=8)
+
+
+# ---------------------------------------------------------- compile counting
+def test_quant_engine_compile_count_bounded(qwen3):
+    """The q8 gather-attend is one more program per bucket, not per
+    request: the quantized engine's decode compiles stay inside the same
+    table-width bucket bound as f32, and re-running inside known buckets
+    adds ZERO compiles."""
+    params, cfg = qwen3
+    eng = InferenceEngine(params, cfg, EngineConfig(
+        num_slots=2, block_size=8, max_model_len=64,
+        kv_quant="int8", weight_quant="int8",
+    ))
+    base = dict(decode_mod.TRACE_COUNTS)
+    first = _prompts((5, 9, 17, 21, 33, 7), seed=3)
+    eng.run([Request(prompt_ids=p, sampling=SamplingParams(max_new_tokens=5))
+             for p in first])
+    delta = decode_mod.TRACE_COUNTS["paged_decode"] - base["paged_decode"]
+    assert 1 <= delta <= 4, delta  # table-width buckets {1,2,4,8}
+    mid = dict(decode_mod.TRACE_COUNTS)
+    more = _prompts((6, 10, 18, 22, 34, 8, 12, 30), seed=4)
+    eng.run([Request(prompt_ids=p, sampling=SamplingParams(max_new_tokens=5))
+             for p in more])
+    assert decode_mod.TRACE_COUNTS["paged_decode"] == mid["paged_decode"]
+    _assert_no_leak(eng)
+
+
+# ------------------------------------------------------------- capacity claim
+def test_quant_capacity_ratio_at_fixed_pool_bytes(qwen3):
+    """The headline: at the f32 pool's exact byte budget, int8 blocks fit
+    >= 1.8x the max-length sequences — computed from the LIVE pools'
+    nbytes (payload + sidecar) via the same devmem gauges scripts/serve.py
+    exports, never from f32 math."""
+    params, cfg = qwen3
+    ec = dict(num_slots=2, block_size=8, max_model_len=64)
+    f32 = InferenceEngine(params, cfg, EngineConfig(**ec))
+    q8 = InferenceEngine(params, cfg, EngineConfig(kv_quant="int8", **ec))
+    cap_f, cap_q = f32.kv_capacity(), q8.kv_capacity()
+    # the gauges report the ACTUAL quantized footprint
+    assert cap_q["pool_bytes"] == q8.k_pool.nbytes + q8.v_pool.nbytes
+    assert cap_q["block_bytes"] < cap_f["block_bytes"]
+    # and agree with the pre-allocation sizing primitive
+    assert cap_q["block_bytes"] == kv_block_nbytes(
+        cfg.num_hidden_layers, 8, cfg.num_key_value_heads, cfg.head_dim,
+        kv_quant="int8")
+    per_seq = cap_f["blocks_per_max_len_seq"]
+    q_blocks_in_f32_budget = cap_f["pool_bytes"] // cap_q["block_bytes"]
+    q_seqs = (q_blocks_in_f32_budget - 1) // per_seq  # block 0 reserved
+    ratio = q_seqs / max(1.0, cap_f["max_concurrent_seqs"])
+    assert ratio >= 1.8, (ratio, cap_f, cap_q)

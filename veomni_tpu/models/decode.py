@@ -54,6 +54,20 @@ def supports_cached_decode(cfg) -> bool:
     return True
 
 
+def _compute_cast(params, cfg: TransformerConfig):
+    """Cast the param tree to the compute dtype, passing int8
+    :class:`~veomni_tpu.ops.QuantizedWeight` leaves through untouched — a
+    blind ``astype`` would silently widen the int8 payload back to the
+    compute dtype and forfeit both the storage win and the registry
+    dispatch (``decode_matmul/xla_q8`` dequantizes in-kernel instead)."""
+    qw = ops.QuantizedWeight
+    return jax.tree.map(
+        lambda p: p if isinstance(p, qw) else p.astype(cfg.dtype),
+        params,
+        is_leaf=lambda x: isinstance(x, qw),
+    )
+
+
 def _rope_tables(cfg: TransformerConfig, positions: jax.Array):
     """(cos_g, sin_g, cos_l, sin_l) for global + (optional) local rope."""
     rope_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
@@ -71,9 +85,9 @@ def _rope_tables(cfg: TransformerConfig, positions: jax.Array):
 def _qkv(x, lp, cfg: TransformerConfig, cos, sin):
     """x [B,T,H] -> q [B,T,hq,d], k/v [B,T,hkv,d] with norms + rope applied."""
     b, t, _ = x.shape
-    q = jnp.dot(x, lp["q_proj"])
-    k = jnp.dot(x, lp["k_proj"])
-    v = jnp.dot(x, lp["v_proj"])
+    q = ops.decode_dot(x, lp["q_proj"])
+    k = ops.decode_dot(x, lp["k_proj"])
+    v = ops.decode_dot(x, lp["v_proj"])
     if cfg.attention_bias:
         q, k, v = q + lp["q_bias"], k + lp["k_bias"], v + lp["v_bias"]
     q = q.reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
@@ -119,11 +133,11 @@ def _mlp(x, lp, cfg: TransformerConfig, is_moe: bool):
         b, t, h = x.shape
         out, _ = _moe_mlp(x.reshape(b * t, h), lp, cfg)
         return out.reshape(b, t, h)
-    gate = jnp.dot(x, lp["gate_proj"])
-    up = jnp.dot(x, lp["up_proj"])
+    gate = ops.decode_dot(x, lp["gate_proj"])
+    up = ops.decode_dot(x, lp["up_proj"])
     if cfg.mlp_bias:
         gate, up = gate + lp["gate_bias"], up + lp["up_bias"]
-    o = jnp.dot(gated_act(gate, up, cfg), lp["down_proj"])
+    o = ops.decode_dot(gated_act(gate, up, cfg), lp["down_proj"])
     if cfg.mlp_bias:
         o = o + lp["down_bias"]
     return o
@@ -133,7 +147,7 @@ def _layer_tail(hidden, attn, lp, cfg: TransformerConfig, is_moe):
     """Everything after attention (o_proj + residual + FFN), shared by the
     contiguous and paged layer variants."""
     b, t, _, _ = attn.shape
-    out = jnp.dot(attn.reshape(b, t, cfg.q_dim), lp["o_proj"])
+    out = ops.decode_dot(attn.reshape(b, t, cfg.q_dim), lp["o_proj"])
     if "o_bias" in lp:
         out = out + lp["o_bias"]
     if cfg.sandwich_norms:
@@ -437,7 +451,7 @@ def paged_prefill_step(params, cfg: TransformerConfig, pools, block_table,
     via the block table. Returns (logits of the last real chunk row
     [1,V] f32, pools); intermediate chunks ignore the logits, the final
     chunk's sample the first generated token."""
-    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    compute = _compute_cast(params, cfg)
     positions = start_pos + jnp.arange(chunk_bucket, dtype=jnp.int32)
     cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions[None])
     hidden = compute["embed_tokens"][tokens[None]]
@@ -473,7 +487,7 @@ def paged_decode_step(params, cfg: TransformerConfig, pools, block_tables,
     with the null block 0. Returns (logits [S,V] f32, pools). The serving
     engine jits this with the pools donated; the gathered-context width
     nb*BS is the compile bucket."""
-    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    compute = _compute_cast(params, cfg)
     positions_2d = positions[:, None]
     cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, positions_2d)
     hidden = compute["embed_tokens"][tokens[:, None]]
@@ -500,7 +514,7 @@ def paged_verify_step(params, cfg: TransformerConfig, pools, block_tables,
     bit-for-bit the distribution the one-token path would have produced.
     The serving engine jits this with the pools donated; (KB, gathered
     context width) are the compile buckets."""
-    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    compute = _compute_cast(params, cfg)
     kb = tokens.shape[1]
     pos_rows = positions[:, None] + jnp.arange(kb, dtype=jnp.int32)[None, :]
     cos_g, sin_g, cos_l, sin_l = _rope_tables(cfg, pos_rows)
@@ -600,7 +614,7 @@ def _prefill_impl(params, cfg: TransformerConfig, tokens, prompt_len,
     causal masking hides a cache row from every query at position < row, and
     the decode loop overwrites row ``pos`` at step ``pos`` BEFORE attending
     to it, so a garbage row is never visible to any real query."""
-    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    compute = _compute_cast(params, cfg)
     b = tokens.shape[0]
     hd, hkv = cfg.head_dim, cfg.num_key_value_heads
     L = cfg.num_hidden_layers
@@ -699,7 +713,7 @@ def _decode_impl(params, cfg: TransformerConfig, caches, first_token,
     """Scan decode: emit n_steps tokens starting from first_token at
     start_pos (the prompt length). Greedy when temperature<=0, else
     temperature/top-k/top-p sampling with a PRNG carry."""
-    compute = jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    compute = _compute_cast(params, cfg)
     max_len = caches[0].shape[2]
     kpos = jnp.arange(max_len)[None, None]
 

@@ -160,12 +160,18 @@ def kv_capacity_stats(blocks, k_pool=None, v_pool=None,
     """Block-pool capacity in operator units.
 
     ``blocks`` is a :class:`~veomni_tpu.serving.kv_block_manager.
-    KVBlockManager``; ``k_pool``/``v_pool`` (optional device arrays) size
-    the byte figures. ``max_concurrent_seqs`` is the estimated ceiling on
-    simultaneously-resident sequences, assuming each grows to
-    ``max_model_len`` — the capacity-planning number ("how many users fit
-    in HBM"); ``free_concurrent_seqs`` is the same estimate over the
-    currently free (+ evictable cached) blocks."""
+    KVBlockManager``; ``k_pool``/``v_pool`` (optional device arrays OR
+    quantized :class:`~veomni_tpu.ops.quantization.QuantizedKV` pools) size
+    the byte figures through their ``nbytes`` — a quantized pool reports
+    its ACTUAL footprint (int8 payload + f32 scale sidecar), so every
+    derived gauge (``serve.kv_pool_bytes``, ``serve.kv_block_bytes``) shows
+    the real capacity win, never f32 math. ``max_concurrent_seqs`` is the
+    estimated ceiling on simultaneously-resident sequences, assuming each
+    grows to ``max_model_len`` — the capacity-planning number ("how many
+    users fit in HBM"); ``free_concurrent_seqs`` is the same estimate over
+    the currently free (+ evictable cached) blocks. For sizing a pool to a
+    byte budget BEFORE allocating it, use
+    :func:`veomni_tpu.ops.quantization.kv_block_nbytes`."""
     pool_bytes = 0.0
     for p in (k_pool, v_pool):
         if p is not None:

@@ -15,6 +15,7 @@ from veomni_tpu.ops import cross_entropy as _cross_entropy  # noqa: F401
 from veomni_tpu.ops import load_balancing as _load_balancing  # noqa: F401
 from veomni_tpu.ops import group_gemm as _group_gemm  # noqa: F401
 from veomni_tpu.ops import paged_attention as _paged_attention  # noqa: F401
+from veomni_tpu.ops import quantization as _quantization  # noqa: F401
 from veomni_tpu.ops import pallas as _pallas  # noqa: F401  (registers TPU kernels)
 
 rms_norm = _rms_norm.rms_norm
@@ -28,8 +29,18 @@ load_balancing_loss = _load_balancing.load_balancing_loss
 group_gemm = _group_gemm.group_gemm
 cache_attend = _paged_attention.cache_attend
 gather_block_kv = _paged_attention.gather_block_kv
+gather_block_kv_q8 = _paged_attention.gather_block_kv_q8
 paged_attend = _paged_attention.paged_attend
 paged_prefill_attend = _paged_attention.paged_prefill_attend
+QuantizedKV = _quantization.QuantizedKV
+QuantizedWeight = _quantization.QuantizedWeight
+quantize_rows = _quantization.quantize_rows
+dequantize_rows = _quantization.dequantize_rows
+quantize_weight = _quantization.quantize_weight
+quantize_decode_params = _quantization.quantize_decode_params
+make_kv_pool = _quantization.make_kv_pool
+kv_block_nbytes = _quantization.kv_block_nbytes
+decode_dot = _quantization.decode_dot
 
 __all__ = [
     "KERNEL_REGISTRY",
@@ -46,6 +57,16 @@ __all__ = [
     "group_gemm",
     "cache_attend",
     "gather_block_kv",
+    "gather_block_kv_q8",
     "paged_attend",
     "paged_prefill_attend",
+    "QuantizedKV",
+    "QuantizedWeight",
+    "quantize_rows",
+    "dequantize_rows",
+    "quantize_weight",
+    "quantize_decode_params",
+    "make_kv_pool",
+    "kv_block_nbytes",
+    "decode_dot",
 ]

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from veomni_tpu.ops.kernel_registry import KERNEL_REGISTRY, resolve_op
+from veomni_tpu.ops.quantization import QuantizedKV
 
 
 def cache_attend(
@@ -82,6 +83,24 @@ def gather_block_kv(k_pool, v_pool, block_tables):
     return k, v
 
 
+def gather_block_kv_q8(k_pool, v_pool, block_tables, dtype):
+    """Quantized-pool variant of :func:`gather_block_kv`: gather the int8
+    payload and the f32 scale sidecar through the block table FIRST (a
+    quarter of the bytes a dense gather moves), then dequantize the
+    gathered context. Padding-entry rows dequantize to garbage exactly as
+    the dense path gathers garbage — the caller's valid mask hides them."""
+    nb_, bs, hkv, d = k_pool.shape
+    s, nb = block_tables.shape
+
+    def one(pool):
+        data = pool.data[block_tables]          # [S, nb, BS, hkv, d] int8
+        scale = pool.scale[block_tables]        # [S, nb, BS, hkv] f32
+        ctx = data.astype(jnp.float32) * scale[..., None]
+        return ctx.astype(dtype).reshape(s, nb * bs, hkv, d)
+
+    return one(k_pool), one(v_pool)
+
+
 @KERNEL_REGISTRY.register("paged_attention", "xla_gather")
 def _paged_attend_xla(
     q,
@@ -100,6 +119,39 @@ def _paged_attend_xla(
     )
 
 
+@KERNEL_REGISTRY.register("paged_attention", "xla_gather_q8")
+def _paged_attend_xla_q8(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    valid_mask,
+    *,
+    num_rep: int = 1,
+    scale: float,
+    sinks: Optional[jax.Array] = None,
+):
+    """int8-KV decode/verify attention: gathered-dequantize, then the SAME
+    ``cache_attend`` softmax as ``xla_gather`` — the only non-bit-exactness
+    is the int8 rounding on the cache rows themselves."""
+    k_ctx, v_ctx = gather_block_kv_q8(k_pool, v_pool, block_tables, q.dtype)
+    return cache_attend(
+        q, k_ctx, v_ctx, valid_mask, num_rep=num_rep, scale=scale, sinks=sinks
+    )
+
+
+def _resolve_paged(op: str, k_pool):
+    """Storage-aware dispatch for the paged-attention ops: an ops-config pin
+    wins unconditionally (same precedence as every other op — the operator
+    pinning a dense impl against a quantized pool is an error at their
+    door), otherwise the POOL TYPE selects the impl: a ``QuantizedKV`` pool
+    takes the ``xla_gather_q8`` impl, a dense pool the normal
+    priority-resolved one."""
+    if KERNEL_REGISTRY.pinned(op) is None and isinstance(k_pool, QuantizedKV):
+        return KERNEL_REGISTRY.impls(op)["xla_gather_q8"].fn
+    return resolve_op(op)
+
+
 def paged_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
                  num_rep: int = 1, scale: float,
                  sinks: Optional[jax.Array] = None):
@@ -108,7 +160,7 @@ def paged_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
     positions. T is 1 for the plain decode step and KB (committed token +
     drafted continuation) for the speculative verify step — the math is
     identical per query row, so the two paths can never drift."""
-    inner = resolve_op("paged_attention")
+    inner = _resolve_paged("paged_attention", k_pool)
     return inner(
         q, k_pool, v_pool, block_tables, valid_mask,
         num_rep=num_rep, scale=scale, sinks=sinks,
@@ -133,6 +185,28 @@ def _paged_prefill_attend_xla(
     )
 
 
+@KERNEL_REGISTRY.register("paged_prefill_attention", "xla_gather_q8")
+def _paged_prefill_attend_xla_q8(
+    q,
+    k_pool,
+    v_pool,
+    block_tables,
+    valid_mask,
+    *,
+    num_rep: int = 1,
+    scale: float,
+    sinks: Optional[jax.Array] = None,
+):
+    """int8-KV chunked-prefill attention: each chunk row attends over the
+    dequantized gathered context — including the chunk's OWN rows, which
+    were quantized on the scatter that preceded this attend, so chunked and
+    monolithic prefill see the identical (rounded) cache."""
+    k_ctx, v_ctx = gather_block_kv_q8(k_pool, v_pool, block_tables, q.dtype)
+    return cache_attend(
+        q, k_ctx, v_ctx, valid_mask, num_rep=num_rep, scale=scale, sinks=sinks
+    )
+
+
 def paged_prefill_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
                          num_rep: int = 1, scale: float,
                          sinks: Optional[jax.Array] = None):
@@ -146,7 +220,7 @@ def paged_prefill_attend(q, k_pool, v_pool, block_tables, valid_mask, *,
     identical to a monolithic prefill over the same context. Registered as
     its own op (impl ``xla_gather``) so a fused Pallas prefill kernel can
     later replace the gather without touching the decode op's pin."""
-    inner = resolve_op("paged_prefill_attention")
+    inner = _resolve_paged("paged_prefill_attention", k_pool)
     return inner(
         q, k_pool, v_pool, block_tables, valid_mask,
         num_rep=num_rep, scale=scale, sinks=sinks,

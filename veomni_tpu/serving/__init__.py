@@ -9,8 +9,10 @@ load-shedding, class-aware preemption, cache-aware) -> ``spec_decode``
 (host-side draft strategies for speculative decoding,
 registry-dispatched) -> ``engine`` (jitted chunked prefill over cached
 prefixes + batched paged decode, one-token or draft-then-verify;
-deadline expiry + goodput accounting). See ``docs/serving.md`` for the
-architecture, the QoS/overload semantics, and the compile-count story.
+deadline expiry + goodput accounting) -> ``quality`` (fixed-seed
+perplexity/top-k gate certifying the non-bit-exact quantized tier). See
+``docs/serving.md`` for the architecture, the QoS/overload semantics,
+the quantized serving tier, and the compile-count story.
 """
 
 from veomni_tpu.serving import spec_decode  # registers the spec_draft op
@@ -22,6 +24,7 @@ from veomni_tpu.serving.api import (
 )
 from veomni_tpu.serving.engine import EngineConfig, InferenceEngine
 from veomni_tpu.serving.kv_block_manager import KVBlockManager
+from veomni_tpu.serving.quality import fixed_corpus, quality_stats
 from veomni_tpu.serving.prefix_cache import PrefixCache
 from veomni_tpu.serving.scheduler import (
     DEFAULT_CLASSES,
@@ -33,7 +36,9 @@ from veomni_tpu.serving.scheduler import (
 __all__ = [
     "DEFAULT_CLASSES",
     "EngineConfig",
+    "fixed_corpus",
     "parse_classes",
+    "quality_stats",
     "InferenceEngine",
     "KVBlockManager",
     "PrefixCache",

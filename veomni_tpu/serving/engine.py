@@ -67,6 +67,7 @@ import numpy as np
 
 from veomni_tpu.models import decode as decode_mod
 from veomni_tpu.models.config import TransformerConfig
+from veomni_tpu.ops.quantization import make_kv_pool, quantize_decode_params
 from veomni_tpu.models.decode import supports_cached_decode
 from veomni_tpu.observability.metrics import get_registry
 from veomni_tpu.observability.request_trace import RequestTracer
@@ -127,6 +128,21 @@ class EngineConfig:
     queue_bound: int = 0
     # per-tenant cap on waiting+running requests. 0 = uncapped (seed).
     tenant_max_inflight: int = 0
+    # KV-cache block storage mode: "none" keeps the dense compute-dtype
+    # pool (bit-identical to the seed engine); "int8" stores blocks as an
+    # int8 payload + per-(layer, block, row, kv-head) f32 scale sidecar —
+    # ~4x the concurrent sequences per pool byte at f32, dequantized inside
+    # the gathered attend (`paged_attention/xla_gather_q8`). "fp8" is
+    # scaffolded behind the same interface but not yet shipped. Non-"none"
+    # modes are NOT bit-exact: they ship under the fixed-seed quality gate
+    # (serving/quality.py; docs/serving.md "Quantized serving tier").
+    kv_quant: str = "none"
+    # decode-path weight storage: "int8" stores the dense q/k/v/o and
+    # gate/up/down projections as int8 + per-output-channel f32 scales,
+    # dequantized in-kernel through the `decode_matmul/xla_q8` registry
+    # impl. Embeddings, norms, biases, the lm head, routers and the MoE
+    # expert stacks stay full-width.
+    weight_quant: str = "none"
     # serving-side recompile detection: after this many step() ticks the
     # decode/prefill TRACE_COUNTS baselines are armed, and any later bucket
     # growth emits the trainer's loud rank-0 RECOMPILE warning + the
@@ -148,6 +164,16 @@ class EngineConfig:
         if self.tenant_max_inflight < 0:
             raise ValueError(
                 "tenant_max_inflight must be >= 0 (0 = uncapped)"
+            )
+        if self.kv_quant not in ("none", "int8", "fp8"):
+            raise ValueError(
+                f"kv_quant must be 'none', 'int8' or 'fp8', got "
+                f"{self.kv_quant!r}"
+            )
+        if self.weight_quant not in ("none", "int8"):
+            raise ValueError(
+                f"weight_quant must be 'none' or 'int8', got "
+                f"{self.weight_quant!r}"
             )
         # malformed class specs fail at construction, not mid-serve
         parse_classes(self.classes)
@@ -179,16 +205,26 @@ class InferenceEngine:
                 f"config {cfg.model_type!r} has no cached-decode path; the "
                 "serving engine requires supports_cached_decode(cfg)"
             )
-        self.params = params
         self.cfg = cfg
         self.config = config or EngineConfig()
         ec = self.config
+        # int8 decode weights are quantized ONCE at construction; the jitted
+        # steps receive the QuantizedWeight leaves and dispatch the
+        # decode-path matmuls through decode_matmul/xla_q8 (dequantizing
+        # in-kernel). weight_quant="none" keeps the params bit-identical.
+        self.params = (
+            quantize_decode_params(params) if ec.weight_quant == "int8"
+            else params
+        )
 
         L = cfg.num_hidden_layers
         shape = (L, ec.num_blocks, ec.block_size, cfg.num_key_value_heads,
                  cfg.head_dim)
-        self.k_pool = jnp.zeros(shape, cfg.dtype)
-        self.v_pool = jnp.zeros(shape, cfg.dtype)
+        # kv_quant="int8" allocates QuantizedKV pools (int8 payload + f32
+        # scale sidecar) behind the same pytree surface; every jitted step,
+        # the CoW copy and the prefill scatter thread them unchanged
+        self.k_pool = make_kv_pool(shape, ec.kv_quant, cfg.dtype)
+        self.v_pool = make_kv_pool(shape, ec.kv_quant, cfg.dtype)
         self.blocks = KVBlockManager(ec.num_blocks, ec.block_size)
         self.prefix_cache = (
             PrefixCache(self.blocks) if ec.prefix_cache else None
