@@ -806,6 +806,10 @@ def run_serve_open_loop_bench(
     seed: int = 0,
     kv_quant: str = "",
     weight_quant: str = "none",
+    shared_prefix: int = 0,
+    shared_prefix_groups: int = 1,
+    replicas: int = 1,
+    replica_kill_at_s: float = 0.0,
     _model=None,
 ) -> dict:
     """Open-loop Poisson overload bench: arrivals fire on a fixed schedule
@@ -833,6 +837,25 @@ def run_serve_open_loop_bench(
     budget (more, smaller blocks), the same Poisson arrivals replay at
     the same rates, and each ``kvq_sweep`` entry carries the
     goodput-under-overload and reject-rate deltas vs the f32 leg.
+
+    ``replicas`` (BENCH_SERVE_REPLICAS, N > 1) adds a scale-out leg: the
+    SAME Poisson storms replay at the SAME swept rates against the
+    prefix-affinity router over N data-parallel engine replicas (compiled
+    programs shared — one warmup covers the fleet). Each ``router_sweep``
+    entry carries the aggregate and per-replica goodput, the router's
+    prefix hit rate vs the single-engine leg's (affinity should keep
+    shared-prefix traffic at least as warm as one engine sees), and
+    ``goodput_scaling`` — aggregate goodput over the single-engine leg's
+    at the identical rate: past single-engine capacity the fleet's extra
+    slots/KV/queue convert sheds and deadline misses back into goodput. ``shared_prefix`` prepends that many
+    common tokens to every prompt, drawn from ``shared_prefix_groups``
+    distinct prefixes (BENCH_SERVE_PREFIX_GROUPS; think N different
+    system prompts — the workload affinity routing exists for: each
+    group's KV warms exactly one replica instead of cold-missing on all
+    of them); ``replica_kill_at_s`` (BENCH_SERVE_REPLICA_KILL_AT_S) kills one
+    replica that many seconds into each router rate — the mid-storm
+    fault drill (survivors absorb re-dispatched work, the entry reports
+    ``redispatched``/``cancelled``).
 
     ``_model`` injects a prebuilt ``(params, cfg)`` (tier-1 CPU smoke uses
     a tiny model); by default the ``preset`` model is built fresh."""
@@ -869,11 +892,23 @@ def run_serve_open_loop_bench(
     class_names = [n for n, _ in parse_classes(classes)]
     hi_class, lo_class = class_names[0], class_names[-1]
 
+    # common leading chunks (think distinct system prompts): the
+    # shared-prefix workload the radix cache — and the router's affinity
+    # keying on top of it — exists for. 0 keeps fully random prompts.
+    prefixes = [
+        [int(t) for t in rng.integers(1, cfg.vocab_size, shared_prefix)]
+        for _ in range(max(1, shared_prefix_groups))
+    ]
+
     def make_requests(n):
         reqs = []
         for i in range(n):
             want = prompt_lens[i % len(prompt_lens)]
-            prompt = [int(t) for t in rng.integers(1, cfg.vocab_size, want)]
+            prefix = prefixes[int(rng.integers(0, len(prefixes)))]
+            fresh = max(1, want - len(prefix))
+            prompt = prefix + [
+                int(t) for t in rng.integers(1, cfg.vocab_size, fresh)
+            ]
             interactive = bool(rng.random() < interactive_frac)
             reqs.append(Request(
                 prompt_ids=prompt,
@@ -977,7 +1012,86 @@ def run_serve_open_loop_bench(
             "goodput_tok_s": (m1["goodput_tokens"] - m0["goodput_tokens"])
             / dt,
             "shed_tokens": m1["shed_tokens"] - m0["shed_tokens"],
+            "prefix_hit_rate": m1["prefix_hit_rate"],
         }
+
+    def run_rate_router(rate, n_replicas):
+        """Open-loop replay through the prefix-affinity router: the SAME
+        storm (identical protos, identical Poisson arrivals at the same
+        rate) that just hit one engine, now absorbed by N replicas — the
+        question an operator staring at a shedding single engine actually
+        asks. Past single-engine capacity the fleet's extra slots/KV/queue
+        convert sheds and deadline misses back into goodput. Optional
+        mid-storm replica kill."""
+        from veomni_tpu.serving import Router, RouterConfig
+
+        router = Router(params, cfg, engine_cfg(
+            queue_bound=queue_bound * n_replicas, classes=classes,
+        ), RouterConfig(replicas=n_replicas))
+        # compiled programs are SHARED across replicas: one warmup pass
+        # through the router compiles for the whole fleet
+        for r in warm:
+            router.run([Request(prompt_ids=r.prompt_ids, sampling=r.sampling,
+                                priority=r.priority)])
+        reqs = clone_requests(proto)
+        arng = np.random.default_rng((seed, int(rate * 1e6)))
+        arrivals = np.cumsum(arng.exponential(1.0 / rate, size=len(reqs)))
+        m0 = router.metrics()
+        ids = []
+        killed = ""
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(reqs) or router.has_work:
+            now = time.perf_counter() - t0
+            if (replica_kill_at_s > 0 and not killed
+                    and now >= replica_kill_at_s
+                    and len(router.live_replicas()) > 1):
+                killed = router.live_replicas()[0].rid
+                router.kill_replica(killed, reason="bench kill drill")
+            while i < len(reqs) and arrivals[i] <= now:
+                ids.append(router.submit(reqs[i]))
+                i += 1
+            if router.has_work:
+                router.step()
+            elif i < len(reqs):
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        dt = time.perf_counter() - t0
+        m1 = router.metrics(reset_window=False)
+        outs = {rid: router._outputs[rid] for rid in ids}
+        done = [o for o in outs.values()
+                if o.finish_reason in ("eos", "length")]
+        entry = {
+            "arrival_rate_rps": rate,
+            "replicas": n_replicas,
+            "completed": len(done),
+            "reject_rate": sum(
+                1 for o in outs.values() if o.finish_reason == "rejected"
+            ) / max(1, len(reqs)),
+            "cancelled": sum(1 for o in outs.values()
+                             if o.finish_reason == "cancelled"),
+            "redispatched": int(m1["redispatched"]),
+            "spills": int(m1["spills"]),
+            # aggregate goodput from the OUTPUTS (deadline-met tokens over
+            # the open-loop wall): a killed replica's engine totals leave
+            # the fleet aggregate mid-run, so the lifetime-delta trick the
+            # single-engine leg uses would undercount here
+            "goodput_tok_s": sum(
+                len(o.token_ids) for o in done if not o.deadline_missed
+            ) / dt,
+            # per-replica split from engine lifetime deltas (survivors
+            # only — a killed replica drops out of the census)
+            "per_replica_goodput_tok_s": {
+                rid: (m["goodput_tokens"]
+                      - m0["per_replica"].get(rid, {}).get(
+                          "goodput_tokens", 0.0)) / dt
+                for rid, m in m1["per_replica"].items()
+            },
+            "prefix_hit_rate": m1["prefix_hit_rate"],
+        }
+        if killed:
+            entry["replica_killed"] = killed
+            entry["replica_kill_at_s"] = replica_kill_at_s
+        return entry
 
     sweep = []
     for rate in rates:
@@ -1037,6 +1151,25 @@ def run_serve_open_loop_bench(
             "f32_num_blocks": int(f32_blocks),
             "kvq_sweep": q_sweep,
         })
+    if replicas > 1:
+        # scale-out leg: the same storm at the same rate, N replicas;
+        # goodput_scaling compares the fleet aggregate against the
+        # single-engine leg at the identical arrival rate
+        r_sweep = []
+        for base, rate in zip(sweep, rates):
+            entry = run_rate_router(rate, replicas)
+            entry["goodput_scaling"] = (
+                entry["goodput_tok_s"] / max(base["goodput_tok_s"], 1e-9)
+            )
+            entry["prefix_hit_rate_single"] = base["prefix_hit_rate"]
+            r_sweep.append(entry)
+            _beat(global_step=len(r_sweep), phase="serve_open_loop_router")
+        result.update({
+            "replicas": replicas,
+            "replica_kill_at_s": replica_kill_at_s,
+            "shared_prefix": shared_prefix,
+            "router_sweep": r_sweep,
+        })
     return result
 
 
@@ -1077,6 +1210,18 @@ def _serve_open_loop_main(preset: str, watchdog=None):
         # leg (optionally BENCH_SERVE_WEIGHT_QUANT=int8 for tier 2 too)
         kv_quant=os.environ.get("BENCH_SERVE_KV_QUANT", ""),
         weight_quant=os.environ.get("BENCH_SERVE_WEIGHT_QUANT", "none"),
+        # BENCH_SERVE_REPLICAS=N (N > 1) adds the scale-out router leg:
+        # same arrivals at N-scaled rates over N data-parallel replicas;
+        # BENCH_SERVE_REPLICA_KILL_AT_S kills one replica mid-storm and
+        # BENCH_SERVE_SHARED_PREFIX makes the traffic affinity-routable
+        shared_prefix=int(os.environ.get("BENCH_SERVE_SHARED_PREFIX", 0)),
+        shared_prefix_groups=int(
+            os.environ.get("BENCH_SERVE_PREFIX_GROUPS", 1)
+        ),
+        replicas=int(os.environ.get("BENCH_SERVE_REPLICAS", 1)),
+        replica_kill_at_s=float(
+            os.environ.get("BENCH_SERVE_REPLICA_KILL_AT_S", 0.0)
+        ),
     )
     if watchdog is not None:
         watchdog.stop()
@@ -1124,6 +1269,21 @@ def _serve_open_loop_main(preset: str, watchdog=None):
                 for entry in r["kvq_sweep"]
             ],
         } if "kv_quant" in r else {}),
+        # scale-out router leg when BENCH_SERVE_REPLICAS > 1: aggregate +
+        # per-replica goodput, goodput_scaling vs the single-engine leg,
+        # and the router-vs-single prefix hit rates
+        **({
+            "replicas": r["replicas"],
+            "shared_prefix": r["shared_prefix"],
+            "replica_kill_at_s": r["replica_kill_at_s"],
+            "router_sweep": [
+                {k: (round(v, 5) if isinstance(v, float) else
+                     {rk: round(rv, 5) for rk, rv in v.items()}
+                     if isinstance(v, dict) else v)
+                 for k, v in entry.items()}
+                for entry in r["router_sweep"]
+            ],
+        } if "router_sweep" in r else {}),
     }), flush=True)
     _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 

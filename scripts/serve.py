@@ -40,10 +40,17 @@ storage: `none` default | `int8` per-channel, dequantized in-kernel),
 VEOMNI_SERVE_CLASSES (QoS classes "name:weight,..." highest priority
 first; a single class restores plain FIFO), VEOMNI_SERVE_TENANT_INFLIGHT
 (per-tenant waiting+running cap, 0 = uncapped),
-VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD). VEOMNI_METRICS_PORT
+VEOMNI_SERVE_REPLICAS (``--replicas N``: N > 1 serves through the
+scale-out router — prefix-affinity dispatch over N data-parallel engine
+replicas sharing one compiled-program bundle, QoS admission at the
+router, per-replica ``serve.rK.*`` metrics and a per-replica status
+census in the final JSON; 1 = the bare engine, byte-identical to the
+seed driver), VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD).
+VEOMNI_METRICS_PORT
 serves Prometheus /metrics + /healthz while the pump runs (healthz carries
 rejected/deadline-miss counts); /debug/requests
-rows carry each request's cached_tokens, and /debug/fleet the collective
+rows carry each request's cached_tokens, /debug/router the router's
+replica census, and /debug/fleet the collective
 census of the engine's compiled programs (docs/observability.md).
 VEOMNI_FAULT_PLAN arms the serving fault points (serve.admit /
 serve.prefill / serve.decode_tick, docs/resilience.md) for overload and
@@ -150,6 +157,11 @@ def main():
                     default=int(os.environ.get("VEOMNI_SERVE_TENANT_INFLIGHT",
                                                0)),
                     help="per-tenant waiting+running cap (0 = uncapped)")
+    ap.add_argument("--replicas", type=int,
+                    default=int(os.environ.get("VEOMNI_SERVE_REPLICAS", 1)),
+                    help="N > 1 serves through the scale-out router over N "
+                         "data-parallel engine replicas (prefix-affinity "
+                         "dispatch, QoS at the router); 1 = bare engine")
     ap.add_argument("--priority", default="interactive",
                     help="QoS class for CLI-built requests")
     ap.add_argument("--tenant", default="",
@@ -179,8 +191,10 @@ def main():
 
     arm_from_env()
 
+    if args.replicas < 1:
+        ap.error("--replicas must be >= 1")
     params, cfg = _build_model(args)
-    engine = InferenceEngine(params, cfg, EngineConfig(
+    ecfg = EngineConfig(
         num_slots=args.slots, block_size=args.block_size,
         max_model_len=args.max_model_len, log_every_steps=args.log_steps,
         prefix_cache=bool(args.prefix_cache),
@@ -189,13 +203,25 @@ def main():
         classes=args.classes, queue_bound=args.queue_bound,
         tenant_max_inflight=args.tenant_inflight,
         kv_quant=args.kv_quant, weight_quant=args.weight_quant,
-    ))
+    )
+    router = None
+    if args.replicas > 1:
+        from veomni_tpu.serving import Router, RouterConfig
+
+        router = Router(params, cfg, ecfg,
+                        RouterConfig(replicas=args.replicas))
+        # any replica describes the per-replica pool; all are identical
+        first = next(iter(router.replicas.values())).engine
+        driver, cap_engine = router, first
+    else:
+        driver = cap_engine = InferenceEngine(params, cfg, ecfg)
     # startup echo of the quant tier next to the capacity it buys: the
     # operator sees the storage mode AND the "users that fit" figure the
     # quantized pool actually provides, before any request lands
-    cap = engine.kv_capacity()
+    cap = cap_engine.kv_capacity()
     print(json.dumps({
         "kv_quant": args.kv_quant, "weight_quant": args.weight_quant,
+        "replicas": args.replicas,
         "kv_pool_bytes": cap["pool_bytes"],
         "kv_block_bytes": cap["block_bytes"],
         "kv_max_concurrent_seqs": cap["max_concurrent_seqs"],
@@ -219,19 +245,48 @@ def main():
     # pump loop mutates (unlocked cross-thread read — the lock-discipline
     # audit in docs/static-analysis.md): the engine publishes these as
     # thread-safe registry gauges after every tick, so health reads those
-    exporter = maybe_start_from_env(health_fn=lambda: {
-        "healthy": True,
-        "queue_depth": get_registry().gauge("serve.queue_depth").value,
-        "num_running": get_registry().gauge("serve.num_running").value,
-        # overload outcomes (thread-safe registry counters, same rule):
-        # a probe sees shedding/deadline pressure without log scraping
-        "rejected": get_registry().counter("serve.rejected").value,
-        "deadline_misses":
-            get_registry().counter("serve.deadline_misses").value,
-    }, requests_fn=engine.tracer.snapshot,
-        # /debug/memory gains the KV pool capacity document (pool bytes +
-        # estimated max-concurrent sequences) next to the buffer census
-        memory_fn=engine.kv_capacity)
+    if router is not None:
+        # router mode: engine gauges carry the serve.rK.* instance label;
+        # the health doc reads the router-level aggregates instead, and
+        # /debug/requests merges every replica's (thread-safe) tracer.
+        # Tracer list captured at startup — the CLI never resizes the fleet
+        tracers = [h.engine.tracer for h in router.replicas.values()]
+
+        def _requests_fn():
+            doc = {"inflight": [], "finished": []}
+            for t in tracers:
+                snap = t.snapshot()
+                doc["inflight"].extend(snap.get("inflight", ()))
+                doc["finished"].extend(snap.get("finished", ()))
+            return doc
+
+        exporter = maybe_start_from_env(health_fn=lambda: {
+            "healthy": True,
+            "queue_depth":
+                get_registry().gauge("serve.router.queue_depth").value,
+            "replicas_live":
+                get_registry().gauge("serve.router.replicas_live").value,
+            "rejected":
+                get_registry().counter("serve.router.rejected").value,
+            "deadline_cancelled": get_registry().counter(
+                "serve.router.deadline_cancelled").value,
+        }, requests_fn=_requests_fn, memory_fn=cap_engine.kv_capacity,
+            router_fn=router.debug_doc)
+    else:
+        exporter = maybe_start_from_env(health_fn=lambda: {
+            "healthy": True,
+            "queue_depth": get_registry().gauge("serve.queue_depth").value,
+            "num_running": get_registry().gauge("serve.num_running").value,
+            # overload outcomes (thread-safe registry counters, same rule):
+            # a probe sees shedding/deadline pressure without log scraping
+            "rejected": get_registry().counter("serve.rejected").value,
+            "deadline_misses":
+                get_registry().counter("serve.deadline_misses").value,
+        }, requests_fn=driver.tracer.snapshot,
+            # /debug/memory gains the KV pool capacity document (pool bytes
+            # + estimated max-concurrent sequences) next to the buffer
+            # census
+            memory_fn=driver.kv_capacity)
 
     sampling = SamplingParams(
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
@@ -284,13 +339,13 @@ def main():
         ap.error("nothing to do: pass --prompt-ids, --synthetic N "
                  "and/or --requests-json")
     try:
-        for ev in engine.generate(reqs):
+        for ev in driver.generate(reqs):
             line = {"request_id": ev.request_id, "index": ev.index,
                     "token": ev.token}
             if ev.finished:
                 line["finished"] = ev.finish_reason
             print(json.dumps(line), flush=True)
-        outs = engine.run()  # no-op drain; collects final outputs
+        outs = driver.run()  # no-op drain; collects final outputs
     except BaseException as e:
         # same contract as trainer.train(): a pump that dies mid-decode
         # leaves its request/event history in a post-mortem, not in the void
@@ -307,7 +362,7 @@ def main():
             extra["oom_report_error"] = str(forensic_err)
         dump_postmortem(f"exception:{type(e).__name__}", extra=extra)
         raise
-    print(json.dumps({"metrics": engine.metrics()}), flush=True)
+    print(json.dumps({"metrics": driver.metrics()}), flush=True)
     if exporter is not None:
         exporter.stop()
     # terminal-status census first: shed/expired requests are reported
@@ -316,14 +371,22 @@ def main():
     for o in outs.values():
         key = o.finish_reason if o.finish_reason in by_status else "ok"
         by_status[key] += 1
-    print(json.dumps({
+    census = {
         "completed": by_status["ok"],
         "rejected": by_status["rejected"],
         "deadline_cancelled": by_status["deadline"],
         "cancelled": by_status["cancelled"],
         "deadline_missed": sum(1 for o in outs.values()
                                if o.deadline_missed),
-    }), flush=True)
+    }
+    if router is not None:
+        # per-replica rollup in the same census line: where the traffic
+        # actually landed (dispatch/redispatch counts, terminal states)
+        census["replicas"] = [h.status_doc()
+                              for h in router.replicas.values()]
+        census["replicas_retired"] = [h.status_doc()
+                                      for h in router.retired]
+    print(json.dumps(census), flush=True)
     for rid in sorted(outs):
         o = outs[rid]
         line = {

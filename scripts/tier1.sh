@@ -9,9 +9,9 @@
 # later shards still run — a hang in shard 1 must not hide shard 2's result.
 #
 # Knobs (env):
-#   TIER1_SHARDS         shard count (default 4; 3 stopped fitting the
-#                        per-shard budget when the quantized serving tier
-#                        grew the suite — shard 1/3 hit 870s)
+#   TIER1_SHARDS         shard count (default 5; 4 stopped fitting the
+#                        per-shard budget when the scale-out router tier
+#                        grew the suite — shard 1/4 hit 870s)
 #   TIER1_SHARD_TIMEOUT  per-shard budget in seconds (default 870, the
 #                        ROADMAP's historical single-run budget)
 #   TIER1_LOG_DIR        where per-shard logs land (default /tmp)
@@ -23,7 +23,7 @@ set -u -o pipefail
 
 cd "$(dirname "$0")/.."
 
-SHARDS="${TIER1_SHARDS:-4}"
+SHARDS="${TIER1_SHARDS:-5}"
 SHARD_TIMEOUT="${TIER1_SHARD_TIMEOUT:-870}"
 LOG_DIR="${TIER1_LOG_DIR:-/tmp}"
 mkdir -p "$LOG_DIR"

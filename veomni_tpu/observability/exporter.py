@@ -17,7 +17,10 @@ history ring and non-finite provenance (the process's active
 ``/debug/fleet`` the
 cross-rank view (per-rank step-time skew table, heartbeat freshness,
 collective census — ``fleet_fn`` or the process's active
-``FleetMonitor``). Usable by both the trainer
+``FleetMonitor``); and ``/debug/router`` the scale-out router's replica
+census (per-replica state/queue/assignment, retired replicas, weights
+version — ``router_fn``, wired by ``scripts/serve.py --replicas N``; an
+unwired deployment reports an empty document). Usable by both the trainer
 (``train.observability_port`` / ``VEOMNI_METRICS_PORT``) and
 ``serving.InferenceEngine`` (``scripts/serve.py``).
 """
@@ -125,7 +128,8 @@ class MetricsExporter:
                  health_fn: Optional[Callable[[], Dict]] = None,
                  requests_fn: Optional[Callable[[], Dict]] = None,
                  memory_fn: Optional[Callable[[], Dict]] = None,
-                 fleet_fn: Optional[Callable[[], Dict]] = None):
+                 fleet_fn: Optional[Callable[[], Dict]] = None,
+                 router_fn: Optional[Callable[[], Dict]] = None):
         self.requested_port = port
         self.host = host
         self.registry = registry  # None -> resolve the global lazily
@@ -139,6 +143,9 @@ class MetricsExporter:
         # the trainer wires FleetMonitor.debug_doc; unwired, /debug/fleet
         # falls back to the process's active monitor (fleet.debug_fleet_doc)
         self.fleet_fn = fleet_fn
+        # scale-out serving wires Router.debug_doc; unwired, /debug/router
+        # reports an empty replica census (single-engine deployment)
+        self.router_fn = router_fn
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -239,6 +246,12 @@ class MetricsExporter:
                             doc = debug_fleet_doc()
                         self._send(200, json.dumps(doc, default=str).encode(),
                                    "application/json")
+                    elif route == "/debug/router":
+                        doc = {"replicas": [], "retired": []}
+                        if exporter.router_fn is not None:
+                            doc = dict(exporter.router_fn())
+                        self._send(200, json.dumps(doc, default=str).encode(),
+                                   "application/json")
                     else:
                         self._send(404, b"not found", "text/plain")
                 except Exception as e:  # a broken scrape must not kill us
@@ -288,6 +301,7 @@ def maybe_start_from_env(registry: Optional[MetricsRegistry] = None,
                          requests_fn: Optional[Callable[[], Dict]] = None,
                          memory_fn: Optional[Callable[[], Dict]] = None,
                          fleet_fn: Optional[Callable[[], Dict]] = None,
+                         router_fn: Optional[Callable[[], Dict]] = None,
                          ) -> Optional[MetricsExporter]:
     """Start an exporter iff configured; returns it (caller owns stop())."""
     port = resolve_port(config_port)
@@ -295,6 +309,6 @@ def maybe_start_from_env(registry: Optional[MetricsRegistry] = None,
         return None
     exp = MetricsExporter(port=port, registry=registry, health_fn=health_fn,
                           requests_fn=requests_fn, memory_fn=memory_fn,
-                          fleet_fn=fleet_fn)
+                          fleet_fn=fleet_fn, router_fn=router_fn)
     exp.start()
     return exp

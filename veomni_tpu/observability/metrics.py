@@ -401,6 +401,82 @@ class MetricsRegistry:
             self._last_export = None
 
 
+class LabelledRegistry:
+    """Per-instance relabeling view over a shared :class:`MetricsRegistry`.
+
+    N in-process serving engines used to clobber each other's process-wide
+    ``serve.*`` instruments — every replica's pump wrote the SAME
+    ``serve.queue_depth`` gauge, so ``/metrics`` showed whichever replica
+    scribbled last. Each engine now emits through a view carrying an
+    instance label; the label is inserted after the family prefix at
+    creation time (``serve.queue_depth`` -> ``serve.r0.queue_depth``) so
+    per-replica series coexist in the one registry the exporter renders.
+
+    Two deliberate properties:
+
+    * **call sites keep literal names** — graftlint's metric scanner and
+      the doc-drift gate key off the literal strings at ``.counter(...)``/
+      ``.gauge(...)``/``.histogram(...)`` call sites, and those strings are
+      the BASE family names; the view relabels underneath, so the scanner
+      sanity pins and the docs table keep meaning what they say.
+    * **the empty label is the identity** — a single unlabelled engine
+      produces byte-identical metric names to every release before this
+      one, so existing scrape configs and dashboards keep working.
+
+    Dot-free names (``recompiles``) stay shared across instances: they are
+    process-wide by design, not per-replica families.
+    """
+
+    def __init__(self, base: MetricsRegistry, label: str = ""):
+        # never stack views — relabeling a labelled view re-targets its base
+        while isinstance(base, LabelledRegistry):
+            base = base.base
+        self.base = base
+        self.label = str(label)
+
+    def scoped(self, name: str) -> str:
+        """The concrete instrument name this view creates for ``name``."""
+        if not self.label or "." not in name:
+            return name
+        head, rest = name.split(".", 1)
+        return f"{head}.{self.label}.{rest}"
+
+    # Same instrument surface as MetricsRegistry — callers (engine, tracer,
+    # recompile detector) cannot tell the difference.
+    def counter(self, name: str) -> Counter:
+        return self.base.counter(self.scoped(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self.base.gauge(self.scoped(name))
+
+    def histogram(self, name: str, max_samples: int = 512) -> Histogram:
+        # SLO bucket bounds are keyed by BASE family name: a labelled
+        # serve.r0.ttft_s must carry the same exact native buckets as
+        # serve.ttft_s or per-replica PromQL p99s silently degrade to
+        # reservoir estimates
+        return self.base._get_or_create(
+            self.scoped(name), Histogram, max_samples=max_samples,
+            bucket_bounds=SLO_BUCKET_BOUNDS.get(name),
+        )
+
+    def get(self, name: str):
+        return self.base.get(self.scoped(name))
+
+    def histogram_sum(self, name: str) -> float:
+        return self.base.histogram_sum(self.scoped(name))
+
+    def set_gauges(self, prefix: str, values: Dict[str, Any]) -> None:
+        for k, v in values.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.gauge(f"{prefix}.{k}").set(v)
+
+    def items_snapshot(self) -> List[tuple]:
+        return self.base.items_snapshot()
+
+    def rank(self) -> int:
+        return self.base.rank()
+
+
 _GLOBAL: Optional[MetricsRegistry] = None
 _GLOBAL_LOCK = threading.Lock()
 

@@ -10,9 +10,13 @@ load-shedding, class-aware preemption, cache-aware) -> ``spec_decode``
 registry-dispatched) -> ``engine`` (jitted chunked prefill over cached
 prefixes + batched paged decode, one-token or draft-then-verify;
 deadline expiry + goodput accounting) -> ``quality`` (fixed-seed
-perplexity/top-k gate certifying the non-bit-exact quantized tier). See
+perplexity/top-k gate certifying the non-bit-exact quantized tier) ->
+``replica``/``router`` (scale-out front door: QoS admission at the
+router, prefix-affinity dispatch over N single-class engine replicas,
+health-aware shedding, live add/remove behind versioned weights). See
 ``docs/serving.md`` for the architecture, the QoS/overload semantics,
-the quantized serving tier, and the compile-count story.
+the quantized serving tier, scale-out routing, and the compile-count
+story.
 """
 
 from veomni_tpu.serving import spec_decode  # registers the spec_draft op
@@ -22,12 +26,19 @@ from veomni_tpu.serving.api import (
     SamplingParams,
     StreamEvent,
 )
-from veomni_tpu.serving.engine import EngineConfig, InferenceEngine
+from veomni_tpu.serving.engine import (
+    EngineConfig,
+    InferenceEngine,
+    SharedPrograms,
+)
 from veomni_tpu.serving.kv_block_manager import KVBlockManager
 from veomni_tpu.serving.quality import fixed_corpus, quality_stats
 from veomni_tpu.serving.prefix_cache import PrefixCache
+from veomni_tpu.serving.replica import ReplicaHandle
+from veomni_tpu.serving.router import Router, RouterConfig
 from veomni_tpu.serving.scheduler import (
     DEFAULT_CLASSES,
+    QoSPicker,
     Scheduler,
     SequenceState,
     parse_classes,
@@ -42,10 +53,15 @@ __all__ = [
     "InferenceEngine",
     "KVBlockManager",
     "PrefixCache",
+    "QoSPicker",
+    "ReplicaHandle",
     "Request",
     "RequestOutput",
+    "Router",
+    "RouterConfig",
     "SamplingParams",
     "Scheduler",
     "SequenceState",
+    "SharedPrograms",
     "StreamEvent",
 ]

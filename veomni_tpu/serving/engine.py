@@ -57,9 +57,10 @@ is host bookkeeping.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Union
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -69,7 +70,7 @@ from veomni_tpu.models import decode as decode_mod
 from veomni_tpu.models.config import TransformerConfig
 from veomni_tpu.ops.quantization import make_kv_pool, quantize_decode_params
 from veomni_tpu.models.decode import supports_cached_decode
-from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.observability.metrics import LabelledRegistry, get_registry
 from veomni_tpu.observability.request_trace import RequestTracer
 from veomni_tpu.observability.spans import span
 from veomni_tpu.resilience.faults import fault_point
@@ -151,6 +152,12 @@ class EngineConfig:
     # train_step). 0 disables. The grace window absorbs the legitimate
     # warmup compiles of the pow2 bucket ladder.
     recompile_warmup_ticks: int = 256
+    # metric-instance label: with N in-process engines (the scale-out
+    # router) each engine's serve.* instruments get this label inserted
+    # after the family prefix (serve.queue_depth -> serve.r0.queue_depth)
+    # so replicas stop clobbering each other's process-wide gauges. ""
+    # (the default) keeps the single-engine names byte-identical.
+    metrics_label: str = ""
 
     def __post_init__(self):
         if self.block_size < 1 or (self.block_size & (self.block_size - 1)):
@@ -175,11 +182,43 @@ class EngineConfig:
                 f"weight_quant must be 'none' or 'int8', got "
                 f"{self.weight_quant!r}"
             )
+        if self.metrics_label and not all(
+            c.isalnum() or c in "_-" for c in self.metrics_label
+        ):
+            raise ValueError(
+                f"metrics_label must be [A-Za-z0-9_-]*, got "
+                f"{self.metrics_label!r}"
+            )
         # malformed class specs fail at construction, not mid-serve
         parse_classes(self.classes)
         if self.num_blocks <= 0:
             per_seq = -(-self.max_model_len // self.block_size)
             self.num_blocks = 1 + self.num_slots * per_seq
+
+
+@dataclass
+class SharedPrograms:
+    """The engine's compiled-program bundle, shareable across replicas.
+
+    Every jitted step the engine builds closes over ``cfg`` ONLY — slot
+    count, bucket widths and sampling state all arrive as (bucketed)
+    arguments. Data-parallel replicas of the same model therefore trace
+    and compile the exact same programs; without sharing, each replica
+    re-traces its own copies and an N-replica router multiplies the warmup
+    compile bill N ways (``TRACE_COUNTS`` counts traces, so the router's
+    compile-count gate would catch it). The first replica builds the
+    bundle, later replicas receive it via ``InferenceEngine(programs=...)``
+    — adding a replica adds ZERO compiles. Donation is per-call, so a
+    shared program donates each caller's own pool buffers safely."""
+
+    cfg: TransformerConfig
+    prefill: Any
+    scatter: Any
+    sample: Any
+    decode_step: Any
+    prefill_chunk_step: Any
+    verify_step: Any
+    cow: Any
 
 
 class InferenceEngine:
@@ -199,7 +238,8 @@ class InferenceEngine:
     scheduler attributes to another thread."""
 
     def __init__(self, params, cfg: TransformerConfig,
-                 config: Optional[EngineConfig] = None):
+                 config: Optional[EngineConfig] = None,
+                 programs: Optional[SharedPrograms] = None):
         if not supports_cached_decode(cfg):
             raise ValueError(
                 f"config {cfg.model_type!r} has no cached-decode path; the "
@@ -229,11 +269,19 @@ class InferenceEngine:
         self.prefix_cache = (
             PrefixCache(self.blocks) if ec.prefix_cache else None
         )
+        # observability registry view: with a metrics_label every serve.*
+        # instrument this engine (and its tracer) creates carries the
+        # instance label — N router replicas stop clobbering each other's
+        # process-wide gauges; unlabelled stays the plain shared registry
+        reg = get_registry()
+        if ec.metrics_label:
+            reg = LabelledRegistry(reg, ec.metrics_label)
+        self._registry = reg
         # per-request lifecycle tracing (request_trace.py): the scheduler
         # reports queued/admitted/preempted, the engine reports prefill/
         # first-token/finished — together they feed serve.queue_wait_s and
         # serve.tpot_s and the /debug/requests timelines
-        self.tracer = RequestTracer(ec.num_slots)
+        self.tracer = RequestTracer(ec.num_slots, registry=reg)
         # draft-then-verify speculation: resolve the drafting strategy up
         # front (a typo'd spec_draft fails at construction, not mid-serve)
         # and widen admission headroom for the per-tick k-token growth. An
@@ -263,37 +311,73 @@ class InferenceEngine:
                                    queue_bound=ec.queue_bound,
                                    tenant_max_inflight=ec.tenant_max_inflight)
 
-        # prefill is the SAME jitted program greedy_generate uses (shared
-        # prompt buckets, shared TRACE_COUNTS["prefill"])
-        self._prefill, _ = decode_mod._jitted(cfg)
-        self._scatter = jax.jit(
-            decode_mod.scatter_prompt_cache, donate_argnums=(0,)
-        )
-        self._sample = jax.jit(decode_mod.sample_tokens)
-        self._decode_step = self._build_decode_step()
-        self._prefill_chunk_step = self._build_prefill_chunk_step()
+        # compiled-program bundle: built once here, or adopted from a peer
+        # replica with the same model config (SharedPrograms) so adding a
+        # data-parallel replica adds zero traces/compiles
+        if programs is not None:
+            if programs.cfg != cfg:
+                raise ValueError(
+                    "SharedPrograms built for a different model config; "
+                    "replicas can only share programs for the same model"
+                )
+            self.programs = programs
+        else:
+            self.programs = SharedPrograms(
+                cfg=cfg,
+                # prefill is the SAME jitted program greedy_generate uses
+                # (shared prompt buckets, shared TRACE_COUNTS["prefill"])
+                prefill=decode_mod._jitted(cfg)[0],
+                scatter=jax.jit(
+                    decode_mod.scatter_prompt_cache, donate_argnums=(0,)
+                ),
+                sample=jax.jit(decode_mod.sample_tokens),
+                decode_step=self._build_decode_step(),
+                prefill_chunk_step=self._build_prefill_chunk_step(),
+                # built unconditionally — jit tracing is lazy, so a
+                # non-speculative engine never pays for it, and a
+                # speculative peer can adopt the bundle
+                verify_step=self._build_verify_step(),
+                # copy-on-write block duplication: src/dst are traced
+                # scalars, so this compiles exactly once per bundle
+                cow=jax.jit(
+                    lambda k, v, src, dst: decode_mod.copy_block(
+                        (k, v), src, dst
+                    ),
+                    donate_argnums=(0, 1),
+                ),
+            )
+        self._prefill = self.programs.prefill
+        self._scatter = self.programs.scatter
+        self._sample = self.programs.sample
+        self._decode_step = self.programs.decode_step
+        self._prefill_chunk_step = self.programs.prefill_chunk_step
         self._verify_step = (
-            self._build_verify_step() if self._spec_enabled else None
+            self.programs.verify_step if self._spec_enabled else None
         )
-        # copy-on-write block duplication: src/dst are traced scalars, so
-        # this compiles exactly once per engine
-        self._cow = jax.jit(
-            lambda k, v, src, dst: decode_mod.copy_block((k, v), src, dst),
-            donate_argnums=(0, 1),
-        )
+        self._cow = self.programs.cow
 
         self._outputs: Dict[str, RequestOutput] = {}
         self._req_counter = 0
         self._step_counter = 0
         # metrics: TTFT accumulators (lifetime + window) + a
-        # decode-throughput window + prefix-cache totals
+        # decode-throughput window + prefix-cache totals.
+        #
+        # The WINDOW accumulators are the one engine surface read AND
+        # reset from outside the pump thread: metrics(reset_window=True)
+        # from two concurrent scrapers (router poll + exporter) used to
+        # race the reset — scraper A computes rates, scraper B zeroes the
+        # window under it, A's reset then re-zeroes a window B already
+        # claimed and a whole window of tokens vanishes from both
+        # readings. Snapshot+reset is now atomic under _metrics_lock
+        # (pump-side increments take it too; it is uncontended off-scrape).
+        self._metrics_lock = threading.Lock()
         self._ttft_sum = 0.0
         self._ttft_n = 0
-        self._win_ttft_sum = 0.0
-        self._win_ttft_n = 0
+        self._win_ttft_sum = 0.0  # guarded-by: _metrics_lock
+        self._win_ttft_n = 0  # guarded-by: _metrics_lock
         self._total_generated = 0
-        self._window_tokens = 0
-        self._window_t0 = time.perf_counter()
+        self._window_tokens = 0  # guarded-by: _metrics_lock
+        self._window_t0 = time.perf_counter()  # guarded-by: _metrics_lock
         self._prompt_tokens_total = 0
         self._cached_tokens_total = 0
         self._prefill_chunks_total = 0
@@ -301,8 +385,8 @@ class InferenceEngine:
         # for the acceptance-rate gauge (resets with the metrics window)
         self._spec_proposed_total = 0
         self._spec_accepted_total = 0
-        self._win_spec_proposed = 0
-        self._win_spec_accepted = 0
+        self._win_spec_proposed = 0  # guarded-by: _metrics_lock
+        self._win_spec_accepted = 0  # guarded-by: _metrics_lock
         # QoS / overload accounting: load-shed + deadline outcomes
         # (lifetime totals) and the goodput window — tokens from requests
         # that finished WITHIN their deadline (deadline-free requests
@@ -311,10 +395,7 @@ class InferenceEngine:
         self._shed_tokens_total = 0
         self._deadline_miss_total = 0
         self._goodput_tokens_total = 0
-        self._win_goodput_tokens = 0
-        # observability registry: same surface the trainer exports through,
-        # so one /metrics endpoint covers both (docs/observability.md)
-        reg = get_registry()
+        self._win_goodput_tokens = 0  # guarded-by: _metrics_lock
         self._m_requests = reg.counter("serve.requests")
         self._m_tokens = reg.counter("serve.generated_tokens")
         self._m_ttft = reg.histogram("serve.ttft_s")
@@ -595,6 +676,27 @@ class InferenceEngine:
             del self._outputs[rid]
         return done
 
+    def backdate_submit_time(self, request_id: str,
+                             submit_time: float) -> None:
+        """Rewind a just-submitted request's deadline clock to an upstream
+        arrival time. ``deadline_s`` measures from when the USER submitted;
+        a front door (the scale-out router) that held the request in its
+        own QoS queue forwards the original intake time here so router
+        wait counts against the deadline exactly like engine queue wait.
+        Only ever moves the clock BACK (min), and only while the request
+        is still in flight."""
+        seq = self._find_seq(request_id)
+        if seq is not None:
+            seq.submit_time = min(seq.submit_time, float(submit_time))
+
+    def get_output(self, request_id: str) -> Optional[RequestOutput]:
+        """Read-only peek at a request's output, in flight or finished,
+        without releasing it. The router's replica-kill path uses this to
+        decide each stranded request's fate: no tokens yet -> safe to
+        re-dispatch to a survivor; tokens already streamed -> terminal
+        ``cancelled`` (re-running it elsewhere would duplicate output)."""
+        return self._outputs.get(request_id)
+
     def pop_output(self, request_id: str) -> Optional[RequestOutput]:
         """Release and return one finished request's output (streaming
         callers pop after seeing its finished event). Refuses while the
@@ -783,8 +885,9 @@ class InferenceEngine:
             self._outputs[seq.seq_id].ttft_s = ttft
             self._ttft_sum += ttft
             self._ttft_n += 1
-            self._win_ttft_sum += ttft
-            self._win_ttft_n += 1
+            with self._metrics_lock:
+                self._win_ttft_sum += ttft
+                self._win_ttft_n += 1
             self._m_ttft.observe(ttft)
             self.tracer.on_first_token(seq.seq_id)
         else:
@@ -961,11 +1064,12 @@ class InferenceEngine:
             accepted_emitted = min(accepted, len(emit) - 1)
             if proposed:
                 self._spec_proposed_total += proposed
-                self._win_spec_proposed += proposed
                 self._m_spec_proposed.inc(proposed)
                 self._spec_accepted_total += accepted_emitted
-                self._win_spec_accepted += accepted_emitted
                 self._m_spec_accepted.inc(accepted_emitted)
+                with self._metrics_lock:
+                    self._win_spec_proposed += proposed
+                    self._win_spec_accepted += accepted_emitted
                 self._outputs[seq.seq_id].spec_accepted_tokens += (
                     accepted_emitted
                 )
@@ -992,7 +1096,8 @@ class InferenceEngine:
     def _emit(self, seq: SequenceState, token: int) -> StreamEvent:
         """Record a sampled token, finishing the request on eos/length."""
         seq.generated.append(token)
-        self._window_tokens += 1
+        with self._metrics_lock:
+            self._window_tokens += 1
         self._total_generated += 1
         self._m_tokens.inc()
         sp = seq.request.sampling
@@ -1017,7 +1122,8 @@ class InferenceEngine:
                 self._m_deadline_misses.inc()
             else:
                 self._goodput_tokens_total += len(seq.generated)
-                self._win_goodput_tokens += len(seq.generated)
+                with self._metrics_lock:
+                    self._win_goodput_tokens += len(seq.generated)
             tl = self.tracer.on_finished(seq.seq_id, reason,
                                          len(seq.generated))
             if tl is not None:
@@ -1050,53 +1156,61 @@ class InferenceEngine:
         logger/meter sink. ``decode_tokens_per_sec`` and ``ttft_avg_s`` are
         measured over the window since the last resetting call (pass
         ``reset_window=False`` for a peek that leaves another consumer's
-        window intact); ``ttft_avg_lifetime_s`` never resets."""
+        window intact); ``ttft_avg_lifetime_s`` never resets.
+
+        Window snapshot and reset are ATOMIC under ``_metrics_lock``: two
+        concurrent resetting scrapers (router poll + exporter) each claim
+        a disjoint window instead of racing the reset and losing one
+        window's tokens from both readings."""
         now = time.perf_counter()
-        dt = max(now - self._window_t0, 1e-9)
-        m = {
-            "queue_depth": float(self.scheduler.queue_depth),
-            "num_running": float(self.scheduler.num_running),
-            "block_utilization": self.blocks.utilization(),
-            "preemptions": float(self.scheduler.preemption_count),
-            "generated_tokens": float(self._total_generated),
-            "decode_tokens_per_sec": self._window_tokens / dt,
-            "prefix_hit_rate": (
-                self._cached_tokens_total / max(1, self._prompt_tokens_total)
-            ),
-            "cached_tokens": float(self._cached_tokens_total),
-            "prompt_tokens": float(self._prompt_tokens_total),
-            "prefill_chunks": float(self._prefill_chunks_total),
-            # speculative decoding: lifetime totals (bench deltas) + the
-            # window acceptance rate (drafted tokens the verify step kept)
-            "spec_proposed": float(self._spec_proposed_total),
-            "spec_accepted": float(self._spec_accepted_total),
-            "spec_acceptance_rate": (
-                self._win_spec_accepted / max(1, self._win_spec_proposed)
-            ),
-            # QoS / overload outcomes (lifetime totals; bench takes deltas)
-            # + the window goodput rate — tokens from requests that met
-            # their deadline, the overload bench's headline figure
-            "rejected": float(self._rejected_total),
-            "shed_tokens": float(self._shed_tokens_total),
-            "deadline_misses": float(self._deadline_miss_total),
-            "goodput_tokens": float(self._goodput_tokens_total),
-            "goodput_tokens_per_sec": self._win_goodput_tokens / dt,
-        }
-        if self._win_ttft_n:
-            m["ttft_avg_s"] = self._win_ttft_sum / self._win_ttft_n
-        if self._ttft_n:
-            m["ttft_avg_lifetime_s"] = self._ttft_sum / self._ttft_n
-        if reset_window:
-            # the resetting caller owns the throughput window; mirror its
-            # reading to the exporter gauge
-            self._m_tps.set(m["decode_tokens_per_sec"])
-            self._m_spec_rate.set(m["spec_acceptance_rate"])
-            self._m_goodput.set(m["goodput_tokens_per_sec"])
-            self._window_tokens = 0
-            self._win_goodput_tokens = 0
-            self._window_t0 = now
-            self._win_ttft_sum = 0.0
-            self._win_ttft_n = 0
-            self._win_spec_proposed = 0
-            self._win_spec_accepted = 0
+        with self._metrics_lock:
+            dt = max(now - self._window_t0, 1e-9)
+            m = {
+                "queue_depth": float(self.scheduler.queue_depth),
+                "num_running": float(self.scheduler.num_running),
+                "block_utilization": self.blocks.utilization(),
+                "preemptions": float(self.scheduler.preemption_count),
+                "generated_tokens": float(self._total_generated),
+                "decode_tokens_per_sec": self._window_tokens / dt,
+                "prefix_hit_rate": (
+                    self._cached_tokens_total
+                    / max(1, self._prompt_tokens_total)
+                ),
+                "cached_tokens": float(self._cached_tokens_total),
+                "prompt_tokens": float(self._prompt_tokens_total),
+                "prefill_chunks": float(self._prefill_chunks_total),
+                # speculative decoding: lifetime totals (bench deltas) +
+                # the window acceptance rate (drafts the verify step kept)
+                "spec_proposed": float(self._spec_proposed_total),
+                "spec_accepted": float(self._spec_accepted_total),
+                "spec_acceptance_rate": (
+                    self._win_spec_accepted
+                    / max(1, self._win_spec_proposed)
+                ),
+                # QoS / overload outcomes (lifetime totals; bench takes
+                # deltas) + the window goodput rate — tokens from requests
+                # that met their deadline, the overload bench's headline
+                "rejected": float(self._rejected_total),
+                "shed_tokens": float(self._shed_tokens_total),
+                "deadline_misses": float(self._deadline_miss_total),
+                "goodput_tokens": float(self._goodput_tokens_total),
+                "goodput_tokens_per_sec": self._win_goodput_tokens / dt,
+            }
+            if self._win_ttft_n:
+                m["ttft_avg_s"] = self._win_ttft_sum / self._win_ttft_n
+            if self._ttft_n:
+                m["ttft_avg_lifetime_s"] = self._ttft_sum / self._ttft_n
+            if reset_window:
+                # the resetting caller owns the throughput window; mirror
+                # its reading to the exporter gauge
+                self._m_tps.set(m["decode_tokens_per_sec"])
+                self._m_spec_rate.set(m["spec_acceptance_rate"])
+                self._m_goodput.set(m["goodput_tokens_per_sec"])
+                self._window_tokens = 0
+                self._win_goodput_tokens = 0
+                self._window_t0 = now
+                self._win_ttft_sum = 0.0
+                self._win_ttft_n = 0
+                self._win_spec_proposed = 0
+                self._win_spec_accepted = 0
         return host_floats(m)

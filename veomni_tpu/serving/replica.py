@@ -1,0 +1,101 @@
+"""Replica handle: the router's per-engine bookkeeping unit.
+
+One :class:`ReplicaHandle` wraps one in-process
+:class:`~veomni_tpu.serving.engine.InferenceEngine` behind the scale-out
+router (``serving/router.py``). The handle owns everything the router
+needs to know about a replica that the engine itself does not track:
+
+* **lifecycle state** — ``live`` (in the dispatch rotation), ``draining``
+  (finishing in-flight work before a clean detach; receives no new
+  requests) or ``dead`` (pump raised / killed; its stranded requests were
+  re-dispatched or surfaced terminal by the router).
+* **assignment set** — the request ids currently dispatched to this
+  engine and not yet captured back by the router. On replica death this
+  set IS the list of stranded requests to triage; on drain it is the
+  work left before detach.
+* **weights version** — the version tag the replica's parameters were
+  published under (``Router.publish_weights``). Replicas added after a
+  publish serve the new version while old replicas finish on theirs —
+  the same versioned-weights interface the trainer hot-swap loop
+  (ROADMAP item 4) plugs into.
+* **dispatch counters** — requests dispatched here, and requests that
+  had to be re-dispatched AWAY after this replica died.
+
+The handle is plain host bookkeeping touched only by the router's pump
+thread; anything another thread reads goes through the router's locked
+debug snapshot (``/debug/router``), never through a live handle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Set
+
+from veomni_tpu.serving.engine import InferenceEngine
+
+#: lifecycle states a replica moves through (strictly forward:
+#: live -> draining -> detached, or live/draining -> dead)
+STATE_LIVE = "live"
+STATE_DRAINING = "draining"
+STATE_DEAD = "dead"
+STATE_DETACHED = "detached"  # drained clean and out of the replica set
+
+
+@dataclass
+class ReplicaHandle:
+    """One engine replica as the router sees it."""
+
+    rid: str  # instance label, e.g. "r0" — also the engine's metrics_label
+    engine: InferenceEngine
+    state: str = STATE_LIVE
+    weights_version: str = "v0"
+    # request ids dispatched to this engine, not yet captured back
+    assigned: Set[str] = field(default_factory=set)
+    dispatched: int = 0  # requests ever routed here
+    redispatched: int = 0  # requests re-routed away after this replica died
+    # the router's last observed failure for a dead replica (repr'd
+    # exception) — lands in the debug doc so a postmortem names the cause
+    fail_reason: str = ""
+
+    @property
+    def in_rotation(self) -> bool:
+        """Eligible for NEW dispatches (draining/dead replicas are not)."""
+        return self.state == STATE_LIVE
+
+    @property
+    def pumpable(self) -> bool:
+        """Still stepped by the router (dead replicas never are)."""
+        return self.state in (STATE_LIVE, STATE_DRAINING)
+
+    def queue_depth(self) -> int:
+        """Waiting requests at the replica's engine (the spill signal)."""
+        return self.engine.scheduler.queue_depth
+
+    def free_concurrent_seqs(self) -> int:
+        """Max-length sequences the engine's free blocks could still
+        admit — the capacity leg of the spill decision (mirrors the
+        engine's ``serve.kv_free_concurrent_seqs`` gauge)."""
+        eng = self.engine
+        per_seq = max(1, eng.blocks.blocks_for(eng.config.max_model_len))
+        return eng.blocks.num_free // per_seq
+
+    def status_doc(self) -> Dict[str, Any]:
+        """JSON-ready row for ``/debug/router`` and the CLI census."""
+        doc: Dict[str, Any] = {
+            "rid": self.rid,
+            "state": self.state,
+            "weights_version": self.weights_version,
+            "queue_depth": (
+                self.queue_depth() if self.state != STATE_DEAD else -1
+            ),
+            "num_running": (
+                self.engine.scheduler.num_running
+                if self.state != STATE_DEAD else -1
+            ),
+            "assigned": len(self.assigned),
+            "dispatched": self.dispatched,
+            "redispatched": self.redispatched,
+        }
+        if self.fail_reason:
+            doc["fail_reason"] = self.fail_reason
+        return doc

@@ -1,0 +1,740 @@
+"""Scale-out serving: prefix-affinity router over data-parallel replicas.
+
+Everything the serving stack grew through PR 15 lives inside ONE engine
+process; millions of users need N of them behind a front door. The router
+is that front door: it owns the QoS admission queue (classes, tenant DRR,
+queue bound, deadlines — moved UP from the engine) and dispatches over N
+in-process :class:`~veomni_tpu.serving.engine.InferenceEngine` replicas
+through the existing ``api.py`` Request/RequestOutput surface. Replica
+engines run single-class FIFO (``classes="default"``, bounds off), so
+per-request semantics on a replica stay token-exact with the bare engine.
+
+Three pillars:
+
+1. **Prefix-affinity routing.** The dispatch target is chosen by
+   rendezvous-hashing the prompt's LEADING block-aligned chunk key — the
+   same ``tuple(tokens[i*bs:(i+1)*bs])`` chunks the radix prefix cache
+   keys its tree on — so shared-prefix traffic lands where its KV already
+   lives, multiplying the PR 9 hit rate instead of diluting it N ways.
+   Rendezvous (highest-random-weight) keeps the mapping stable when
+   replicas come and go: adding or removing one replica only moves the
+   keys that hash to it. Affinity yields under load pressure: when the
+   target's engine queue depth reaches ``spill_queue_depth`` (or its free
+   concurrent-sequence estimate drops below ``spill_min_free_seqs``) the
+   request spills to the least-loaded live replica instead; when EVERY
+   live replica is past the threshold the request parks at the router —
+   which is exactly what makes the router-level QoS pick meaningful under
+   overload (back-pressure, not blind fan-out).
+
+2. **Health- and shed-aware dispatch.** The router's pump steps every
+   replica; a replica whose ``step()`` raises (a wedged scheduler, a
+   device error) is marked DEAD and drained out of rotation the same
+   tick. Its stranded requests are triaged exactly-once: nothing
+   streamed yet -> re-dispatched (front of the router queue, original
+   arrival order) to a survivor; tokens already streamed -> terminal
+   ``cancelled`` (re-running would duplicate delivered output); already
+   terminal on the dead engine -> captured as-is. Nothing ever hangs.
+   ``serve.router.*`` gauges/counters, ``/debug/router`` and
+   ``router.*`` flight events expose all of it.
+
+3. **Live add/remove behind versioned weights.** ``add_replica()`` spins
+   up an engine that SHARES the compiled-program bundle
+   (:class:`~veomni_tpu.serving.engine.SharedPrograms` — zero new
+   compiles) and the latest ``publish_weights(params, version)`` payload;
+   ``remove_replica()`` drains (no new dispatches, in-flight work
+   finishes, outputs captured) then detaches — no lost or duplicated
+   request ids. Old replicas finish on their weights version while new
+   ones serve the new tag: the same interface the trainer hot-swap loop
+   (ROADMAP item 4) publishes into.
+
+Threading contract: like the engine, the router holds no locks for its
+own state — ONE pump thread (the caller's) drives ``submit``/``step``/
+``generate``/``run`` and every replica engine. The only cross-thread
+surface is the debug snapshot behind ``_debug_lock`` (the exporter's
+HTTP thread reads ``/debug/router``) plus the already-thread-safe
+metrics registry and flight recorder.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+
+from veomni_tpu.observability.flight_recorder import record as _flight_record
+from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.serving.api import (
+    Request,
+    RequestOutput,
+    SamplingParams,
+    StreamEvent,
+)
+from veomni_tpu.serving.engine import EngineConfig, InferenceEngine
+from veomni_tpu.serving.replica import (
+    STATE_DEAD,
+    STATE_DETACHED,
+    STATE_DRAINING,
+    STATE_LIVE,
+    ReplicaHandle,
+)
+from veomni_tpu.serving.scheduler import QoSPicker, parse_classes
+from veomni_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class RouterConfig:
+    """Router-level knobs (the engine keeps its own via EngineConfig)."""
+
+    # initial replica count (grow/shrink live via add/remove_replica)
+    replicas: int = 2
+    # leading FULL blocks of the prompt hashed into the affinity key —
+    # mirrors the radix cache's block-aligned chunk keys, so requests
+    # sharing a system prompt share a key. Prompts shorter than one block
+    # key on the whole prompt.
+    affinity_blocks: int = 2
+    # affinity yields when the target replica's engine queue depth reaches
+    # this; when EVERY live replica is past it, requests park at the
+    # router (back-pressure). 0 disables spill AND parking (pure affinity).
+    spill_queue_depth: int = 4
+    # affinity also yields when the target's free concurrent-sequence
+    # estimate (the serve.kv_free_concurrent_seqs signal) drops below
+    # this. 0 disables the capacity leg.
+    spill_min_free_seqs: int = 0
+    # QoS at the front door. None inherits the corresponding EngineConfig
+    # field, so an engine-tuned deployment routes identically.
+    classes: Optional[str] = None
+    queue_bound: Optional[int] = None
+    tenant_max_inflight: Optional[int] = None
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError("need at least one replica")
+        if self.affinity_blocks < 1:
+            raise ValueError("affinity_blocks must be >= 1")
+        if self.spill_queue_depth < 0:
+            raise ValueError("spill_queue_depth must be >= 0 (0 disables)")
+        if self.spill_min_free_seqs < 0:
+            raise ValueError("spill_min_free_seqs must be >= 0 (0 disables)")
+
+
+@dataclass
+class _RouterItem:
+    """Router-side bookkeeping for one accepted request."""
+
+    request: Request
+    class_idx: int  # QoSPicker duck-type field
+    order: int  # arrival sequence number (re-dispatch keeps this order)
+    submit_time: float = field(default_factory=time.perf_counter)
+    phase: str = "queued"  # queued -> dispatched -> done
+    replica: str = ""  # rid while dispatched
+
+    @property
+    def tenant(self) -> str:  # QoSPicker duck-type field
+        return getattr(self.request, "tenant", "")
+
+
+class Router:
+    """Front door over N in-process engine replicas."""
+
+    def __init__(self, params, cfg, engine_config: Optional[EngineConfig] = None,
+                 config: Optional[RouterConfig] = None):
+        self.engine_config = engine_config or EngineConfig()
+        self.config = config or RouterConfig()
+        ec, rc = self.engine_config, self.config
+        # QoS moves UP to the router: the front door runs the class/tenant
+        # pick and the admission bounds; replicas run single-class FIFO
+        # with bounds off so their per-request semantics stay token-exact.
+        classes_spec = rc.classes if rc.classes is not None else ec.classes
+        self.qos = QoSPicker(parse_classes(classes_spec))
+        self.queue_bound = (
+            rc.queue_bound if rc.queue_bound is not None else ec.queue_bound
+        )
+        self.tenant_max_inflight = (
+            rc.tenant_max_inflight if rc.tenant_max_inflight is not None
+            else ec.tenant_max_inflight
+        )
+        # versioned weights: replicas added later serve the latest publish
+        self._params = params
+        self._cfg = cfg
+        self._weights_version = "v0"
+        self.replicas: Dict[str, ReplicaHandle] = {}
+        self.retired: List[ReplicaHandle] = []
+        self._next_rid = 0
+        self._programs = None  # SharedPrograms, built by the first replica
+        for _ in range(rc.replicas):
+            self._spawn_replica()
+        # request bookkeeping: arrival-ordered router queue + id -> item
+        self._items: Dict[str, _RouterItem] = {}
+        self._queue: List[_RouterItem] = []
+        self._outputs: Dict[str, RequestOutput] = {}
+        self._req_counter = 0
+        self._order_counter = 0
+        # router-local outcome totals (metrics() mirrors the engine's keys)
+        self._rejected_total = 0
+        self._shed_tokens_total = 0
+        self._deadline_cancelled_total = 0
+        self._spill_total = 0
+        self._redispatch_total = 0
+        # router-level observability (docs/observability.md):
+        self._reg = get_registry()
+        self._m_requests = self._reg.counter("serve.router.requests")
+        self._m_dispatched = self._reg.counter("serve.router.dispatched")
+        self._m_redispatched = self._reg.counter("serve.router.redispatched")
+        self._m_spills = self._reg.counter("serve.router.spills")
+        self._m_rejected = self._reg.counter("serve.router.rejected")
+        self._m_deadline = self._reg.counter("serve.router.deadline_cancelled")
+        self._m_live = self._reg.gauge("serve.router.replicas_live")
+        self._m_queue = self._reg.gauge("serve.router.queue_depth")
+        self._m_hit_rate = self._reg.gauge("serve.router.prefix_hit_rate")
+        # cross-thread debug snapshot: the exporter's HTTP thread reads
+        # /debug/router while the pump writes — the ONLY router state that
+        # crosses threads, refreshed at the end of every step()
+        self._debug_lock = threading.Lock()
+        self._debug_doc: Dict[str, Any] = {}  # guarded-by: _debug_lock
+        self._publish_gauges()
+
+    # ------------------------------------------------------------- replicas
+    def _spawn_replica(self) -> ReplicaHandle:
+        rid = f"r{self._next_rid}"
+        self._next_rid += 1
+        # replicas run single-class FIFO with the bounds off — QoS lives at
+        # the router — and carry their rid as the metrics instance label
+        rcfg = replace(
+            self.engine_config, classes="default", queue_bound=0,
+            tenant_max_inflight=0, metrics_label=rid,
+        )
+        eng = InferenceEngine(self._params, self._cfg, rcfg,
+                              programs=self._programs)
+        if self._programs is None:
+            self._programs = eng.programs
+        h = ReplicaHandle(rid=rid, engine=eng,
+                          weights_version=self._weights_version)
+        self.replicas[rid] = h
+        return h
+
+    def add_replica(self) -> ReplicaHandle:
+        """Grow the fleet by one live replica. The new engine shares the
+        compiled-program bundle (zero new traces/compiles — pinned by the
+        router compile-count gate) and serves the LATEST published
+        weights version."""
+        h = self._spawn_replica()
+        _flight_record("router.replica_added", cid=h.rid,
+                       weights_version=h.weights_version)
+        self._publish_gauges()
+        return h
+
+    def remove_replica(self, rid: str) -> ReplicaHandle:
+        """Begin a clean drain: the replica leaves the dispatch rotation
+        immediately, finishes everything already dispatched to it, and
+        detaches once drained (no lost or duplicated requests). Refuses to
+        drain the LAST live replica — a router with work and nowhere to
+        send it would stall."""
+        h = self.replicas[rid]
+        if h.state != STATE_LIVE:
+            raise ValueError(f"replica {rid!r} is {h.state}, not live")
+        if sum(1 for o in self.replicas.values()
+               if o.state == STATE_LIVE) <= 1:
+            raise ValueError("cannot remove the last live replica")
+        h.state = STATE_DRAINING
+        _flight_record("router.replica_draining", cid=rid,
+                       assigned=len(h.assigned))
+        self._publish_gauges()
+        return h
+
+    def kill_replica(self, rid: str, reason: str = "killed") -> None:
+        """Simulate a replica crash (tests, the bench's mid-storm kill
+        drill): the replica is drained out of rotation exactly as if its
+        pump had raised — stranded requests re-dispatched or surfaced
+        terminal, never hung."""
+        self._on_replica_failure(self.replicas[rid], RuntimeError(reason))
+
+    def publish_weights(self, params, version: str) -> str:
+        """Publish a new weights payload under a version tag. Replicas
+        added from now on serve it; existing replicas finish on the
+        version they were built with (in-flight requests never see a
+        mid-stream weight change). A full in-place hot-swap of live
+        replicas plugs in here later (ROADMAP item 4) — the version tag
+        is the interface both sides already agree on."""
+        self._params = params
+        self._weights_version = str(version)
+        _flight_record("router.weights_published", cid=self._weights_version)
+        self._refresh_debug()
+        return self._weights_version
+
+    @property
+    def weights_version(self) -> str:
+        return self._weights_version
+
+    def live_replicas(self) -> List[ReplicaHandle]:
+        return [h for h in self.replicas.values() if h.state == STATE_LIVE]
+
+    # ---------------------------------------------------------------- intake
+    def submit(self, request: Union[Request, Iterable[int]],
+               sampling: Optional[SamplingParams] = None) -> str:
+        """Enqueue a request at the front door. Validation mirrors
+        ``InferenceEngine.submit`` exactly (malformed raises, overloaded
+        load-sheds to a terminal ``rejected`` output) so a single-replica
+        router is behavior-identical to the bare engine."""
+        ec = self.engine_config
+        if not isinstance(request, Request):
+            request = Request(prompt_ids=[int(t) for t in request],
+                              sampling=sampling or SamplingParams())
+        if not request.request_id:
+            while f"req-{self._req_counter}" in self._items:
+                self._req_counter += 1
+            request.request_id = f"req-{self._req_counter}"
+            self._req_counter += 1
+        if request.request_id in self._items:
+            raise ValueError(f"duplicate request id {request.request_id!r}")
+        if not request.prompt_ids:
+            raise ValueError("empty prompt")
+        sp = request.sampling
+        if sp.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(request.prompt_ids) + sp.max_new_tokens
+        if total > ec.max_model_len:
+            raise ValueError(
+                f"prompt+max_new_tokens={total} exceeds max_model_len="
+                f"{ec.max_model_len}"
+            )
+        blocks_needed = -(-total // ec.block_size)
+        if blocks_needed > ec.num_blocks - 1:
+            raise ValueError(
+                f"request needs {blocks_needed} blocks; each replica pool "
+                f"has {ec.num_blocks - 1}"
+            )
+        if request.deadline_s is not None and request.deadline_s < 0:
+            raise ValueError("deadline_s must be >= 0 (None disables)")
+        # unknown priority class raises BEFORE anything registers —
+        # malformed is an error, overloaded is an outcome
+        class_idx = self.qos.resolve_class(
+            getattr(request, "priority", "interactive")
+        )
+        item = _RouterItem(request=request, class_idx=class_idx,
+                           order=self._order_counter)
+        self._order_counter += 1
+        self._m_requests.inc()
+        # front-door admission control: the waiting population is the
+        # router queue PLUS every pumped engine's waiting queue, so with
+        # one replica the bound sheds exactly when the bare engine would
+        if (self.queue_bound
+                and self._total_waiting() >= self.queue_bound) or (
+                self.tenant_max_inflight
+                and self._tenant_inflight(item.tenant)
+                >= self.tenant_max_inflight):
+            out = RequestOutput(
+                request_id=request.request_id,
+                prompt_ids=list(request.prompt_ids),
+            )
+            out.finished = True
+            out.finish_reason = "rejected"
+            item.phase = "done"
+            self._items[request.request_id] = item
+            self._outputs[request.request_id] = out
+            self._rejected_total += 1
+            self._shed_tokens_total += total
+            self._m_rejected.inc()
+            _flight_record("router.rejected", cid=request.request_id)
+            return request.request_id
+        self._items[request.request_id] = item
+        self._queue.append(item)
+        return request.request_id
+
+    def _total_waiting(self) -> int:
+        return len(self._queue) + sum(
+            h.queue_depth() for h in self.replicas.values() if h.pumpable
+        )
+
+    def _tenant_inflight(self, tenant: str) -> int:
+        return sum(1 for it in self._items.values()
+                   if it.phase != "done" and it.tenant == tenant)
+
+    # -------------------------------------------------------------- affinity
+    def _affinity_key(self, prompt_ids) -> int:
+        """crc32 over the prompt's leading block-aligned chunk keys — the
+        exact ``tuple(tokens[i*bs:(i+1)*bs])`` chunks the radix cache keys
+        its tree on, so two prompts that would share cache blocks share an
+        affinity key. Prompts shorter than one block key on the whole
+        prompt (they can't share full blocks anyway)."""
+        bs = self.engine_config.block_size
+        n = min(self.config.affinity_blocks, len(prompt_ids) // bs)
+        if n <= 0:
+            chunks: Any = tuple(int(t) for t in prompt_ids)
+        else:
+            chunks = tuple(
+                tuple(int(t) for t in prompt_ids[i * bs:(i + 1) * bs])
+                for i in range(n)
+            )
+        return zlib.crc32(repr(chunks).encode())
+
+    def _affinity_target(self, key: int,
+                         live: List[ReplicaHandle]) -> ReplicaHandle:
+        """Rendezvous (highest-random-weight) hash: stable under replica
+        add/remove — only keys owned by a departing replica move."""
+        return max(live, key=lambda h: (
+            zlib.crc32(f"{key}:{h.rid}".encode()), h.rid,
+        ))
+
+    def _past_threshold(self, h: ReplicaHandle) -> bool:
+        rc = self.config
+        if rc.spill_queue_depth and h.queue_depth() >= rc.spill_queue_depth:
+            return True
+        if (rc.spill_min_free_seqs
+                and h.free_concurrent_seqs() < rc.spill_min_free_seqs):
+            return True
+        return False
+
+    # ---------------------------------------------------------------- pump
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(
+            (h.engine.has_work or h.assigned)
+            for h in self.replicas.values() if h.pumpable
+        )
+
+    def step(self) -> List[StreamEvent]:
+        """One router tick: expire queued deadlines, dispatch under the
+        QoS pick + affinity/spill policy, pump every live/draining
+        replica one engine tick (a raising replica dies and sheds, never
+        hangs), capture finished outputs, detach drained replicas, and
+        refresh gauges + the /debug/router snapshot."""
+        self._expire_deadlines()
+        self._dispatch()
+        events: List[StreamEvent] = []
+        pump = [h for h in self.replicas.values() if h.pumpable]
+        busy = [h for h in pump if h.engine.has_work]
+        if len(busy) == 1:
+            h = busy[0]
+            try:
+                events.extend(h.engine.step())
+            except Exception as e:  # noqa: BLE001 — a replica failure
+                # must shed to survivors, not take the router down
+                self._on_replica_failure(h, e)
+        elif busy:
+            # pump replicas CONCURRENTLY: each engine is still touched by
+            # exactly one thread at a time (its worker, with a join
+            # barrier before any router bookkeeping reads it back), so the
+            # engine's single-pump-thread contract holds per replica while
+            # the jitted steps — which release the GIL — overlap. This is
+            # where the aggregate throughput scaling comes from; a serial
+            # pump would serialize N device programs behind one core.
+            results: Dict[str, Any] = {}
+
+            def _pump_one(handle: ReplicaHandle) -> None:
+                try:
+                    results[handle.rid] = ("ok", handle.engine.step())
+                except Exception as e:  # noqa: BLE001 — triaged post-join
+                    results[handle.rid] = ("dead", e)
+
+            threads = [
+                threading.Thread(target=_pump_one, args=(h,),
+                                 name=f"router-pump-{h.rid}", daemon=True)
+                for h in busy
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for h in busy:
+                kind, val = results[h.rid]
+                if kind == "ok":
+                    events.extend(val)
+                else:
+                    self._on_replica_failure(h, val)
+        for h in pump:
+            if h.rid in self.replicas:  # skip replicas that died this tick
+                self._capture_finished(h)
+        self._detach_drained()
+        self._publish_gauges()
+        return events
+
+    def generate(self, requests: Optional[Iterable] = None
+                 ) -> Iterator[StreamEvent]:
+        """Streaming interface mirroring the engine's: submit, then yield
+        token events (from every replica) until all in-flight work
+        drains. More requests may be ``submit()``-ed between yields."""
+        for r in requests or ():
+            self.submit(r)
+        while self.has_work:
+            yield from self.step()
+
+    def run(self, requests: Optional[Iterable] = None
+            ) -> Dict[str, RequestOutput]:
+        """Drain ``generate()`` and hand over every terminal output,
+        releasing router bookkeeping for them (same ownership contract as
+        ``InferenceEngine.run``)."""
+        for _ in self.generate(requests):
+            pass
+        done = dict(self._outputs)
+        for rid in done:
+            self._outputs.pop(rid, None)
+            self._items.pop(rid, None)
+        return done
+
+    def pop_output(self, request_id: str) -> Optional[RequestOutput]:
+        """Release and return one finished request's output; refuses while
+        it is still in flight anywhere in the fleet."""
+        item = self._items.get(request_id)
+        if item is not None and item.phase != "done":
+            raise ValueError(f"request {request_id!r} is still in flight")
+        self._items.pop(request_id, None)
+        return self._outputs.pop(request_id, None)
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Cancel wherever the request currently is: parked at the router
+        (terminal output synthesized here) or dispatched (delegated to the
+        owning engine, output captured immediately). False for unknown or
+        already-finished ids."""
+        item = self._items.get(request_id)
+        if item is None or item.phase == "done":
+            return False
+        if item.phase == "queued":
+            self._queue.remove(item)
+            out = RequestOutput(
+                request_id=request_id,
+                prompt_ids=list(item.request.prompt_ids),
+            )
+            out.finished = True
+            out.finish_reason = reason
+            self._finish_item(item, out)
+            return True
+        h = self.replicas.get(item.replica)
+        if h is None or not h.engine.cancel(request_id, reason):
+            return False
+        self._capture_finished(h)
+        return True
+
+    # ------------------------------------------------------------- internals
+    def _expire_deadlines(self) -> None:
+        """Expire ROUTER-queued requests past their deadline (terminal
+        ``deadline`` status) — the engine expires what was dispatched to
+        it, with the clock backdated to router intake so the two waits
+        add up to one deadline."""
+        now = time.perf_counter()
+        for item in [it for it in self._queue
+                     if it.request.deadline_s is not None
+                     and (now - it.submit_time) > it.request.deadline_s]:
+            self._queue.remove(item)
+            out = RequestOutput(
+                request_id=item.request.request_id,
+                prompt_ids=list(item.request.prompt_ids),
+            )
+            out.finished = True
+            out.finish_reason = "deadline"
+            out.deadline_missed = True
+            self._deadline_cancelled_total += 1
+            self._shed_tokens_total += (
+                len(item.request.prompt_ids)
+                + item.request.sampling.max_new_tokens
+            )
+            self._m_deadline.inc()
+            _flight_record("router.deadline", cid=item.request.request_id)
+            self._finish_item(item, out)
+
+    def _dispatch(self) -> None:
+        live = self.live_replicas()
+        if not live:
+            if self._queue and not any(
+                    h.engine.has_work or h.assigned
+                    for h in self.replicas.values() if h.pumpable):
+                # nothing can ever serve the queue again — fail loudly,
+                # mirroring the engine's scheduler-stall invariant, instead
+                # of letting generate() spin on has_work forever
+                raise RuntimeError(
+                    "router stalled: requests queued but no live replicas"
+                )
+            return  # draining replicas may still finish their work
+        while self._queue:
+            # park at the router when every live replica is past the spill
+            # threshold AND the fleet is actually busy — back-pressure
+            # makes the router-level QoS pick decide who goes next. An
+            # idle fleet always accepts (a threshold below the idle
+            # capacity must never stall an empty router).
+            busy = any(h.engine.has_work for h in live)
+            if busy and all(self._past_threshold(h) for h in live):
+                break
+            item = self.qos.pick(self._queue)
+            key = self._affinity_key(item.request.prompt_ids)
+            target = self._affinity_target(key, live)
+            if self._past_threshold(target):
+                spilled = min(live, key=lambda h: (h.queue_depth(), h.rid))
+                if spilled.rid != target.rid:
+                    self._spill_total += 1
+                    self._m_spills.inc()
+                    _flight_record("router.spill",
+                                   cid=item.request.request_id,
+                                   affinity=target.rid, to=spilled.rid)
+                target = spilled
+            self.qos.commit(item)
+            self._queue.remove(item)
+            self._dispatch_to(item, target)
+
+    def _dispatch_to(self, item: _RouterItem, h: ReplicaHandle) -> None:
+        req = item.request
+        h.engine.submit(req)
+        # router-side wait counts toward the deadline exactly like engine
+        # queue wait: one clock, started at user intake
+        h.engine.backdate_submit_time(req.request_id, item.submit_time)
+        item.phase = "dispatched"
+        item.replica = h.rid
+        h.assigned.add(req.request_id)
+        h.dispatched += 1
+        self._m_dispatched.inc()
+        _flight_record("router.dispatch", cid=req.request_id, replica=h.rid)
+
+    def _capture_finished(self, h: ReplicaHandle) -> None:
+        """Pull every terminal output off a replica. Runs after each pump
+        tick AND on demand (cancel), and covers event-less terminals too
+        (deadline/cancel inside the engine emit no StreamEvent)."""
+        for rid_ in list(h.assigned):
+            out = h.engine.get_output(rid_)
+            if out is not None and out.finished:
+                h.engine.pop_output(rid_)
+                h.assigned.discard(rid_)
+                self._finish_item(self._items[rid_], out)
+
+    def _finish_item(self, item: _RouterItem, out: RequestOutput) -> None:
+        item.phase = "done"
+        item.replica = ""
+        self._outputs[out.request_id] = out
+
+    def _on_replica_failure(self, h: ReplicaHandle, exc: Exception) -> None:
+        """Drain a dead replica out of rotation, exactly-once per stranded
+        request: finished on the dead engine -> captured as-is; nothing
+        streamed yet -> re-dispatched at the FRONT of the router queue in
+        original arrival order; tokens already streamed -> terminal
+        ``cancelled`` keeping what was delivered. Never hung."""
+        if h.state == STATE_DEAD:
+            return
+        h.state = STATE_DEAD
+        h.fail_reason = repr(exc)
+        self.replicas.pop(h.rid, None)
+        self.retired.append(h)
+        logger.warning("router: replica %s died (%s); %d stranded requests",
+                       h.rid, exc, len(h.assigned))
+        _flight_record("router.replica_dead", cid=h.rid, error=repr(exc),
+                       stranded=len(h.assigned))
+        requeue: List[_RouterItem] = []
+        for rid_ in list(h.assigned):
+            item = self._items[rid_]
+            out = h.engine.get_output(rid_)
+            if out is not None and out.finished:
+                self._finish_item(item, out)
+            elif out is None or not out.token_ids:
+                item.phase = "queued"
+                item.replica = ""
+                requeue.append(item)
+                h.redispatched += 1
+                self._redispatch_total += 1
+                self._m_redispatched.inc()
+                _flight_record("router.redispatch", cid=rid_,
+                               from_replica=h.rid)
+            else:
+                out.finished = True
+                out.finish_reason = "cancelled"
+                self._shed_tokens_total += (
+                    item.request.sampling.max_new_tokens - len(out.token_ids)
+                )
+                self._finish_item(item, out)
+        h.assigned.clear()
+        # front of the queue, original arrival order — like a preemption
+        # requeue, a victim of infrastructure never loses its place
+        self._queue[:0] = sorted(requeue, key=lambda it: it.order)
+        self._publish_gauges()
+
+    def _detach_drained(self) -> None:
+        for h in [h for h in self.replicas.values()
+                  if h.state == STATE_DRAINING
+                  and not h.engine.has_work and not h.assigned]:
+            h.state = STATE_DETACHED
+            self.replicas.pop(h.rid, None)
+            self.retired.append(h)
+            _flight_record("router.replica_detached", cid=h.rid)
+
+    # ---------------------------------------------------------------- stats
+    def _publish_gauges(self) -> None:
+        live = [h for h in self.replicas.values() if h.state == STATE_LIVE]
+        self._m_live.set(len(live))
+        self._m_queue.set(len(getattr(self, "_queue", ())))
+        cached = prompts = 0
+        for h in self.replicas.values():
+            if not h.pumpable:
+                continue
+            # lifetime totals; pump-thread-private engine fields are safe
+            # to read here — the router IS the pump thread
+            cached += h.engine._cached_tokens_total
+            prompts += h.engine._prompt_tokens_total
+            self._reg.gauge(
+                f"serve.router.{h.rid}.queue_depth"
+            ).set(h.queue_depth())
+        self._m_hit_rate.set(cached / max(1, prompts))
+        self._refresh_debug()
+
+    def _refresh_debug(self) -> None:
+        doc = {
+            "replicas": [h.status_doc() for h in self.replicas.values()],
+            "retired": [h.status_doc() for h in self.retired],
+            "queue_depth": len(self._queue),
+            "weights_version": self._weights_version,
+            "rejected": self._rejected_total,
+            "deadline_cancelled": self._deadline_cancelled_total,
+            "spills": self._spill_total,
+            "redispatched": self._redispatch_total,
+        }
+        with self._debug_lock:
+            self._debug_doc = doc
+
+    def debug_doc(self) -> Dict[str, Any]:
+        """Thread-safe snapshot for ``/debug/router`` (exporter HTTP
+        thread); refreshed by the pump at the end of every step."""
+        with self._debug_lock:
+            return dict(self._debug_doc)
+
+    def metrics(self, reset_window: bool = True) -> Dict[str, Any]:
+        """Fleet-aggregated metrics, same keys as the engine's plus
+        router-level outcomes and a ``per_replica`` breakdown. Rates sum
+        across replicas; the hit rate is token-weighted."""
+        per: Dict[str, Dict[str, float]] = {}
+        for h in self.replicas.values():
+            if h.pumpable:
+                per[h.rid] = h.engine.metrics(reset_window=reset_window)
+        agg: Dict[str, Any] = {
+            "queue_depth": float(len(self._queue)) + sum(
+                m["queue_depth"] for m in per.values()
+            ),
+            "num_running": sum(m["num_running"] for m in per.values()),
+            "generated_tokens": sum(
+                m["generated_tokens"] for m in per.values()
+            ),
+            "decode_tokens_per_sec": sum(
+                m["decode_tokens_per_sec"] for m in per.values()
+            ),
+            "goodput_tokens": sum(m["goodput_tokens"] for m in per.values()),
+            "goodput_tokens_per_sec": sum(
+                m["goodput_tokens_per_sec"] for m in per.values()
+            ),
+            "prefix_hit_rate": (
+                sum(m["cached_tokens"] for m in per.values())
+                / max(1, sum(m["prompt_tokens"] for m in per.values()))
+            ),
+            "cached_tokens": sum(m["cached_tokens"] for m in per.values()),
+            "prompt_tokens": sum(m["prompt_tokens"] for m in per.values()),
+            "preemptions": sum(m["preemptions"] for m in per.values()),
+            # engine-side rejects are structurally 0 (bounds live here)
+            "rejected": float(self._rejected_total),
+            "shed_tokens": float(self._shed_tokens_total) + sum(
+                m["shed_tokens"] for m in per.values()
+            ),
+            "deadline_misses": float(self._deadline_cancelled_total) + sum(
+                m["deadline_misses"] for m in per.values()
+            ),
+            "spills": float(self._spill_total),
+            "redispatched": float(self._redispatch_total),
+            "replicas_live": float(len(self.live_replicas())),
+            "per_replica": per,
+        }
+        return agg

@@ -110,6 +110,114 @@ def parse_classes(spec: Union[str, Sequence, None]
     return entries
 
 
+class QoSPicker:
+    """The QoS admission policy, factored out of :class:`Scheduler` so the
+    scale-out router (``serving/router.py``) runs the IDENTICAL discipline
+    over its own dispatch queue: per-class stride scheduling (pass values
+    advance by ``1/weight`` per pick, a newly active class floored at the
+    current virtual time so it can't burst on stale credit) with
+    unit-quantum deficit-round-robin across each class's tenants.
+
+    Items are duck-typed: anything carrying ``class_idx`` and ``tenant``
+    attributes (the scheduler picks :class:`SequenceState`, the router its
+    own queue entries). ``pick`` is pure — selection state commits in
+    :meth:`commit` only once the caller actually takes the candidate, so a
+    head-of-line wait never burns stride or tenant credit."""
+
+    def __init__(self, classes: Optional[Sequence[Tuple[str, int]]] = None):
+        # classes, highest priority first. None (or a single class) is the
+        # seed FIFO policy: one queue, any priority label accepted.
+        self.classes = list(classes) if classes else None
+        self._weights = {i: w for i, (_, w) in enumerate(self.classes or ())}
+        self._class_idx = {n: i for i, (n, _) in
+                           enumerate(self.classes or ())}
+        # stride-scheduling state across classes: pass values advance by
+        # 1/weight per admission; _vtime floors a newly active class so an
+        # idle class can't burst on stale credit
+        self._pass: Dict[int, float] = {}
+        self._vtime = 0.0
+        # per-(class, tenant) served counts (unit-quantum DRR) + per-class
+        # floor a newly active tenant joins at
+        self._tenant_served: Dict[Tuple[int, str], int] = {}
+        self._tenant_floor: Dict[int, int] = {}
+
+    @property
+    def single_class(self) -> bool:
+        return self.classes is None or len(self.classes) == 1
+
+    def resolve_class(self, priority: str) -> int:
+        """Class index for a request's priority label. A single-class (or
+        class-less) picker accepts ANY label into its one queue — the
+        seed-FIFO configuration; a multi-class one refuses unknown labels
+        loudly (a typo'd priority silently landing in the wrong tier would
+        be an SLO bug nobody can see)."""
+        if self.single_class:
+            return 0
+        try:
+            return self._class_idx[priority]
+        except KeyError:
+            raise ValueError(
+                f"unknown priority class {priority!r}; configured classes: "
+                f"{[n for n, _ in self.classes]}"
+            ) from None
+
+    def pick(self, waiting: Sequence[Any]) -> Optional[Any]:
+        """Next candidate from ``waiting`` (arrival order, front first)
+        under the QoS policy. Pure — commit separately."""
+        if not waiting:
+            return None
+        if self.single_class:
+            return waiting[0]  # seed FIFO exactly
+        # stride pick across active classes: lowest effective pass wins,
+        # ties break toward the higher-priority (earlier) class. An idle
+        # class's stale pass is floored at _vtime so it can't burst.
+        active = sorted({s.class_idx for s in waiting})
+        c = min(active, key=lambda i: (max(self._pass.get(i, 0.0),
+                                           self._vtime), i))
+        # unit-quantum DRR across the class's active tenants: lowest served
+        # count wins, ties break toward the earliest-waiting tenant
+        order: List[str] = []
+        for s in waiting:
+            if s.class_idx == c and s.tenant not in order:
+                order.append(s.tenant)
+        t = min(order, key=lambda tn: (
+            max(self._tenant_served.get((c, tn), 0),
+                self._tenant_floor.get(c, 0)),
+            order.index(tn),
+        ))
+        for s in waiting:
+            if s.class_idx == c and s.tenant == t:
+                return s
+        raise AssertionError("picked (class, tenant) has no waiting seq")
+
+    def commit(self, item: Any) -> None:
+        """Charge the taken candidate's class stride + tenant credit (the
+        caller removes it from its own waiting order)."""
+        if self.single_class:
+            return
+        c = item.class_idx
+        base = max(self._pass.get(c, 0.0), self._vtime)
+        self._vtime = base
+        self._pass[c] = base + 1.0 / self._weights[c]
+        served = max(self._tenant_served.get((c, item.tenant), 0),
+                     self._tenant_floor.get(c, 0))
+        self._tenant_served[(c, item.tenant)] = served + 1
+        # newly active tenants join at the level of the last pick: fair
+        # from now on, no retroactive catch-up burst
+        self._tenant_floor[c] = served
+
+    def prune_tenant(self, tenant: str) -> None:
+        """Drop a fully-drained tenant's DRR credit entries: a long-running
+        server sees unboundedly many distinct tenant ids, and keeping one
+        counter per (class, tenant) forever would leak. Safe for fairness —
+        a rejoining tenant is re-floored at the class's current credit
+        level (``max(served, _tenant_floor[c])``), exactly as if its stale
+        entry had been kept. The caller checks the tenant really is
+        drained (no waiting or running work) before calling."""
+        for key in [k for k in self._tenant_served if k[1] == tenant]:
+            del self._tenant_served[key]
+
+
 @dataclass
 class SequenceState:
     """Host-side runtime state of one request (survives preemption)."""
@@ -194,19 +302,10 @@ class Scheduler:
         self.tracer = tracer
         # QoS classes, highest priority first. None (or a single class) is
         # the seed scheduler: one FIFO queue, any priority label admitted.
-        self.classes = list(classes) if classes else None
-        self._weights = {i: w for i, (_, w) in enumerate(self.classes or ())}
-        self._class_idx = {n: i for i, (n, _) in
-                          enumerate(self.classes or ())}
-        # stride-scheduling state across classes: pass values advance by
-        # 1/weight per admission; _vtime floors a newly active class so an
-        # idle class can't burst on stale credit
-        self._pass: Dict[int, float] = {}
-        self._vtime = 0.0
-        # per-(class, tenant) served counts (unit-quantum DRR) + per-class
-        # floor a newly active tenant joins at
-        self._tenant_served: Dict[Tuple[int, str], int] = {}
-        self._tenant_floor: Dict[int, int] = {}
+        # The stride/DRR policy itself lives in QoSPicker so the scale-out
+        # router shares the one implementation.
+        self.qos = QoSPicker(classes)
+        self.classes = self.qos.classes
         # admission control: 0 disables either bound (seed behavior)
         self.queue_bound = queue_bound
         self.tenant_max_inflight = tenant_max_inflight
@@ -243,20 +342,10 @@ class Scheduler:
         return [(i, s) for i, s in enumerate(self.slots) if s is not None]
 
     def resolve_class(self, priority: str) -> int:
-        """Class index for a request's priority label. A single-class (or
-        class-less) scheduler accepts ANY label into its one queue — the
-        seed-FIFO configuration; a multi-class one refuses unknown labels
-        loudly (a typo'd priority silently landing in the wrong tier would
-        be an SLO bug nobody can see)."""
-        if self.classes is None or len(self.classes) == 1:
-            return 0
-        try:
-            return self._class_idx[priority]
-        except KeyError:
-            raise ValueError(
-                f"unknown priority class {priority!r}; configured classes: "
-                f"{[n for n, _ in self.classes]}"
-            ) from None
+        """Class index for a request's priority label (delegates to the
+        shared :class:`QoSPicker`: single-class accepts any label — the
+        seed-FIFO configuration — multi-class refuses unknown ones)."""
+        return self.qos.resolve_class(priority)
 
     def tenant_inflight(self, tenant: str) -> int:
         """Waiting + running sequences charged to one tenant (the in-flight
@@ -288,51 +377,17 @@ class Scheduler:
 
     # ------------------------------------------------------------- QoS pick
     def _pick_candidate(self) -> Optional[SequenceState]:
-        """Next admission candidate under the QoS policy. Pure — selection
-        state commits in :meth:`_commit_pick` only after the candidate's
-        blocks actually fit, so a head-of-line wait doesn't burn credit."""
-        if not self._waiting:
-            return None
-        if self.classes is None or len(self.classes) == 1:
-            return self._waiting[0]  # seed FIFO exactly
-        # stride pick across active classes: lowest effective pass wins,
-        # ties break toward the higher-priority (earlier) class. An idle
-        # class's stale pass is floored at _vtime so it can't burst.
-        active = sorted({s.class_idx for s in self._waiting})
-        c = min(active, key=lambda i: (max(self._pass.get(i, 0.0),
-                                           self._vtime), i))
-        # unit-quantum DRR across the class's active tenants: lowest served
-        # count wins, ties break toward the earliest-waiting tenant
-        order: List[str] = []
-        for s in self._waiting:
-            if s.class_idx == c and s.tenant not in order:
-                order.append(s.tenant)
-        t = min(order, key=lambda tn: (
-            max(self._tenant_served.get((c, tn), 0),
-                self._tenant_floor.get(c, 0)),
-            order.index(tn),
-        ))
-        for s in self._waiting:
-            if s.class_idx == c and s.tenant == t:
-                return s
-        raise AssertionError("picked (class, tenant) has no waiting seq")
+        """Next admission candidate under the QoS policy (the shared
+        :class:`QoSPicker`). Pure — selection state commits in
+        :meth:`_commit_pick` only after the candidate's blocks actually
+        fit, so a head-of-line wait doesn't burn credit."""
+        return self.qos.pick(self._waiting)
 
     def _commit_pick(self, seq: SequenceState) -> None:
         """Remove the admitted candidate from the waiting order and charge
         its class stride + tenant credit."""
         self._waiting.remove(seq)
-        if self.classes is None or len(self.classes) == 1:
-            return
-        c = seq.class_idx
-        base = max(self._pass.get(c, 0.0), self._vtime)
-        self._vtime = base
-        self._pass[c] = base + 1.0 / self._weights[c]
-        served = max(self._tenant_served.get((c, seq.tenant), 0),
-                     self._tenant_floor.get(c, 0))
-        self._tenant_served[(c, seq.tenant)] = served + 1
-        # newly active tenants join at the level of the last pick: fair
-        # from now on, no retroactive catch-up burst
-        self._tenant_floor[c] = served
+        self.qos.commit(seq)
 
     def admit(self) -> List[SequenceState]:
         """Fill free slots from the waiting queue (QoS pick; plain FIFO
@@ -545,15 +600,11 @@ class Scheduler:
         self._prune_tenant(seq.tenant)
 
     def _prune_tenant(self, tenant: str) -> None:
-        """Drop a fully-drained tenant's DRR credit entries: a long-running
-        server sees unboundedly many distinct tenant ids, and keeping one
-        counter per (class, tenant) forever would leak. Safe for fairness —
-        a rejoining tenant is re-floored at the class's current credit
-        level (``max(served, _tenant_floor[c])``), exactly as if its stale
-        entry had been kept."""
+        """Drop a fully-drained tenant's DRR credit entries (the leak
+        guard lives in :class:`QoSPicker`; the drained check — no waiting
+        or running work left — is the scheduler's)."""
         if any(s.tenant == tenant for s in self._waiting):
             return
         if any(s.tenant == tenant for _, s in self.running()):
             return
-        for key in [k for k in self._tenant_served if k[1] == tenant]:
-            del self._tenant_served[key]
+        self.qos.prune_tenant(tenant)
