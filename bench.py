@@ -810,6 +810,8 @@ def run_serve_open_loop_bench(
     shared_prefix_groups: int = 1,
     replicas: int = 1,
     replica_kill_at_s: float = 0.0,
+    chaos_seed: int = -1,
+    chaos_stall_s: float = 2.0,
     _model=None,
 ) -> dict:
     """Open-loop Poisson overload bench: arrivals fire on a fixed schedule
@@ -856,6 +858,19 @@ def run_serve_open_loop_bench(
     replica that many seconds into each router rate — the mid-storm
     fault drill (survivors absorb re-dispatched work, the entry reports
     ``redispatched``/``cancelled``).
+
+    ``chaos_seed`` (BENCH_SERVE_CHAOS, >= 0 enables) adds the chaos soak
+    leg: a seeded deterministic fault schedule (``resilience/chaos.py`` —
+    replica kills + hang/delay/exception across the serve fault points)
+    fires over a self-healing fleet (wedge detection at ``chaos_stall_s``,
+    respawn + probation enabled) while the same Poisson storm replays
+    twice — once fault-free, once under chaos. The entry reports the
+    fleet invariants (no lost/duplicated ids, zero leaked blocks per
+    survivor, fleet restored to full live count) and ``goodput_ratio``
+    (chaos / fault-free; the acceptance floor is 0.7). The PR-17 kill
+    drill (``replica_kill_at_s``) deliberately keeps respawns OFF — it
+    measures the *degraded* fleet; the chaos leg measures the *healing*
+    one.
 
     ``_model`` injects a prebuilt ``(params, cfg)`` (tier-1 CPU smoke uses
     a tiny model); by default the ``preset`` model is built fresh."""
@@ -1025,9 +1040,14 @@ def run_serve_open_loop_bench(
         mid-storm replica kill."""
         from veomni_tpu.serving import Router, RouterConfig
 
+        # respawns stay OFF here: this leg measures the DEGRADED fleet
+        # (how survivors absorb a kill), not the healing one — the chaos
+        # leg below owns resurrection. Pump workers heartbeat per replica
+        # so a wedged replica is nameable from the stall JSON.
         router = Router(params, cfg, engine_cfg(
             queue_bound=queue_bound * n_replicas, classes=classes,
-        ), RouterConfig(replicas=n_replicas))
+        ), RouterConfig(replicas=n_replicas, max_respawns=0,
+                        heartbeat_dir=_bench_out_dir()))
         # compiled programs are SHARED across replicas: one warmup pass
         # through the router compiles for the whole fleet
         for r in warm:
@@ -1170,6 +1190,75 @@ def run_serve_open_loop_bench(
             "shared_prefix": shared_prefix,
             "router_sweep": r_sweep,
         })
+    if chaos_seed >= 0:
+        # chaos soak: the same storm replayed fault-free and under a
+        # seeded deterministic fault schedule against a SELF-HEALING
+        # fleet; the seed in the report replays a failure bit-for-bit
+        from veomni_tpu.resilience.chaos import (
+            build_chaos_plan,
+            run_chaos_soak,
+        )
+        from veomni_tpu.serving import Router, RouterConfig
+
+        n_rep = replicas if replicas > 1 else 3
+        chaos_rate = max(rates)
+        arng = np.random.default_rng((seed, 777))
+        chaos_arrivals = [float(t) for t in np.cumsum(
+            arng.exponential(1.0 / chaos_rate, size=n_requests))]
+
+        def chaos_factory():
+            router = Router(params, cfg, engine_cfg(
+                queue_bound=queue_bound * n_rep, classes=classes,
+            ), RouterConfig(replicas=n_rep, replica_stall_ticks=2,
+                            max_respawns=4, respawn_backoff_s=0.05,
+                            respawn_backoff_max_s=0.5,
+                            probation_requests=2,
+                            heartbeat_dir=_bench_out_dir()))
+            # warm under the default forgiving stall deadline — compiles
+            # must not read as wedges — then run the warm set AGAIN: the
+            # prefix-cache hits route through the chunked-prefill program,
+            # which otherwise first compiles mid-storm and trips the
+            # tightened deadline below
+            for _ in range(2):
+                router.run([Request(prompt_ids=list(r.prompt_ids),
+                                    sampling=r.sampling,
+                                    priority=r.priority) for r in warm])
+            router.config.replica_stall_s = chaos_stall_s
+            return router
+
+        plan = build_chaos_plan(
+            chaos_seed, duration_s=chaos_arrivals[-1],
+            hang_seconds=2.0 * chaos_stall_s + 1.0,
+            expected_ticks=max(50, (n_requests * max_new_tokens) // 8),
+        )
+        base_soak = run_chaos_soak(
+            router_factory=chaos_factory, requests=clone_requests(proto),
+            arrivals=chaos_arrivals, plan=None, restore_timeout_s=60.0)
+        _beat(phase="serve_chaos_fault_free")
+        chaos_soak = run_chaos_soak(
+            router_factory=chaos_factory, requests=clone_requests(proto),
+            arrivals=chaos_arrivals, plan=plan, restore_timeout_s=60.0)
+        _beat(phase="serve_chaos")
+        ratio = (chaos_soak["goodput_tok_s"]
+                 / max(base_soak["goodput_tok_s"], 1e-9))
+
+        def _slim(rep):
+            return {k: v for k, v in rep.items()
+                    if k not in ("outputs", "router")}
+
+        result["chaos"] = {
+            "seed": chaos_seed,
+            "replicas": n_rep,
+            "stall_s": chaos_stall_s,
+            "arrival_rate_rps": chaos_rate,
+            "plan": plan.to_doc(),
+            "fault_free": _slim(base_soak),
+            "chaos": _slim(chaos_soak),
+            "goodput_ratio": ratio,
+            "ok": bool(base_soak["invariants_ok"]
+                       and chaos_soak["invariants_ok"]
+                       and ratio >= 0.7),
+        }
     return result
 
 
@@ -1221,6 +1310,14 @@ def _serve_open_loop_main(preset: str, watchdog=None):
         replicas=int(os.environ.get("BENCH_SERVE_REPLICAS", 1)),
         replica_kill_at_s=float(
             os.environ.get("BENCH_SERVE_REPLICA_KILL_AT_S", 0.0)
+        ),
+        # BENCH_SERVE_CHAOS=<seed> adds the chaos soak leg: a seeded
+        # deterministic kill/hang/delay/exception schedule over a
+        # self-healing fleet (3 replicas unless BENCH_SERVE_REPLICAS
+        # says otherwise), reported against a fault-free replay
+        chaos_seed=int(os.environ.get("BENCH_SERVE_CHAOS", -1)),
+        chaos_stall_s=float(
+            os.environ.get("BENCH_SERVE_CHAOS_STALL_S", 2.0)
         ),
     )
     if watchdog is not None:
@@ -1284,6 +1381,19 @@ def _serve_open_loop_main(preset: str, watchdog=None):
                 for entry in r["router_sweep"]
             ],
         } if "router_sweep" in r else {}),
+        # chaos soak leg when BENCH_SERVE_CHAOS is set: the seeded plan,
+        # both soak reports (fault-free + chaos), the fleet invariants
+        # and the goodput floor verdict
+        **({
+            "chaos": {
+                k: (round(v, 5) if isinstance(v, float) else v)
+                for k, v in r["chaos"].items()
+                if k not in ("fault_free", "chaos")
+            },
+            "chaos_invariants_ok": r["chaos"]["chaos"]["invariants_ok"],
+            "chaos_wedged": r["chaos"]["chaos"]["wedged"],
+            "chaos_respawns": r["chaos"]["chaos"]["respawns"],
+        } if "chaos" in r else {}),
     }), flush=True)
     _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 

@@ -79,7 +79,10 @@ def merge_fleet(dirpath, now=None):
     ranks = {}
 
     def rankdoc(r):
-        return ranks.setdefault(int(r), {"rank": int(r)})
+        # trainer ranks are ints; serving router pump heartbeats carry
+        # their replica id (e.g. "r0") as the rank
+        key = int(r) if str(r).lstrip("-").isdigit() else str(r)
+        return ranks.setdefault(key, {"rank": key})
 
     # 1. metrics JSONL: already wall-stamped per line
     for rank, path in metrics_files.items():
@@ -150,7 +153,8 @@ def merge_fleet(dirpath, now=None):
                and "fleet.slowest_rank" in e["payload"]]
     if slowest:
         verdict["telemetry_slowest_rank"] = int(slowest[-1])
-    return {"ranks": [ranks[r] for r in sorted(ranks)], "events": events,
+    order = sorted(ranks, key=lambda r: (isinstance(r, str), r))
+    return {"ranks": [ranks[r] for r in order], "events": events,
             "verdict": verdict}
 
 

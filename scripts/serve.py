@@ -45,7 +45,17 @@ scale-out router — prefix-affinity dispatch over N data-parallel engine
 replicas sharing one compiled-program bundle, QoS admission at the
 router, per-replica ``serve.rK.*`` metrics and a per-replica status
 census in the final JSON; 1 = the bare engine, byte-identical to the
-seed driver), VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD).
+seed driver), VEOMNI_SERVE_OUT (post-mortem dump dir, default CWD; when
+set, router pump workers also heartbeat there as heartbeat-<rid>.json).
+Self-healing fleet knobs (router mode, docs/serving.md):
+VEOMNI_SERVE_STALL_S (per-replica step() deadline before a replica is
+declared wedged and its pump thread abandoned, default 60, 0 disables),
+VEOMNI_SERVE_MAX_RESPAWNS (respawn budget per replica lineage before
+permanent retirement, default 2, 0 disables resurrection),
+VEOMNI_SERVE_PROBATION (clean completions a respawned replica must serve
+on spill traffic before rejoining affinity rotation, default 2),
+VEOMNI_SERVE_MIN_LIVE (live-replica floor under which /healthz answers
+503, default 1).
 VEOMNI_METRICS_PORT
 serves Prometheus /metrics + /healthz while the pump runs (healthz carries
 rejected/deadline-miss counts); /debug/requests
@@ -208,8 +218,22 @@ def main():
     if args.replicas > 1:
         from veomni_tpu.serving import Router, RouterConfig
 
-        router = Router(params, cfg, ecfg,
-                        RouterConfig(replicas=args.replicas))
+        # self-healing knobs (docs/serving.md "Self-healing fleet"):
+        # wedge deadline, respawn budget, probation length, and the live
+        # floor under which /healthz flips 503. Heartbeats only when the
+        # operator chose an artifact dir — the CLI default CWD ('.')
+        # would litter launch directories with heartbeat files.
+        router = Router(params, cfg, ecfg, RouterConfig(
+            replicas=args.replicas,
+            replica_stall_s=float(
+                os.environ.get("VEOMNI_SERVE_STALL_S", 60.0)),
+            max_respawns=int(
+                os.environ.get("VEOMNI_SERVE_MAX_RESPAWNS", 2)),
+            probation_requests=int(
+                os.environ.get("VEOMNI_SERVE_PROBATION", 2)),
+            min_live=int(os.environ.get("VEOMNI_SERVE_MIN_LIVE", 1)),
+            heartbeat_dir=os.environ.get("VEOMNI_SERVE_OUT", ""),
+        ))
         # any replica describes the per-replica pool; all are identical
         first = next(iter(router.replicas.values())).engine
         driver, cap_engine = router, first
@@ -260,18 +284,20 @@ def main():
                 doc["finished"].extend(snap.get("finished", ()))
             return doc
 
-        exporter = maybe_start_from_env(health_fn=lambda: {
-            "healthy": True,
-            "queue_depth":
-                get_registry().gauge("serve.router.queue_depth").value,
-            "replicas_live":
-                get_registry().gauge("serve.router.replicas_live").value,
-            "rejected":
-                get_registry().counter("serve.router.rejected").value,
-            "deadline_cancelled": get_registry().counter(
-                "serve.router.deadline_cancelled").value,
-        }, requests_fn=_requests_fn, memory_fn=cap_engine.kv_capacity,
-            router_fn=router.debug_doc)
+        def _health_fn():
+            # router.health() is a thread-safe snapshot read: healthy
+            # flips False — exporter answers 503 — while the live count
+            # sits under min_live, and recovers when respawns land
+            doc = router.health()
+            reg = get_registry()
+            doc["rejected"] = reg.counter("serve.router.rejected").value
+            doc["deadline_cancelled"] = reg.counter(
+                "serve.router.deadline_cancelled").value
+            return doc
+
+        exporter = maybe_start_from_env(
+            health_fn=_health_fn, requests_fn=_requests_fn,
+            memory_fn=cap_engine.kv_capacity, router_fn=router.debug_doc)
     else:
         exporter = maybe_start_from_env(health_fn=lambda: {
             "healthy": True,

@@ -45,6 +45,20 @@ echo "LINT rc=${lint_rc}"
 if [ "$lint_rc" -ne 0 ]; then
   rc=$lint_rc
 fi
+# Bounded chaos smoke (scripts/chaos_smoke.py, docs/testing.md): the
+# fixed-seed self-healing fleet drill — kill + hang + delay/exception over
+# 3 replicas, fleet invariants + goodput floor checked against a fault-free
+# replay. ~50s on CPU; the 120s timeout is headroom, not budget. Runs
+# before the shard loop for the same reason lint does: a broken resurrect
+# path fails fast, and a smoke failure never hides a shard regression.
+chaos_log="$LOG_DIR/_t1_chaos.log"
+timeout -k 5 120 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py \
+  2>&1 | tee "$chaos_log"
+chaos_rc=${PIPESTATUS[0]}
+echo "CHAOS_SMOKE rc=${chaos_rc}"
+if [ "$chaos_rc" -ne 0 ] && [ "$rc" -eq 0 ]; then
+  rc=$chaos_rc
+fi
 for k in $(seq 1 "$SHARDS"); do
   log="$LOG_DIR/_t1_shard${k}of${SHARDS}.log"
   rm -f "$log"

@@ -305,16 +305,24 @@ def test_replica_kill_mid_storm_sheds_never_corrupts(qwen3):
 
 
 def test_router_stalls_loudly_with_no_live_replicas(qwen3):
+    """With resurrection disabled (max_respawns=0), losing every replica
+    still fails loudly — but only AFTER every queued request got a
+    terminal REJECTED output, so a run()/pop_output caller is never left
+    blocking on a request that can no longer be served."""
     params, cfg = qwen3
     r = Router(params, cfg, EngineConfig(
         num_slots=1, block_size=8, max_model_len=32,
-    ), RouterConfig(replicas=2))
-    r.submit(Request(prompt_ids=[1, 2, 3],
-                     sampling=SamplingParams(max_new_tokens=2)))
+    ), RouterConfig(replicas=2, max_respawns=0))
+    rid = r.submit(Request(prompt_ids=[1, 2, 3],
+                           sampling=SamplingParams(max_new_tokens=2)))
     for h in list(r.live_replicas()):
         r.kill_replica(h.rid)
     with pytest.raises(RuntimeError, match="no live replicas"):
         r.step()
+    out = r.pop_output(rid)
+    assert out is not None and out.finished
+    assert out.finish_reason == "rejected"
+    assert not r.has_work  # nothing left parked or in flight
 
 
 # -------------------------------------------------------------- elasticity

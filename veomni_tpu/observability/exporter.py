@@ -121,7 +121,12 @@ class MetricsExporter:
     ``port=0`` binds an ephemeral port (tests); :meth:`start` returns the
     actual port. ``health_fn`` returns a JSON-serializable dict; a falsy
     ``"healthy"`` key turns the response into a 503 so load balancers and
-    probes need no body parsing."""
+    probes need no body parsing. The 503 must be *recoverable*: callers
+    wire live state, not a latched flag — the serving router's
+    ``health()`` (scripts/serve.py) flips unhealthy while its live
+    replica count sits under ``min_live`` and back to 200 once respawned
+    replicas clear probation, so a probe watching this endpoint sees the
+    self-healing cycle, not a tombstone."""
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
                  registry: Optional[MetricsRegistry] = None,

@@ -25,7 +25,10 @@ the gap three ways:
    failure mode, where no in-band exchange can run — is diagnosable from
    OUTSIDE the process: its heartbeat age keeps growing while its
    neighbors' stay fresh. ``scripts/fleet.py`` and the bench's stall JSON
-   read these.
+   read these. The "rank" may also be a string — the serving router's
+   per-replica pump workers beat as ``heartbeat-<rid>.json`` (phase
+   ``serve_pump``), so a replica wedged inside ``engine.step()`` is
+   nameable from outside the process exactly like a wedged trainer rank.
 
 3. **``/debug/fleet``** (exporter): the local rank's last exchanged skew
    table, every heartbeat visible in the heartbeat dir (on a shared
@@ -52,7 +55,22 @@ from veomni_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-HEARTBEAT_RE = re.compile(r"^heartbeat-(\d+)\.json$")
+# rank is numeric for trainer processes; serving router pump workers beat
+# under their replica id (e.g. heartbeat-r0.json)
+HEARTBEAT_RE = re.compile(r"^heartbeat-([A-Za-z0-9_.\-]+)\.json$")
+
+
+def _rank_value(rank: Any) -> Any:
+    """Numeric ranks stay ints (trainer semantics: sorting, slowest-rank
+    gauges); anything else is a string identity."""
+    s = str(rank)
+    return int(s) if s.lstrip("-").isdigit() else s
+
+
+def _rank_sort_key(rank: Any):
+    """Ints first in numeric order, then string ranks lexically — a mixed
+    trainer + serving heartbeat dir must not TypeError a sort."""
+    return (1, rank) if isinstance(rank, str) else (0, rank)
 
 #: heartbeat older than this many seconds reads as stale in
 #: :func:`heartbeat_ages` (callers may pass their own threshold — the bench
@@ -61,11 +79,11 @@ DEFAULT_STALE_S = 120.0
 
 
 # ----------------------------------------------------------------- heartbeats
-def heartbeat_path(dirpath: str, rank: int) -> str:
+def heartbeat_path(dirpath: str, rank: Any) -> str:
     return os.path.join(dirpath, f"heartbeat-{rank}.json")
 
 
-def write_heartbeat(dirpath: str, *, rank: Optional[int] = None,
+def write_heartbeat(dirpath: str, *, rank: Optional[Any] = None,
                     global_step: int = 0, step_time_s: float = 0.0,
                     phase: str = "train",
                     extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
@@ -80,7 +98,7 @@ def write_heartbeat(dirpath: str, *, rank: Optional[int] = None,
         rank = _process_index()
     doc = {
         "schema": 1,
-        "rank": int(rank),
+        "rank": _rank_value(rank),
         "pid": os.getpid(),
         "wall_time_s": time.time(),
         "global_step": int(global_step),
@@ -120,9 +138,9 @@ def read_heartbeats(dirpath: str) -> List[Dict[str, Any]]:
                 doc = json.load(f)
         except (OSError, ValueError):
             continue
-        doc.setdefault("rank", int(m.group(1)))
+        doc.setdefault("rank", _rank_value(m.group(1)))
         out.append(doc)
-    out.sort(key=lambda d: d.get("rank", 0))
+    out.sort(key=lambda d: _rank_sort_key(d.get("rank", 0)))
     return out
 
 

@@ -401,6 +401,40 @@ class MetricsRegistry:
             self._last_export = None
 
 
+class _FencedInstrument:
+    """Write-dropping proxy over one instrument, owned by a labelled view.
+
+    Writes (``inc``/``set``/``observe``) forward to the real instrument
+    until the owning :class:`LabelledRegistry` is ``revoke()``-d, then
+    become no-ops — the fence an abandoned zombie pump thread hits when it
+    finally returns from a wedged step and tries to bump its replica's
+    labelled counters. Everything else (``value``, ``snapshot``, native
+    bucket introspection, ...) delegates to the real instrument, so read
+    paths and the exporter see the one true series.
+    """
+
+    __slots__ = ("_inst", "_owner")
+
+    def __init__(self, inst, owner: "LabelledRegistry"):
+        self._inst = inst
+        self._owner = owner
+
+    def inc(self, amount: float = 1.0) -> None:
+        if not self._owner._revoked:
+            self._inst.inc(amount)
+
+    def set(self, value: float) -> None:
+        if not self._owner._revoked:
+            self._inst.set(value)
+
+    def observe(self, value: float) -> None:
+        if not self._owner._revoked:
+            self._inst.observe(value)
+
+    def __getattr__(self, attr):
+        return getattr(self._inst, attr)
+
+
 class LabelledRegistry:
     """Per-instance relabeling view over a shared :class:`MetricsRegistry`.
 
@@ -425,6 +459,18 @@ class LabelledRegistry:
 
     Dot-free names (``recompiles``) stay shared across instances: they are
     process-wide by design, not per-replica families.
+
+    **Revocation (the zombie-write fence).** The scale-out router abandons
+    a pump thread that blows its ``replica_stall_s`` deadline — but that
+    thread may still be inside XLA and will eventually return and keep
+    writing this view's labelled instruments. ``revoke()`` flips the view
+    into a write-dropping state: every instrument a LABELLED view hands
+    out is a :class:`_FencedInstrument` proxy whose ``inc``/``set``/
+    ``observe`` no-op once the owning view is revoked, so a late zombie
+    write can never double-count the respawned replica's window (the
+    successor engine gets a FRESH view for the same label). Reads
+    delegate to the real instrument, and the unlabelled identity view
+    hands out bare instruments — the single-engine path is untouched.
     """
 
     def __init__(self, base: MetricsRegistry, label: str = ""):
@@ -433,6 +479,30 @@ class LabelledRegistry:
             base = base.base
         self.base = base
         self.label = str(label)
+        self._revoked = False
+        self._proxies: Dict[str, "_FencedInstrument"] = {}
+
+    def revoke(self) -> None:
+        """Drop every FUTURE write through this view (reads keep working).
+        Idempotent; used by the router's generation fence when a wedged
+        replica's pump thread is abandoned."""
+        self._revoked = True
+
+    @property
+    def revoked(self) -> bool:
+        return self._revoked
+
+    def _fence(self, inst):
+        """Wrap ``inst`` in this view's write fence (cached per concrete
+        name so callers that cache the instrument and callers that re-look
+        it up behave identically)."""
+        if not self.label:
+            return inst  # identity view: no fleet above it, no fence
+        proxy = self._proxies.get(inst.name)
+        if proxy is None or proxy._inst is not inst:
+            proxy = _FencedInstrument(inst, self)
+            self._proxies[inst.name] = proxy
+        return proxy
 
     def scoped(self, name: str) -> str:
         """The concrete instrument name this view creates for ``name``."""
@@ -444,20 +514,20 @@ class LabelledRegistry:
     # Same instrument surface as MetricsRegistry — callers (engine, tracer,
     # recompile detector) cannot tell the difference.
     def counter(self, name: str) -> Counter:
-        return self.base.counter(self.scoped(name))
+        return self._fence(self.base.counter(self.scoped(name)))
 
     def gauge(self, name: str) -> Gauge:
-        return self.base.gauge(self.scoped(name))
+        return self._fence(self.base.gauge(self.scoped(name)))
 
     def histogram(self, name: str, max_samples: int = 512) -> Histogram:
         # SLO bucket bounds are keyed by BASE family name: a labelled
         # serve.r0.ttft_s must carry the same exact native buckets as
         # serve.ttft_s or per-replica PromQL p99s silently degrade to
         # reservoir estimates
-        return self.base._get_or_create(
+        return self._fence(self.base._get_or_create(
             self.scoped(name), Histogram, max_samples=max_samples,
             bucket_bounds=SLO_BUCKET_BOUNDS.get(name),
-        )
+        ))
 
     def get(self, name: str):
         return self.base.get(self.scoped(name))

@@ -51,6 +51,11 @@ Fault points wired through the stack:
                 ``hang`` drives the watchdog/flight-recorder post-mortem
                 path deterministically on CPU (mirrors what ``step.loss``
                 hangs do for the trainer)
+``serve.spawn`` per router replica (re)spawn, before the engine is built —
+                drills the self-healing fleet's resurrection path
+                (``exception`` burns a ``max_respawns`` budget attempt and
+                reschedules the backoff; hitting it repeatedly drives the
+                lineage into permanent retirement)
 ==============  ==============================================================
 
 Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
@@ -112,7 +117,7 @@ ENV_PLAN = "VEOMNI_FAULT_PLAN"
 KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "ckpt.reshard",
                 "data.fetch", "data.record", "step.loss", "step.delay",
                 "step.params", "serve.admit", "serve.prefill",
-                "serve.decode_tick")
+                "serve.decode_tick", "serve.spawn")
 
 _MODES = ("exception", "nan", "hang", "delay", "corrupt")
 

@@ -37,6 +37,14 @@ class RetryPolicy:
     def delay(self, attempt: int) -> float:
         return min(self.base_delay_s * (2.0 ** attempt), self.max_delay_s)
 
+    def schedule(self, attempts: int) -> Tuple[float, ...]:
+        """The exact backoff sequence ``attempts`` retries will sleep —
+        ``(delay(0), ..., delay(attempts-1))``. Deterministic by design
+        (no jitter), so a planner that spaces retries itself — the
+        serving router's replica-respawn scheduler — and a fault-plan
+        test can both pin the whole timeline ahead of time."""
+        return tuple(self.delay(i) for i in range(max(0, attempts)))
+
 
 def retry_call(
     fn: Callable[..., Any],

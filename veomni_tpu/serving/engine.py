@@ -1140,6 +1140,18 @@ class InferenceEngine:
         )
 
     # ---------------------------------------------------------------- metrics
+    def revoke_metrics(self) -> None:
+        """Fence off this engine's labelled metric writes (no-op for an
+        unlabelled engine). The router calls this when it abandons a
+        WEDGED replica's pump thread: that zombie may still be inside XLA
+        and will eventually return and try to bump its ``serve.<rid>.*``
+        instruments — after revocation those writes are dropped, so the
+        respawned successor (a fresh engine, fresh labelled view, same
+        rid) never has its window double-counted by its predecessor."""
+        revoke = getattr(self._registry, "revoke", None)
+        if revoke is not None:
+            revoke()
+
     def kv_capacity(self) -> Dict[str, float]:
         """Block-pool capacity in operator units (pool bytes + estimated
         max-concurrent max-length sequences); the `/debug/memory` pool

@@ -5,10 +5,18 @@ One :class:`ReplicaHandle` wraps one in-process
 router (``serving/router.py``). The handle owns everything the router
 needs to know about a replica that the engine itself does not track:
 
-* **lifecycle state** — ``live`` (in the dispatch rotation), ``draining``
-  (finishing in-flight work before a clean detach; receives no new
-  requests) or ``dead`` (pump raised / killed; its stranded requests were
+* **lifecycle state** — ``live`` (in the dispatch rotation), ``probation``
+  (a respawned replica serving only spill traffic until it proves itself),
+  ``draining`` (finishing in-flight work before a clean detach; receives
+  no new requests), ``wedged`` (its pump thread blew the
+  ``replica_stall_s`` deadline and was abandoned behind the generation
+  fence) or ``dead`` (pump raised / killed; its stranded requests were
   re-dispatched or surfaced terminal by the router).
+* **generation fence** — a monotonically increasing integer bumped every
+  time the router abandons the replica's in-flight pump (wedge) or
+  respawns its lineage. A zombie pump thread that eventually returns
+  carries a stale generation, so its results, metrics-label writes and
+  debug rows are all dropped instead of corrupting the successor.
 * **assignment set** — the request ids currently dispatched to this
   engine and not yet captured back by the router. On replica death this
   set IS the list of stranded requests to triage; on drain it is the
@@ -18,27 +26,36 @@ needs to know about a replica that the engine itself does not track:
   publish serve the new version while old replicas finish on theirs —
   the same versioned-weights interface the trainer hot-swap loop
   (ROADMAP item 4) plugs into.
-* **dispatch counters** — requests dispatched here, and requests that
-  had to be re-dispatched AWAY after this replica died.
+* **dispatch counters** — requests dispatched here, requests that had to
+  be re-dispatched AWAY after this replica died, and the probation
+  completions a respawned replica has served so far.
 
 The handle is plain host bookkeeping touched only by the router's pump
 thread; anything another thread reads goes through the router's locked
-debug snapshot (``/debug/router``), never through a live handle.
+debug snapshot (``/debug/router``), never through a live handle. The one
+exception is the pump worker the router itself starts for this handle:
+while a pump ticket is outstanding (``pump is not None``) the ENGINE
+belongs to that worker, so every router-side read here falls back to the
+``last_*`` snapshots taken at the previous completed tick.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, Set
+from typing import Any, Dict, Optional, Set
 
 from veomni_tpu.serving.engine import InferenceEngine
 
 #: lifecycle states a replica moves through (strictly forward:
-#: live -> draining -> detached, or live/draining -> dead)
+#: live -> draining -> detached, live/draining -> dead/wedged, and —
+#: self-healing, docs/serving.md "Self-healing fleet" — dead/wedged ->
+#: respawned successor handle in probation -> live)
 STATE_LIVE = "live"
 STATE_DRAINING = "draining"
 STATE_DEAD = "dead"
 STATE_DETACHED = "detached"  # drained clean and out of the replica set
+STATE_WEDGED = "wedged"  # pump blew replica_stall_s; thread abandoned
+STATE_PROBATION = "probation"  # respawned; spill-only until proven
 
 
 @dataclass
@@ -56,46 +73,97 @@ class ReplicaHandle:
     # the router's last observed failure for a dead replica (repr'd
     # exception) — lands in the debug doc so a postmortem names the cause
     fail_reason: str = ""
+    # generation fence: bumped on wedge-abandon and on respawn. A pump
+    # ticket snapshots the generation at start; the router only applies
+    # results whose generation still matches.
+    generation: int = 0
+    # lineage root rid (respawned handles keep their ancestor's rid, so
+    # lineage == rid today; kept explicit for the respawn budget ledger)
+    lineage: str = ""
+    # clean completions served while on probation (router-counted)
+    probation_done: int = 0
+    # consecutive router ticks this handle's pump exceeded replica_stall_s
+    stall_ticks: int = 0
+    # outstanding pump ticket (router._PumpTicket) — None when the engine
+    # is quiescent and safe for the router thread to touch directly
+    pump: Optional[Any] = field(default=None, repr=False)
+    # last engine readings taken while quiescent; served while a pump
+    # ticket is outstanding so gauges/spill decisions never race the
+    # worker thread into the engine
+    last_queue_depth: int = 0
+    last_num_running: int = 0
+    last_free_seqs: int = 0
+    # pump-worker-private: ticks pumped (heartbeat global_step) and the
+    # last heartbeat write time (throttle); only ever touched by the one
+    # outstanding worker, never by the router thread
+    pumped_ticks: int = 0
+    last_beat: float = 0.0
 
     @property
     def in_rotation(self) -> bool:
-        """Eligible for NEW dispatches (draining/dead replicas are not)."""
+        """Eligible for NEW affinity dispatches (probation, draining,
+        wedged and dead replicas are not)."""
         return self.state == STATE_LIVE
 
     @property
     def pumpable(self) -> bool:
-        """Still stepped by the router (dead replicas never are)."""
-        return self.state in (STATE_LIVE, STATE_DRAINING)
+        """Still stepped by the router (wedged/dead replicas never are)."""
+        return self.state in (STATE_LIVE, STATE_DRAINING, STATE_PROBATION)
+
+    @property
+    def engine_quiescent(self) -> bool:
+        """True when the router thread may touch ``engine`` directly: the
+        replica is pumpable or cleanly detached AND no pump worker is in
+        flight. Wedged/dead engines may still be mutated by an abandoned
+        zombie thread, so they are never quiescent."""
+        return (self.pump is None
+                and self.state not in (STATE_DEAD, STATE_WEDGED))
 
     def queue_depth(self) -> int:
-        """Waiting requests at the replica's engine (the spill signal)."""
-        return self.engine.scheduler.queue_depth
+        """Waiting requests at the replica's engine (the spill signal).
+        Falls back to the last quiescent snapshot while a pump ticket is
+        outstanding."""
+        if not self.engine_quiescent:
+            return self.last_queue_depth
+        self.last_queue_depth = self.engine.scheduler.queue_depth
+        return self.last_queue_depth
 
     def free_concurrent_seqs(self) -> int:
         """Max-length sequences the engine's free blocks could still
         admit — the capacity leg of the spill decision (mirrors the
-        engine's ``serve.kv_free_concurrent_seqs`` gauge)."""
+        engine's ``serve.kv_free_concurrent_seqs`` gauge). Snapshot-backed
+        like :meth:`queue_depth`."""
+        if not self.engine_quiescent:
+            return self.last_free_seqs
         eng = self.engine
         per_seq = max(1, eng.blocks.blocks_for(eng.config.max_model_len))
-        return eng.blocks.num_free // per_seq
+        self.last_free_seqs = eng.blocks.num_free // per_seq
+        return self.last_free_seqs
 
     def status_doc(self) -> Dict[str, Any]:
         """JSON-ready row for ``/debug/router`` and the CLI census."""
+        if self.state in (STATE_DEAD, STATE_WEDGED):
+            qd = nr = -1
+        elif self.pump is not None:
+            qd, nr = self.last_queue_depth, self.last_num_running
+        else:
+            qd = self.queue_depth()
+            nr = self.last_num_running = self.engine.scheduler.num_running
         doc: Dict[str, Any] = {
             "rid": self.rid,
             "state": self.state,
+            "generation": self.generation,
             "weights_version": self.weights_version,
-            "queue_depth": (
-                self.queue_depth() if self.state != STATE_DEAD else -1
-            ),
-            "num_running": (
-                self.engine.scheduler.num_running
-                if self.state != STATE_DEAD else -1
-            ),
+            "queue_depth": qd,
+            "num_running": nr,
             "assigned": len(self.assigned),
             "dispatched": self.dispatched,
             "redispatched": self.redispatched,
         }
+        if self.state == STATE_PROBATION:
+            doc["probation_done"] = self.probation_done
+        if self.stall_ticks:
+            doc["stall_ticks"] = self.stall_ticks
         if self.fail_reason:
             doc["fail_reason"] = self.fail_reason
         return doc

@@ -47,12 +47,37 @@ Three pillars:
    ones serve the new tag: the same interface the trainer hot-swap loop
    (ROADMAP item 4) publishes into.
 
-Threading contract: like the engine, the router holds no locks for its
-own state — ONE pump thread (the caller's) drives ``submit``/``step``/
-``generate``/``run`` and every replica engine. The only cross-thread
+4. **Self-healing fleet** (docs/serving.md "Self-healing fleet"). A
+   replica that *raises* dies and sheds; a replica that *hangs* — the
+   TPU-relay failure mode, reproducible via the ``hang`` fault at
+   ``serve.decode_tick`` — used to wedge the pump's join barrier
+   forever. Now every busy replica is pumped on a worker thread behind a
+   per-replica deadline (``RouterConfig.replica_stall_s``): a ``step()``
+   over deadline for ``replica_stall_ticks`` consecutive router ticks
+   marks the handle WEDGED, the stuck worker is abandoned behind a
+   generation fence (it may still be inside XLA; its results are never
+   read and its labelled metric writes are revoked) and the normal
+   death triage runs — healthy replicas' tick latency is never held
+   hostage. Dead/wedged replicas then RESPAWN after a deterministic
+   ``resilience.retry.RetryPolicy`` backoff, attach to the shared
+   program bundle (zero new compiles, same gate as ``add_replica``),
+   and serve a PROBATION period — spill traffic only — before rejoining
+   the rendezvous rotation; a ``max_respawns`` budget per lineage
+   exhausts into loud permanent retirement. ``health()`` surfaces all
+   of it for ``/healthz`` (503 below ``min_live`` — recovering, never
+   sticky).
+
+Threading contract: the router holds no locks for its own state — ONE
+pump thread (the caller's) drives ``submit``/``step``/``generate``/
+``run``, and each replica engine is touched by at most one thread at a
+time: either the router thread (quiescent) or the single outstanding
+pump worker the router started for it (``_PumpTicket``; ``Thread.join``
+is the happens-before edge that publishes the worker's result back).
+While a ticket is outstanding the router reads only the handle's
+``last_*`` snapshots, never the engine. The only other cross-thread
 surface is the debug snapshot behind ``_debug_lock`` (the exporter's
-HTTP thread reads ``/debug/router``) plus the already-thread-safe
-metrics registry and flight recorder.
+HTTP thread reads ``/debug/router`` and ``health()``) plus the
+already-thread-safe metrics registry and flight recorder.
 """
 
 from __future__ import annotations
@@ -63,8 +88,11 @@ import zlib
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
 
+from veomni_tpu.observability.fleet import write_heartbeat
 from veomni_tpu.observability.flight_recorder import record as _flight_record
 from veomni_tpu.observability.metrics import get_registry
+from veomni_tpu.resilience.faults import fault_point
+from veomni_tpu.resilience.retry import RetryPolicy
 from veomni_tpu.serving.api import (
     Request,
     RequestOutput,
@@ -77,12 +105,26 @@ from veomni_tpu.serving.replica import (
     STATE_DETACHED,
     STATE_DRAINING,
     STATE_LIVE,
+    STATE_PROBATION,
+    STATE_WEDGED,
     ReplicaHandle,
 )
 from veomni_tpu.serving.scheduler import QoSPicker, parse_classes
 from veomni_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+#: numeric encoding for the per-replica ``serve.router.<rid>.state`` gauge
+#: (docs/observability.md): forward transitions only ever raise the value
+#: until a respawn resets it
+STATE_CODES = {
+    STATE_LIVE: 0,
+    STATE_PROBATION: 1,
+    STATE_DRAINING: 2,
+    STATE_WEDGED: 3,
+    STATE_DEAD: 4,
+    STATE_DETACHED: 5,
+}
 
 
 @dataclass
@@ -109,6 +151,32 @@ class RouterConfig:
     classes: Optional[str] = None
     queue_bound: Optional[int] = None
     tenant_max_inflight: Optional[int] = None
+    # --- self-healing fleet (docs/serving.md "Self-healing fleet") ---
+    # per-replica pump deadline: a step() still running after this many
+    # seconds counts one stall strike per router tick, and
+    # replica_stall_ticks consecutive strikes mark the replica WEDGED
+    # (detection latency <= replica_stall_s + one tick). 0 disables wedge
+    # detection and keeps the legacy unbounded-join pump.
+    replica_stall_s: float = 60.0
+    replica_stall_ticks: int = 2
+    # respawn budget per replica lineage (0 disables resurrection);
+    # attempts are spaced by the deterministic retry.RetryPolicy backoff
+    # (base * 2**attempt, capped — no jitter, so recovery timelines are
+    # reproducible in tests and chaos replays)
+    max_respawns: int = 2
+    respawn_backoff_s: float = 0.5
+    respawn_backoff_max_s: float = 30.0
+    # clean completions (eos/length) a respawned replica must serve —
+    # spill traffic only, never an affinity target — before it rejoins
+    # the rendezvous rotation. 0 respawns straight to live.
+    probation_requests: int = 2
+    # health() reports healthy=False (the exporter serves HTTP 503) while
+    # fewer than this many replicas are LIVE; recovering, not sticky
+    min_live: int = 1
+    # pump workers drop throttled heartbeat-<rid>.json files here so a
+    # wedged replica is diagnosable from OUTSIDE the process
+    # (scripts/fleet.py timeline); "" disables
+    heartbeat_dir: str = ""
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -119,6 +187,18 @@ class RouterConfig:
             raise ValueError("spill_queue_depth must be >= 0 (0 disables)")
         if self.spill_min_free_seqs < 0:
             raise ValueError("spill_min_free_seqs must be >= 0 (0 disables)")
+        if self.replica_stall_s < 0:
+            raise ValueError("replica_stall_s must be >= 0 (0 disables)")
+        if self.replica_stall_ticks < 1:
+            raise ValueError("replica_stall_ticks must be >= 1")
+        if self.max_respawns < 0:
+            raise ValueError("max_respawns must be >= 0 (0 disables)")
+        if self.respawn_backoff_s < 0 or self.respawn_backoff_max_s < 0:
+            raise ValueError("respawn backoff delays must be >= 0")
+        if self.probation_requests < 0:
+            raise ValueError("probation_requests must be >= 0 (0 skips)")
+        if self.min_live < 0:
+            raise ValueError("min_live must be >= 0")
 
 
 @dataclass
@@ -135,6 +215,54 @@ class _RouterItem:
     @property
     def tenant(self) -> str:  # QoSPicker duck-type field
         return getattr(self.request, "tenant", "")
+
+
+class _PumpTicket:
+    """One in-flight ``engine.step()`` on a worker thread.
+
+    Created and joined by the router's pump thread; the worker writes
+    ``result`` then exits, and ``Thread.join`` is the happens-before edge
+    that publishes the result back. While a ticket is outstanding the
+    replica's engine belongs to the worker — every router-side read goes
+    through the handle's ``last_*`` snapshots instead.
+
+    ``generation`` snapshots the handle's fence at start. If the router
+    abandons this ticket (the replica wedged, was killed mid-stall, or
+    respawned), the dropped ticket reference means the zombie's result is
+    never read, the bumped handle generation invalidates any late match,
+    and the engine's revoked metrics view drops its late labelled writes
+    — the zombie may still be inside XLA, and none of that matters.
+    """
+
+    def __init__(self, handle: ReplicaHandle, heartbeat_dir: str = ""):
+        self.handle = handle
+        self.generation = handle.generation
+        self.heartbeat_dir = heartbeat_dir
+        self.started = time.perf_counter()
+        self.thread: Optional[threading.Thread] = None
+        self.result: Any = ("ok", [])
+
+    def run(self) -> None:
+        h = self.handle
+        if self.heartbeat_dir:
+            # throttled liveness beat BEFORE the step: a wedged step
+            # leaves the file aging, which is exactly what makes the
+            # wedge diagnosable from outside the process
+            # (scripts/fleet.py; docs/observability.md heartbeats)
+            now = time.monotonic()
+            if now - h.last_beat >= 1.0:
+                h.last_beat = now
+                write_heartbeat(
+                    self.heartbeat_dir, rank=h.rid,
+                    global_step=h.pumped_ticks, phase="serve_pump",
+                    extra={"replica": h.rid, "state": h.state,
+                           "generation": self.generation},
+                )
+        try:
+            self.result = ("ok", h.engine.step())
+        except Exception as e:  # noqa: BLE001 — triaged post-join
+            self.result = ("dead", e)
+        h.pumped_ticks += 1
 
 
 class Router:
@@ -179,6 +307,20 @@ class Router:
         self._deadline_cancelled_total = 0
         self._spill_total = 0
         self._redispatch_total = 0
+        self._wedged_total = 0
+        self._respawn_total = 0
+        self._probation_total = 0
+        # self-healing scheduler state: pending respawns (due-dated by the
+        # deterministic backoff), the per-lineage budget ledger, and the
+        # lineages that exhausted it (permanently retired)
+        self._respawn_policy = RetryPolicy(
+            retries=max(0, rc.max_respawns),
+            base_delay_s=rc.respawn_backoff_s,
+            max_delay_s=max(rc.respawn_backoff_s, rc.respawn_backoff_max_s),
+        )
+        self._pending_respawns: List[Dict[str, Any]] = []
+        self._lineage_respawns: Dict[str, int] = {}
+        self._retired_lineages: set = set()
         # router-level observability (docs/observability.md):
         self._reg = get_registry()
         self._m_requests = self._reg.counter("serve.router.requests")
@@ -187,6 +329,9 @@ class Router:
         self._m_spills = self._reg.counter("serve.router.spills")
         self._m_rejected = self._reg.counter("serve.router.rejected")
         self._m_deadline = self._reg.counter("serve.router.deadline_cancelled")
+        self._m_wedged = self._reg.counter("serve.router.wedged")
+        self._m_respawns = self._reg.counter("serve.router.respawns")
+        self._m_probation = self._reg.counter("serve.router.probation")
         self._m_live = self._reg.gauge("serve.router.replicas_live")
         self._m_queue = self._reg.gauge("serve.router.queue_depth")
         self._m_hit_rate = self._reg.gauge("serve.router.prefix_hit_rate")
@@ -198,11 +343,20 @@ class Router:
         self._publish_gauges()
 
     # ------------------------------------------------------------- replicas
-    def _spawn_replica(self) -> ReplicaHandle:
-        rid = f"r{self._next_rid}"
-        self._next_rid += 1
+    def _spawn_replica(self, rid: Optional[str] = None,
+                       state: str = STATE_LIVE, generation: int = 0,
+                       lineage: str = "") -> ReplicaHandle:
+        if rid is None:
+            rid = f"r{self._next_rid}"
+            self._next_rid += 1
+        # resurrection fault drill (docs/resilience.md ``serve.spawn``): an
+        # exception here during a respawn burns one budget attempt
+        fault_point("serve.spawn")
         # replicas run single-class FIFO with the bounds off — QoS lives at
-        # the router — and carry their rid as the metrics instance label
+        # the router — and carry their rid as the metrics instance label.
+        # A respawned replica REUSES its ancestor's rid: the metric series
+        # continues, and the generation fence (plus the ancestor's revoked
+        # registry view) keeps the zombie's late writes out of it.
         rcfg = replace(
             self.engine_config, classes="default", queue_bound=0,
             tenant_max_inflight=0, metrics_label=rid,
@@ -211,10 +365,82 @@ class Router:
                               programs=self._programs)
         if self._programs is None:
             self._programs = eng.programs
-        h = ReplicaHandle(rid=rid, engine=eng,
+        h = ReplicaHandle(rid=rid, engine=eng, state=state,
+                          generation=generation, lineage=lineage or rid,
                           weights_version=self._weights_version)
         self.replicas[rid] = h
         return h
+
+    def _schedule_respawn(self, *, rid: str, lineage: str, generation: int,
+                          fail_reason: str = "") -> None:
+        """Book a resurrection attempt for a dead/wedged lineage, spaced
+        by the deterministic backoff; a lineage past ``max_respawns`` is
+        permanently retired instead — loudly, because from here only an
+        operator ``add_replica()`` restores the lost capacity."""
+        rc = self.config
+        if rc.max_respawns <= 0:
+            return
+        used = self._lineage_respawns.get(lineage, 0)
+        if used >= rc.max_respawns:
+            if lineage not in self._retired_lineages:
+                self._retired_lineages.add(lineage)
+                logger.error(
+                    "router: replica %s exhausted its respawn budget "
+                    "(%d/%d) and is PERMANENTLY retired — fleet capacity "
+                    "stays reduced until an operator adds a replica "
+                    "(last failure: %s)",
+                    lineage, used, rc.max_respawns, fail_reason or "n/a")
+                _flight_record("router.replica_retired", cid=lineage,
+                               respawns=used,
+                               last_error=fail_reason[:160])
+            return
+        delay = self._respawn_policy.delay(used)
+        self._lineage_respawns[lineage] = used + 1
+        self._pending_respawns.append({
+            "rid": rid, "lineage": lineage, "generation": generation,
+            "attempt": used + 1, "delay_s": delay,
+            "due": time.perf_counter() + delay,
+        })
+        logger.warning(
+            "router: replica %s will respawn in %.3gs (attempt %d/%d)",
+            rid, delay, used + 1, rc.max_respawns)
+
+    def _maybe_respawn(self) -> None:
+        """Land every due respawn: a fresh engine attached to the shared
+        program bundle (zero new traces — the same compile-count gate as
+        ``add_replica``), same rid, bumped generation, entering PROBATION
+        (spill traffic only) unless probation is disabled. A spawn that
+        raises (the ``serve.spawn`` fault drill, an allocator error)
+        burns the attempt and reschedules."""
+        if not self._pending_respawns:
+            return
+        now = time.perf_counter()
+        for p in [p for p in self._pending_respawns if p["due"] <= now]:
+            self._pending_respawns.remove(p)
+            state = (STATE_PROBATION if self.config.probation_requests > 0
+                     else STATE_LIVE)
+            try:
+                h = self._spawn_replica(rid=p["rid"], state=state,
+                                        generation=p["generation"],
+                                        lineage=p["lineage"])
+            except Exception as e:  # noqa: BLE001 — a failed respawn must
+                # not take down the healthy fleet driving this pump
+                logger.warning("router: respawn of replica %s failed (%s)",
+                               p["rid"], e)
+                self._schedule_respawn(rid=p["rid"], lineage=p["lineage"],
+                                       generation=p["generation"],
+                                       fail_reason=repr(e))
+                continue
+            self._respawn_total += 1
+            self._m_respawns.inc()
+            _flight_record("router.replica_respawned", cid=h.rid,
+                           generation=h.generation, attempt=p["attempt"],
+                           state=h.state)
+            logger.warning(
+                "router: replica %s respawned (generation %d, %s, "
+                "attempt %d/%d)", h.rid, h.generation, h.state,
+                p["attempt"], self.config.max_respawns)
+            self._publish_gauges()
 
     def add_replica(self) -> ReplicaHandle:
         """Grow the fleet by one live replica. The new engine shares the
@@ -392,20 +618,102 @@ class Router:
     @property
     def has_work(self) -> bool:
         return bool(self._queue) or any(
-            (h.engine.has_work or h.assigned)
+            (h.pump is not None or h.engine.has_work or h.assigned)
             for h in self.replicas.values() if h.pumpable
         )
 
     def step(self) -> List[StreamEvent]:
-        """One router tick: expire queued deadlines, dispatch under the
-        QoS pick + affinity/spill policy, pump every live/draining
-        replica one engine tick (a raising replica dies and sheds, never
-        hangs), capture finished outputs, detach drained replicas, and
-        refresh gauges + the /debug/router snapshot."""
+        """One router tick: land due respawns, expire queued deadlines,
+        dispatch under the QoS pick + affinity/spill policy, pump every
+        busy replica one engine tick behind the ``replica_stall_s``
+        deadline (a raising replica dies, a hanging one WEDGES and is
+        abandoned — either way survivors shed, never hang), capture
+        finished outputs, detach drained replicas, and refresh gauges +
+        the /debug/router snapshot."""
+        self._maybe_respawn()
         self._expire_deadlines()
         self._dispatch()
         events: List[StreamEvent] = []
+        stall_s = self.config.replica_stall_s
         pump = [h for h in self.replicas.values() if h.pumpable]
+        if stall_s > 0:
+            events.extend(self._pump_fenced(pump, stall_s))
+        else:
+            events.extend(self._pump_legacy(pump))
+        for h in pump:
+            # skip replicas that died/wedged this tick, and replicas whose
+            # pump worker is still running (their engine is untouchable
+            # until the ticket resolves)
+            if self.replicas.get(h.rid) is h and h.engine_quiescent:
+                self._capture_finished(h)
+        self._detach_drained()
+        self._publish_gauges()
+        if (not events and self._pending_respawns
+                and not any(h.pump is not None or h.engine.has_work
+                            for h in self.replicas.values() if h.pumpable)):
+            # idle fleet waiting out a respawn backoff: a generate()/run()
+            # caller spins on has_work, so nap toward the next due time
+            # instead of burning a core
+            wait = (min(p["due"] for p in self._pending_respawns)
+                    - time.perf_counter())
+            if wait > 0:
+                time.sleep(min(wait, 0.005))
+        return events
+
+    def _pump_fenced(self, pump: List[ReplicaHandle],
+                     stall_s: float) -> List[StreamEvent]:
+        """Pump every busy replica on a worker thread behind a
+        per-replica join deadline. Each engine is still touched by
+        exactly one thread at a time — its single outstanding worker,
+        with ``join`` as the read-back barrier — so the engine's
+        single-pump-thread contract holds per replica while the jitted
+        steps (which release the GIL) overlap; this is where the
+        aggregate throughput scaling comes from. A worker that blows the
+        deadline leaves its ticket outstanding (the replica is skipped by
+        dispatch/capture/gauges until it resolves) and collects one stall
+        strike per router tick; ``replica_stall_ticks`` strikes wedge the
+        replica and abandon the worker behind the generation fence."""
+        events: List[StreamEvent] = []
+        tickets: List[ReplicaHandle] = []
+        for h in pump:
+            if h.pump is not None:
+                tickets.append(h)  # outstanding from a previous tick
+                continue
+            if not h.engine.has_work:
+                continue
+            t = _PumpTicket(h, self.config.heartbeat_dir)
+            h.pump = t
+            t.thread = threading.Thread(
+                target=t.run, name=f"router-pump-{h.rid}", daemon=True)
+            t.thread.start()
+            tickets.append(h)
+        for h in tickets:
+            t = h.pump
+            remaining = stall_s - (time.perf_counter() - t.started)
+            t.thread.join(max(0.0, remaining))
+            if t.thread.is_alive():
+                # over its deadline: one strike per router tick, so a
+                # wedge is declared within replica_stall_s + one tick
+                h.stall_ticks += 1
+                if h.stall_ticks >= self.config.replica_stall_ticks:
+                    self._on_replica_wedged(h)
+                continue
+            h.pump = None
+            h.stall_ticks = 0
+            if t.generation != h.generation:
+                continue  # fenced: the handle moved on while this ran
+            kind, val = t.result
+            if kind == "ok":
+                events.extend(val)
+            else:
+                self._on_replica_failure(h, val)
+        return events
+
+    def _pump_legacy(self, pump: List[ReplicaHandle]) -> List[StreamEvent]:
+        """The pre-self-healing pump (``replica_stall_s=0`` opts out of
+        wedge detection): inline for a single busy replica, concurrent
+        workers behind an UNBOUNDED join barrier otherwise."""
+        events: List[StreamEvent] = []
         busy = [h for h in pump if h.engine.has_work]
         if len(busy) == 1:
             h = busy[0]
@@ -415,13 +723,6 @@ class Router:
                 # must shed to survivors, not take the router down
                 self._on_replica_failure(h, e)
         elif busy:
-            # pump replicas CONCURRENTLY: each engine is still touched by
-            # exactly one thread at a time (its worker, with a join
-            # barrier before any router bookkeeping reads it back), so the
-            # engine's single-pump-thread contract holds per replica while
-            # the jitted steps — which release the GIL — overlap. This is
-            # where the aggregate throughput scaling comes from; a serial
-            # pump would serialize N device programs behind one core.
             results: Dict[str, Any] = {}
 
             def _pump_one(handle: ReplicaHandle) -> None:
@@ -445,11 +746,6 @@ class Router:
                     events.extend(val)
                 else:
                     self._on_replica_failure(h, val)
-        for h in pump:
-            if h.rid in self.replicas:  # skip replicas that died this tick
-                self._capture_finished(h)
-        self._detach_drained()
-        self._publish_gauges()
         return events
 
     def generate(self, requests: Optional[Iterable] = None
@@ -503,7 +799,13 @@ class Router:
             self._finish_item(item, out)
             return True
         h = self.replicas.get(item.replica)
-        if h is None or not h.engine.cancel(request_id, reason):
+        # a replica mid-stall (outstanding pump ticket) is untouchable —
+        # the cancel would race its worker inside the engine; callers see
+        # False and may retry after the ticket resolves or the wedge triage
+        # surfaces the request terminally
+        if h is None or not h.engine_quiescent:
+            return False
+        if not h.engine.cancel(request_id, reason):
             return False
         self._capture_finished(h)
         return True
@@ -536,32 +838,51 @@ class Router:
             self._finish_item(item, out)
 
     def _dispatch(self) -> None:
-        live = self.live_replicas()
-        if not live:
-            if self._queue and not any(
-                    h.engine.has_work or h.assigned
-                    for h in self.replicas.values() if h.pumpable):
-                # nothing can ever serve the queue again — fail loudly,
-                # mirroring the engine's scheduler-stall invariant, instead
-                # of letting generate() spin on has_work forever
+        # a replica with an outstanding pump ticket is untouchable until
+        # the ticket resolves — its engine belongs to the worker thread
+        live = [h for h in self.live_replicas() if h.pump is None]
+        probation = [h for h in self.replicas.values()
+                     if h.state == STATE_PROBATION and h.pump is None]
+        if not live and not probation:
+            if (self._queue and not self._pending_respawns
+                    and not any(
+                        h.pump is not None or h.engine.has_work or h.assigned
+                        for h in self.replicas.values() if h.pumpable)):
+                # nothing can ever serve the queue again — surface every
+                # queued request as a terminal REJECTED output first (a
+                # generate()/run() caller must never block forever on a
+                # request that can no longer be served), THEN fail loudly,
+                # mirroring the engine's scheduler-stall invariant
+                self._reject_stranded_queue()
                 raise RuntimeError(
                     "router stalled: requests queued but no live replicas"
                 )
-            return  # draining replicas may still finish their work
+            # draining replicas may still finish their work, and a pending
+            # respawn may restore capacity — the pump waits, never stalls
+            return
+        # probation replicas receive ONLY spill traffic: the rendezvous
+        # target set is the live rotation, and probation capacity shows up
+        # as a spill destination / parking headroom. A fleet reduced to
+        # probation-only dispatches to it directly — serving on an
+        # unproven replica beats stalling the queue.
+        targets = live or probation
+        pool = live + probation
         while self._queue:
-            # park at the router when every live replica is past the spill
-            # threshold AND the fleet is actually busy — back-pressure
-            # makes the router-level QoS pick decide who goes next. An
-            # idle fleet always accepts (a threshold below the idle
-            # capacity must never stall an empty router).
-            busy = any(h.engine.has_work for h in live)
-            if busy and all(self._past_threshold(h) for h in live):
+            # park at the router when every live+probation replica is past
+            # the spill threshold AND the fleet is actually busy —
+            # back-pressure makes the router-level QoS pick decide who
+            # goes next. An idle fleet always accepts (a threshold below
+            # the idle capacity must never stall an empty router).
+            busy = (any(h.engine.has_work for h in pool)
+                    or any(h.pump is not None
+                           for h in self.replicas.values()))
+            if busy and all(self._past_threshold(h) for h in pool):
                 break
             item = self.qos.pick(self._queue)
             key = self._affinity_key(item.request.prompt_ids)
-            target = self._affinity_target(key, live)
+            target = self._affinity_target(key, targets)
             if self._past_threshold(target):
-                spilled = min(live, key=lambda h: (h.queue_depth(), h.rid))
+                spilled = min(pool, key=lambda h: (h.queue_depth(), h.rid))
                 if spilled.rid != target.rid:
                     self._spill_total += 1
                     self._m_spills.inc()
@@ -573,9 +894,48 @@ class Router:
             self._queue.remove(item)
             self._dispatch_to(item, target)
 
+    def _reject_stranded_queue(self) -> None:
+        """Terminal REJECTED outputs for everything still queued when the
+        router stalls with no live replicas and no way back — callers
+        blocked in ``run()``/``pop_output`` get an answer, not a hang."""
+        for item in list(self._queue):
+            req = item.request
+            out = RequestOutput(request_id=req.request_id,
+                                prompt_ids=list(req.prompt_ids))
+            out.finished = True
+            out.finish_reason = "rejected"
+            self._rejected_total += 1
+            self._shed_tokens_total += (
+                len(req.prompt_ids) + req.sampling.max_new_tokens)
+            self._m_rejected.inc()
+            _flight_record("router.rejected", cid=req.request_id,
+                           reason="no live replicas")
+            self._finish_item(item, out)
+        self._queue.clear()
+        self._publish_gauges()
+
     def _dispatch_to(self, item: _RouterItem, h: ReplicaHandle) -> None:
         req = item.request
-        h.engine.submit(req)
+        try:
+            h.engine.submit(req)
+        except Exception as e:  # noqa: BLE001 — an admission that raises
+            # (the serve.admit fault drill, an allocator edge) bounces the
+            # REQUEST, not the fleet: terminal rejected, the replica stays
+            # in rotation. Malformed requests cannot reach here —
+            # Router.submit already ran the same validation the engine
+            # does, so whatever raised is environmental.
+            out = RequestOutput(request_id=req.request_id,
+                                prompt_ids=list(req.prompt_ids))
+            out.finished = True
+            out.finish_reason = "rejected"
+            self._rejected_total += 1
+            self._shed_tokens_total += (
+                len(req.prompt_ids) + req.sampling.max_new_tokens)
+            self._m_rejected.inc()
+            _flight_record("router.dispatch_rejected", cid=req.request_id,
+                           replica=h.rid, error=repr(e)[:160])
+            self._finish_item(item, out)
+            return
         # router-side wait counts toward the deadline exactly like engine
         # queue wait: one clock, started at user intake
         h.engine.backdate_submit_time(req.request_id, item.submit_time)
@@ -589,28 +949,89 @@ class Router:
     def _capture_finished(self, h: ReplicaHandle) -> None:
         """Pull every terminal output off a replica. Runs after each pump
         tick AND on demand (cancel), and covers event-less terminals too
-        (deadline/cancel inside the engine emit no StreamEvent)."""
+        (deadline/cancel inside the engine emit no StreamEvent). Clean
+        completions captured from a PROBATION replica count toward its
+        parole: ``probation_requests`` of them rejoin it to the live
+        rendezvous rotation."""
         for rid_ in list(h.assigned):
             out = h.engine.get_output(rid_)
             if out is not None and out.finished:
                 h.engine.pop_output(rid_)
                 h.assigned.discard(rid_)
                 self._finish_item(self._items[rid_], out)
+                if (h.state == STATE_PROBATION
+                        and out.finish_reason in ("eos", "length")):
+                    h.probation_done += 1
+                    if h.probation_done >= self.config.probation_requests:
+                        h.state = STATE_LIVE
+                        self._probation_total += 1
+                        self._m_probation.inc()
+                        _flight_record("router.probation_passed", cid=h.rid,
+                                       generation=h.generation,
+                                       served=h.probation_done)
+                        logger.info(
+                            "router: replica %s passed probation after %d "
+                            "clean completions; rejoining rotation",
+                            h.rid, h.probation_done)
 
     def _finish_item(self, item: _RouterItem, out: RequestOutput) -> None:
         item.phase = "done"
         item.replica = ""
         self._outputs[out.request_id] = out
 
-    def _on_replica_failure(self, h: ReplicaHandle, exc: Exception) -> None:
-        """Drain a dead replica out of rotation, exactly-once per stranded
-        request: finished on the dead engine -> captured as-is; nothing
-        streamed yet -> re-dispatched at the FRONT of the router queue in
-        original arrival order; tokens already streamed -> terminal
-        ``cancelled`` keeping what was delivered. Never hung."""
-        if h.state == STATE_DEAD:
+    def _on_replica_wedged(self, h: ReplicaHandle) -> None:
+        """A pump worker blew ``replica_stall_s`` for
+        ``replica_stall_ticks`` consecutive ticks: abandon it behind the
+        generation fence and run the normal death triage. The zombie
+        thread may still be inside XLA — its ticket is dropped before the
+        fence bumps, so its result is never read and its labelled metric
+        writes are revoked."""
+        t = h.pump
+        stalled = time.perf_counter() - t.started if t is not None else 0.0
+        self._wedged_total += 1
+        self._m_wedged.inc()
+        _flight_record("router.replica_wedged", cid=h.rid,
+                       generation=h.generation,
+                       stalled_s=round(stalled, 3),
+                       stall_ticks=h.stall_ticks)
+        logger.warning(
+            "router: replica %s WEDGED — step() still running after %.3gs "
+            "(deadline replica_stall_s=%.3gs, %d strike(s)); abandoning "
+            "its pump thread behind the generation fence",
+            h.rid, stalled, self.config.replica_stall_s, h.stall_ticks)
+        self._on_replica_failure(
+            h,
+            RuntimeError(
+                f"wedged: step() exceeded replica_stall_s="
+                f"{self.config.replica_stall_s}s for {h.stall_ticks} "
+                f"consecutive tick(s)"
+            ),
+            state=STATE_WEDGED,
+        )
+
+    def _on_replica_failure(self, h: ReplicaHandle, exc: Exception,
+                            state: str = STATE_DEAD) -> None:
+        """Drain a dead/wedged replica out of rotation, exactly-once per
+        stranded request: finished on the dead engine -> captured as-is;
+        nothing streamed yet -> re-dispatched at the FRONT of the router
+        queue in original arrival order; tokens already streamed ->
+        terminal ``cancelled`` keeping what was delivered. Never hung.
+        If the lineage still has respawn budget a resurrection is booked
+        on the deterministic backoff."""
+        if h.state in (STATE_DEAD, STATE_WEDGED):
             return
-        h.state = STATE_DEAD
+        if h.pump is not None:
+            # abandon the in-flight worker behind the generation fence:
+            # the ticket reference is dropped (its result is never read),
+            # the generation bump invalidates any late match, and the
+            # engine's labelled metrics view is revoked so the zombie's
+            # eventual writes are dropped. The triage reads below touch
+            # only GIL-atomic dict/list state the worker appends to, so a
+            # concurrently-running zombie cannot corrupt them.
+            h.pump = None
+            h.generation += 1
+            h.engine.revoke_metrics()
+        h.state = state
         h.fail_reason = repr(exc)
         self.replicas.pop(h.rid, None)
         self.retired.append(h)
@@ -618,6 +1039,9 @@ class Router:
                        h.rid, exc, len(h.assigned))
         _flight_record("router.replica_dead", cid=h.rid, error=repr(exc),
                        stranded=len(h.assigned))
+        # last state the rid's gauge will show until a respawn resets it
+        self._reg.gauge(f"serve.router.{h.rid}.state").set(
+            STATE_CODES.get(state, -1))
         requeue: List[_RouterItem] = []
         for rid_ in list(h.assigned):
             item = self._items[rid_]
@@ -644,11 +1068,15 @@ class Router:
         # front of the queue, original arrival order — like a preemption
         # requeue, a victim of infrastructure never loses its place
         self._queue[:0] = sorted(requeue, key=lambda it: it.order)
+        # self-healing: book the resurrection (or retire the lineage)
+        self._schedule_respawn(rid=h.rid, lineage=h.lineage or h.rid,
+                               generation=h.generation + 1,
+                               fail_reason=h.fail_reason)
         self._publish_gauges()
 
     def _detach_drained(self) -> None:
         for h in [h for h in self.replicas.values()
-                  if h.state == STATE_DRAINING
+                  if h.state == STATE_DRAINING and h.pump is None
                   and not h.engine.has_work and not h.assigned]:
             h.state = STATE_DETACHED
             self.replicas.pop(h.rid, None)
@@ -664,17 +1092,23 @@ class Router:
         for h in self.replicas.values():
             if not h.pumpable:
                 continue
-            # lifetime totals; pump-thread-private engine fields are safe
-            # to read here — the router IS the pump thread
-            cached += h.engine._cached_tokens_total
-            prompts += h.engine._prompt_tokens_total
             self._reg.gauge(
                 f"serve.router.{h.rid}.queue_depth"
             ).set(h.queue_depth())
+            self._reg.gauge(
+                f"serve.router.{h.rid}.state"
+            ).set(STATE_CODES.get(h.state, -1))
+            if not h.engine_quiescent:
+                continue  # engine belongs to its outstanding pump worker
+            # lifetime totals; pump-thread-private engine fields are safe
+            # to read here — the router thread owns a quiescent engine
+            cached += h.engine._cached_tokens_total
+            prompts += h.engine._prompt_tokens_total
         self._m_hit_rate.set(cached / max(1, prompts))
         self._refresh_debug()
 
     def _refresh_debug(self) -> None:
+        now = time.perf_counter()
         doc = {
             "replicas": [h.status_doc() for h in self.replicas.values()],
             "retired": [h.status_doc() for h in self.retired],
@@ -684,15 +1118,58 @@ class Router:
             "deadline_cancelled": self._deadline_cancelled_total,
             "spills": self._spill_total,
             "redispatched": self._redispatch_total,
+            # self-healing columns (docs/serving.md "Self-healing fleet")
+            "replicas_live": sum(1 for h in self.replicas.values()
+                                 if h.state == STATE_LIVE),
+            "min_live": self.config.min_live,
+            "wedged": self._wedged_total,
+            "respawns": self._respawn_total,
+            "probation_passed": self._probation_total,
+            "pending_respawns": [
+                {"rid": p["rid"], "attempt": p["attempt"],
+                 "delay_s": p["delay_s"],
+                 "due_in_s": round(max(0.0, p["due"] - now), 3)}
+                for p in self._pending_respawns
+            ],
+            "retired_lineages": sorted(self._retired_lineages),
         }
         with self._debug_lock:
             self._debug_doc = doc
 
     def debug_doc(self) -> Dict[str, Any]:
         """Thread-safe snapshot for ``/debug/router`` (exporter HTTP
-        thread); refreshed by the pump at the end of every step."""
+        thread); refreshed by the pump at the end of every step. The
+        ``_debug_doc`` swap is the ONLY cross-thread write, and the
+        single-writer is the router's pump thread — an abandoned zombie
+        pump worker never touches it (workers only run ``engine.step``),
+        so a wedge cannot corrupt the snapshot a scrape is reading."""
         with self._debug_lock:
             return dict(self._debug_doc)
+
+    def health(self) -> Dict[str, Any]:
+        """Fleet health for ``/healthz`` — thread-safe (built from the
+        locked debug snapshot, so the exporter's HTTP thread calls it
+        directly). ``healthy`` is False while fewer than
+        ``RouterConfig.min_live`` replicas are LIVE — the exporter maps
+        that to HTTP 503 — and it is RECOVERING, not sticky: the moment
+        respawn + probation restore the fleet, the next scrape is 200."""
+        doc = self.debug_doc()
+        rows = doc.get("replicas", [])
+        n_live = doc.get(
+            "replicas_live",
+            sum(1 for r in rows if r.get("state") == STATE_LIVE),
+        )
+        return {
+            "healthy": n_live >= self.config.min_live,
+            "replicas_live": n_live,
+            "min_live": self.config.min_live,
+            "replica_states": {r.get("rid"): r.get("state") for r in rows},
+            "queue_depth": doc.get("queue_depth", 0),
+            "wedged": doc.get("wedged", 0),
+            "respawns": doc.get("respawns", 0),
+            "pending_respawns": len(doc.get("pending_respawns", ())),
+            "retired_lineages": doc.get("retired_lineages", []),
+        }
 
     def metrics(self, reset_window: bool = True) -> Dict[str, Any]:
         """Fleet-aggregated metrics, same keys as the engine's plus
@@ -700,7 +1177,9 @@ class Router:
         across replicas; the hit rate is token-weighted."""
         per: Dict[str, Dict[str, float]] = {}
         for h in self.replicas.values():
-            if h.pumpable:
+            # a replica mid-stall is skipped for one poll rather than
+            # racing its worker inside the engine's window bookkeeping
+            if h.pumpable and h.engine_quiescent:
                 per[h.rid] = h.engine.metrics(reset_window=reset_window)
         agg: Dict[str, Any] = {
             "queue_depth": float(len(self._queue)) + sum(
@@ -735,6 +1214,9 @@ class Router:
             "spills": float(self._spill_total),
             "redispatched": float(self._redispatch_total),
             "replicas_live": float(len(self.live_replicas())),
+            "wedged": float(self._wedged_total),
+            "respawns": float(self._respawn_total),
+            "probation_passed": float(self._probation_total),
             "per_replica": per,
         }
         return agg
