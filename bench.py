@@ -812,6 +812,8 @@ def run_serve_open_loop_bench(
     replica_kill_at_s: float = 0.0,
     chaos_seed: int = -1,
     chaos_stall_s: float = 2.0,
+    chaos_publishes: int = 0,
+    publish: bool = False,
     _model=None,
 ) -> dict:
     """Open-loop Poisson overload bench: arrivals fire on a fixed schedule
@@ -870,7 +872,21 @@ def run_serve_open_loop_bench(
     (chaos / fault-free; the acceptance floor is 0.7). The PR-17 kill
     drill (``replica_kill_at_s``) deliberately keeps respawns OFF — it
     measures the *degraded* fleet; the chaos leg measures the *healing*
-    one.
+    one. ``chaos_publishes`` (BENCH_SERVE_CHAOS_PUBLISHES) additionally
+    schedules that many mid-storm rolling weight publications inside the
+    chaos leg (drawn AFTER the seed's faults/kills, so existing seeds
+    replay bit-identically) — the soak then also checks the fleet
+    converged to exactly one weights version.
+
+    ``publish`` (BENCH_SERVE_PUBLISH=1) adds the rolling-publish leg:
+    the same Poisson storm replays twice over the self-healing fleet —
+    once publish-free, once with ONE mid-storm ``publish_weights`` of a
+    perturbed payload rolling through every replica — reporting the
+    publish wall time (fire -> fleet converged on the new version), the
+    goodput ratio vs the publish-free replay (acceptance floor 0.7) and
+    the zero-new-traces gate across the whole drain -> swap -> rotation
+    window (``models/decode.py::TRACE_COUNTS`` delta from the moment of
+    publish must be 0).
 
     ``_model`` injects a prebuilt ``(params, cfg)`` (tier-1 CPU smoke uses
     a tiny model); by default the ``preset`` model is built fresh."""
@@ -1190,6 +1206,21 @@ def run_serve_open_loop_bench(
             "shared_prefix": shared_prefix,
             "router_sweep": r_sweep,
         })
+    def _perturbed_params(idx: int):
+        # deterministic non-trivial payload for publish drills: every
+        # float leaf scaled by a per-publish factor (same shapes/dtypes,
+        # so the hot-swap is zero-trace by construction)
+        import jax
+        import jax.numpy as jnp
+
+        scale = 1.0 + 1e-3 * (idx + 1)
+        return jax.tree_util.tree_map(
+            lambda x: x * scale
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+            else x,
+            params,
+        )
+
     if chaos_seed >= 0:
         # chaos soak: the same storm replayed fault-free and under a
         # seeded deterministic fault schedule against a SELF-HEALING
@@ -1230,6 +1261,7 @@ def run_serve_open_loop_bench(
             chaos_seed, duration_s=chaos_arrivals[-1],
             hang_seconds=2.0 * chaos_stall_s + 1.0,
             expected_ticks=max(50, (n_requests * max_new_tokens) // 8),
+            publishes=max(0, chaos_publishes),
         )
         base_soak = run_chaos_soak(
             router_factory=chaos_factory, requests=clone_requests(proto),
@@ -1237,7 +1269,12 @@ def run_serve_open_loop_bench(
         _beat(phase="serve_chaos_fault_free")
         chaos_soak = run_chaos_soak(
             router_factory=chaos_factory, requests=clone_requests(proto),
-            arrivals=chaos_arrivals, plan=plan, restore_timeout_s=60.0)
+            arrivals=chaos_arrivals, plan=plan,
+            publish_fn=(
+                (lambda router, idx: router.publish_weights(
+                    _perturbed_params(idx), f"storm-v{idx + 1}"))
+                if chaos_publishes > 0 else None),
+            restore_timeout_s=60.0)
         _beat(phase="serve_chaos")
         ratio = (chaos_soak["goodput_tok_s"]
                  / max(base_soak["goodput_tok_s"], 1e-9))
@@ -1255,9 +1292,98 @@ def run_serve_open_loop_bench(
             "fault_free": _slim(base_soak),
             "chaos": _slim(chaos_soak),
             "goodput_ratio": ratio,
+            # mid-storm publish coverage (chaos_publishes > 0): the soak's
+            # invariants_ok already folds in version convergence
+            "publishes": chaos_soak["publishes"],
+            "published_versions": chaos_soak["published_versions"],
+            "version_converged": chaos_soak["version_converged"],
+            "publish_wall_s": chaos_soak["publish_wall_s"],
             "ok": bool(base_soak["invariants_ok"]
                        and chaos_soak["invariants_ok"]
                        and ratio >= 0.7),
+        }
+    if publish:
+        # rolling-publish leg (BENCH_SERVE_PUBLISH=1): the same Poisson
+        # storm replayed publish-free and then with ONE mid-storm rolling
+        # weight publication over the self-healing fleet — publish wall
+        # time, goodput ratio vs the publish-free replay (0.7 floor) and
+        # the zero-new-traces gate from the moment of publish
+        from veomni_tpu.models import decode as decode_mod
+        from veomni_tpu.resilience.chaos import (
+            build_chaos_plan,
+            run_chaos_soak,
+        )
+        from veomni_tpu.serving import Router, RouterConfig
+
+        n_rep = replicas if replicas > 1 else 3
+        pub_rate = max(rates)
+        prng = np.random.default_rng((seed, 778))
+        pub_arrivals = [float(t) for t in np.cumsum(
+            prng.exponential(1.0 / pub_rate, size=n_requests))]
+
+        def publish_factory():
+            router = Router(params, cfg, engine_cfg(
+                queue_bound=queue_bound * n_rep, classes=classes,
+            ), RouterConfig(replicas=n_rep, probation_requests=2,
+                            heartbeat_dir=_bench_out_dir()))
+            # warm twice like the chaos factory: the second pass routes
+            # prefix-cache hits through the chunked-prefill program so
+            # nothing compiles mid-storm
+            for _ in range(2):
+                router.run([Request(prompt_ids=list(r.prompt_ids),
+                                    sampling=r.sampling,
+                                    priority=r.priority) for r in warm])
+            return router
+
+        pub_plan = build_chaos_plan(
+            max(0, chaos_seed) if chaos_seed >= 0 else seed,
+            duration_s=pub_arrivals[-1],
+            kills=0, hangs=0, delays=0, exceptions=0, publishes=1,
+            expected_ticks=max(50, (n_requests * max_new_tokens) // 8),
+        )
+        base_rep = run_chaos_soak(
+            router_factory=publish_factory, requests=clone_requests(proto),
+            arrivals=pub_arrivals, plan=None, restore_timeout_s=60.0)
+        _beat(phase="serve_publish_baseline")
+        trace_mark: dict = {}
+
+        def _publish_payload(router, idx):
+            # trace census snapshot at the MOMENT of publish: the gate
+            # covers exactly the drain -> swap -> rotation window
+            trace_mark.update(decode_mod.TRACE_COUNTS)
+            return router.publish_weights(_perturbed_params(idx),
+                                          f"publish-v{idx + 1}")
+
+        pub_rep = run_chaos_soak(
+            router_factory=publish_factory, requests=clone_requests(proto),
+            arrivals=pub_arrivals, plan=pub_plan,
+            publish_fn=_publish_payload, restore_timeout_s=60.0)
+        _beat(phase="serve_publish")
+        trace_delta = (sum(decode_mod.TRACE_COUNTS.values())
+                       - sum(trace_mark.values()))
+        pratio = (pub_rep["goodput_tok_s"]
+                  / max(base_rep["goodput_tok_s"], 1e-9))
+
+        def _slim_pub(rep):
+            return {k: v for k, v in rep.items()
+                    if k not in ("outputs", "router")}
+
+        result["publish"] = {
+            "replicas": n_rep,
+            "arrival_rate_rps": pub_rate,
+            "plan": pub_plan.to_doc(),
+            "baseline": _slim_pub(base_rep),
+            "publish": _slim_pub(pub_rep),
+            "published_versions": pub_rep["published_versions"],
+            "publish_wall_s": pub_rep["publish_wall_s"],
+            "version_converged": pub_rep["version_converged"],
+            "goodput_ratio": pratio,
+            "trace_delta": trace_delta,
+            "ok": bool(base_rep["invariants_ok"]
+                       and pub_rep["invariants_ok"]
+                       and pub_rep["version_converged"]
+                       and pratio >= 0.7
+                       and trace_delta == 0),
         }
     return result
 
@@ -1319,6 +1445,15 @@ def _serve_open_loop_main(preset: str, watchdog=None):
         chaos_stall_s=float(
             os.environ.get("BENCH_SERVE_CHAOS_STALL_S", 2.0)
         ),
+        # BENCH_SERVE_CHAOS_PUBLISHES=N fires N mid-storm rolling weight
+        # publications inside the chaos leg; BENCH_SERVE_PUBLISH=1 adds
+        # the dedicated rolling-publish leg (publish wall time, goodput
+        # ratio vs publish-free replay, zero-new-traces gate)
+        chaos_publishes=int(
+            os.environ.get("BENCH_SERVE_CHAOS_PUBLISHES", 0)
+        ),
+        publish=os.environ.get("BENCH_SERVE_PUBLISH", "0")
+        not in ("0", ""),
     )
     if watchdog is not None:
         watchdog.stop()
@@ -1394,6 +1529,20 @@ def _serve_open_loop_main(preset: str, watchdog=None):
             "chaos_wedged": r["chaos"]["chaos"]["wedged"],
             "chaos_respawns": r["chaos"]["chaos"]["respawns"],
         } if "chaos" in r else {}),
+        # rolling-publish leg when BENCH_SERVE_PUBLISH=1: publish wall
+        # time, goodput ratio vs the publish-free replay and the
+        # zero-new-traces verdict
+        **({
+            "publish": {
+                k: (round(v, 5) if isinstance(v, float) else v)
+                for k, v in r["publish"].items()
+                if k not in ("baseline", "publish", "plan")
+            },
+            "publish_ok": r["publish"]["ok"],
+            "publish_goodput_ratio": round(
+                r["publish"]["goodput_ratio"], 5),
+            "publish_trace_delta": r["publish"]["trace_delta"],
+        } if "publish" in r else {}),
     }), flush=True)
     _cleanup_default_out()  # healthy exit: don't leak the per-PID /tmp dir
 

@@ -4,10 +4,13 @@ Runs the bench's chaos soak leg (``bench.run_serve_open_loop_bench`` with
 ``chaos_seed``) on the tiny CPU model: a fixed-seed deterministic fault
 schedule — replica kill + hang/delay/exception across the serve fault
 points — fires over a 3-replica self-healing router while an open-loop
-Poisson storm replays, then the same storm replays fault-free. Exits 0
-only when every fleet invariant holds on both runs (no lost/duplicated
-request ids, zero leaked KV blocks per survivor, fleet restored to full
-live count) and chaos goodput stays >= 70% of the fault-free replay.
+Poisson storm replays, then the same storm replays fault-free. The plan
+also schedules one mid-storm weight publish, so the drill covers the
+rolling hot-swap path under fire. Exits 0 only when every fleet
+invariant holds on both runs (no lost/duplicated request ids, zero
+leaked KV blocks per survivor, fleet restored to full live count, fleet
+converged to the published weights version) and chaos goodput stays
+>= 70% of the fault-free replay.
 
 Budgeted for CI: one rate, a small storm, aggressive (sub-second) wedge
 deadlines — the whole drill finishes in well under a minute on CPU.
@@ -53,7 +56,7 @@ def main() -> int:
     r = bench.run_serve_open_loop_bench(
         num_slots=2, block_size=8, n_requests=16, prompt_lens=(8, 12),
         max_new_tokens=6, arrival_rates=(2.5,), seed=SEED,
-        chaos_seed=SEED, chaos_stall_s=0.5,
+        chaos_seed=SEED, chaos_stall_s=0.5, chaos_publishes=1,
         _model=(params, cfg),
     )
     c = r["chaos"]
@@ -69,6 +72,9 @@ def main() -> int:
         "lost_ids": c["chaos"]["lost_ids"],
         "leaked_blocks": c["chaos"]["leaked_blocks"],
         "restored": c["chaos"]["restored"],
+        "publishes": c["chaos"]["publishes"],
+        "published_versions": c["chaos"]["published_versions"],
+        "version_converged": c["chaos"]["version_converged"],
         "fault_free_quiet": (c["fault_free"]["wedged"] == 0
                              and c["fault_free"]["respawns"] == 0),
         "plan": c["plan"],
@@ -90,6 +96,12 @@ def main() -> int:
         # the schedule's determinism) regressed, not that the fleet got
         # lucky
         print("CHAOS_SMOKE FAILED: expected >= 1 wedge from this seed",
+              file=sys.stderr)
+        return 1
+    if c["chaos"]["publishes"] != 1 or not c["chaos"]["version_converged"]:
+        # the plan schedules exactly one mid-storm publish; the fleet
+        # must end the drill serving that version everywhere
+        print("CHAOS_SMOKE FAILED: mid-storm publish did not converge",
               file=sys.stderr)
         return 1
     return 0

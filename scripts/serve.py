@@ -56,6 +56,17 @@ VEOMNI_SERVE_PROBATION (clean completions a respawned replica must serve
 on spill traffic before rejoining affinity rotation, default 2),
 VEOMNI_SERVE_MIN_LIVE (live-replica floor under which /healthz answers
 503, default 1).
+Live weight publication (docs/serving.md "Versioned weight
+publication"): ``--publish-from <step dir>`` (VEOMNI_SERVE_PUBLISH_FROM)
+loads a committed checkpoint generation through the integrity gate
+(VEOMNI_SERVE_PUBLISH_VERIFY: off|size|full, default size — corrupt or
+uncommitted generations are refused before any live buffer is touched)
+and hot-publishes it: router mode rolls the fleet replica-by-replica
+after the first token lands (drain -> in-place swap -> prefix-cache
+flush, zero new traces); bare-engine mode swaps in place before serving.
+VEOMNI_SERVE_PUBLISH_VERSION tags the published version (default: the
+step dir's basename). /healthz and /debug/router report the fleet
+weights version, per-replica versions and publish-in-progress.
 VEOMNI_METRICS_PORT
 serves Prometheus /metrics + /healthz while the pump runs (healthz carries
 rejected/deadline-miss counts); /debug/requests
@@ -95,6 +106,31 @@ def _build_model(args):
     model = build_foundation_model(config=cfg)
     params = model.family.init_params(jax.random.PRNGKey(args.seed), cfg)
     return params, cfg
+
+
+def _ckpt_params_loader(step_dir):
+    """Restore the params subtree of a trainer checkpoint generation.
+
+    Abstract target comes from on-disk metadata (same idiom as
+    merge_checkpoint_to_hf.py), so the loader needs no knowledge of the
+    optimizer that produced the checkpoint. This orbax version has no
+    partial restore, so optimizer moments are materialized then dropped —
+    budget host RAM accordingly for big models.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(os.path.abspath(step_dir), "train_state")
+    ckptr = ocp.StandardCheckpointer()
+    meta = ckptr.metadata(path)
+    # older orbax returns the tree metadata directly; newer wraps it
+    meta = getattr(meta, "item_metadata", meta)
+    target = jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+        {"params": meta["params"], "opt_state": meta["opt_state"],
+         "step": meta["step"]},
+    )
+    return ckptr.restore(path, target)["params"]
 
 
 def main():
@@ -184,6 +220,23 @@ def main():
                          "optional priority/tenant/deadline_s/"
                          "max_new_tokens/temperature/top_k/top_p/eos_id/"
                          "seed); '-' reads stdin")
+    ap.add_argument("--publish-from",
+                    default=os.environ.get("VEOMNI_SERVE_PUBLISH_FROM", ""),
+                    help="checkpoint step dir (global_step_N) to hot-"
+                         "publish: integrity-gated load, then rolling "
+                         "in-place swap mid-serve (router mode) or a "
+                         "pre-serve swap (bare engine)")
+    ap.add_argument("--publish-version",
+                    default=os.environ.get("VEOMNI_SERVE_PUBLISH_VERSION",
+                                           ""),
+                    help="version tag for the published weights "
+                         "(default: the step dir's basename)")
+    ap.add_argument("--publish-verify", choices=("off", "size", "full"),
+                    default=os.environ.get("VEOMNI_SERVE_PUBLISH_VERIFY",
+                                           "size"),
+                    help="manifest verification mode for --publish-from "
+                         "(docs/resilience.md; corrupt generations are "
+                         "refused before any buffer is touched)")
     args = ap.parse_args()
 
     import numpy as np
@@ -250,6 +303,32 @@ def main():
         "kv_block_bytes": cap["block_bytes"],
         "kv_max_concurrent_seqs": cap["max_concurrent_seqs"],
     }), flush=True)
+    # --publish-from: load THROUGH the integrity gate before serving a
+    # single token, so a corrupt/uncommitted generation fails fast here
+    # with an actionable error instead of mid-serve. The actual swap is
+    # deferred: router mode rolls it after the first token lands (the
+    # hot-publish path this flag exists to exercise); bare-engine mode
+    # swaps in place right away (the engine refuses swaps while busy).
+    publish_params = None
+    publish_version = ""
+    if args.publish_from:
+        from veomni_tpu.resilience.integrity import CheckpointCorruptError
+        from veomni_tpu.serving import load_published_params
+
+        try:
+            publish_params = load_published_params(
+                args.publish_from, _ckpt_params_loader,
+                verify_mode=args.publish_verify)
+        except CheckpointCorruptError as e:
+            raise SystemExit(
+                f"--publish-from refused by integrity gate: {e}")
+        publish_version = args.publish_version or os.path.basename(
+            os.path.normpath(args.publish_from))
+    if publish_params is not None and router is None:
+        info = driver.swap_weights(publish_params)
+        print(json.dumps({"publish": publish_version, "mode": "pre-serve",
+                          **info}), flush=True)
+        publish_params = None  # consumed
     # VEOMNI_METRICS_PORT: Prometheus /metrics + /healthz + /debug/flight +
     # /debug/requests (per-request timelines) for the pump loop (the engine
     # feeds the same registry the trainer exports through)
@@ -371,6 +450,15 @@ def main():
             if ev.finished:
                 line["finished"] = ev.finish_reason
             print(json.dumps(line), flush=True)
+            if publish_params is not None:
+                # router mode: fire the rolling publish once the fleet
+                # is demonstrably serving (first token landed). step()
+                # drains each replica and swaps in place from here on;
+                # generate() keeps pumping until the fleet converges.
+                router.publish_weights(publish_params, publish_version)
+                print(json.dumps({"publish": publish_version,
+                                  "mode": "rolling"}), flush=True)
+                publish_params = None
         outs = driver.run()  # no-op drain; collects final outputs
     except BaseException as e:
         # same contract as trainer.train(): a pump that dies mid-decode

@@ -18,6 +18,7 @@ The load-bearing guarantees, layered on the engine's own:
 """
 
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -386,8 +387,10 @@ def test_add_replica_shares_programs_zero_new_traces(qwen3):
 
 
 def test_publish_weights_versioning(qwen3):
-    """New replicas serve the latest published version; existing replicas
-    keep theirs (no mid-stream weight change)."""
+    """New replicas serve the latest published version immediately;
+    existing replicas keep theirs until the rolling publish (driven by
+    ``step()``) reaches them — never mid-stream, always via the drain
+    fence (docs/serving.md "Versioned weight publication")."""
     params, cfg = qwen3
     r = Router(params, cfg, EngineConfig(
         num_slots=1, block_size=8, max_model_len=32,
@@ -398,9 +401,23 @@ def test_publish_weights_versioning(qwen3):
     assert r.publish_weights(p2, "v1") == "v1"
     h = r.add_replica()
     assert h.weights_version == "v1"
+    # snapshot BEFORE any step(): the roll is lazy, nothing swapped yet
     assert all(x.weights_version == "v0"
                for x in r.live_replicas() if x.rid in old)
     assert r.debug_doc()["weights_version"] == "v1"
+    assert r.publish_in_progress
+    # an idle fleet converges through step() alone (has_work holds the
+    # pump open while any serving replica is off the latest version)
+    deadline = time.perf_counter() + 30.0
+    while r.has_work and time.perf_counter() < deadline:
+        r.step()
+    assert not r.publish_in_progress
+    assert all(x.weights_version == "v1" for x in r.live_replicas())
+    doc = r.debug_doc()
+    assert doc["publishes"] == 1 and not doc["publish_in_progress"]
+    health = r.health()
+    assert health["weights_version"] == "v1"
+    assert set(health["replica_weights"].values()) == {"v1"}
 
 
 # ----------------------------------------------------------- observability

@@ -8,9 +8,12 @@ can be replayed bit-for-bit from the one integer in its report. The
 faults are composed from the EXISTING ``resilience/faults.py`` grammar —
 ``hang``/``delay``/``exception`` across the serving points
 ``serve.admit``/``serve.prefill``/``serve.decode_tick`` (docs/
-resilience.md "Fault-point catalog") — plus router-level replica kills,
-which the fault layer cannot express because they are a *control-plane*
-action (``Router.kill_replica``), not a code-path fault.
+resilience.md "Fault-point catalog") — plus router-level replica kills
+and mid-storm weight publishes, which the fault layer cannot express
+because they are *control-plane* actions (``Router.kill_replica``,
+``Router.publish_weights``), not code-path faults. Publish events are
+drawn AFTER every fault and kill draw, so adding ``publishes=N`` to a
+plan never moves the faults/kills an existing seed pins.
 
 :func:`run_chaos_soak` is the shared storm driver behind the bench's
 ``BENCH_SERVE_CHAOS=<seed>`` leg, the tier-1 ``scripts/chaos_smoke.py``
@@ -68,6 +71,17 @@ class KillEvent:
     pick: int
 
 
+@dataclass(frozen=True)
+class PublishEvent:
+    """One scheduled mid-storm weight publish: at ``at_s`` the soak calls
+    its ``publish_fn`` (which runs ``Router.publish_weights``) and then
+    watches the rolling swap converge — the chaos coverage for the
+    PUBLISHING state machine (docs/serving.md "Versioned weight
+    publication")."""
+
+    at_s: float
+
+
 @dataclass
 class ChaosPlan:
     """A seeded, fully deterministic chaos schedule."""
@@ -76,6 +90,7 @@ class ChaosPlan:
     duration_s: float
     faults: List[Dict[str, Any]] = field(default_factory=list)
     kills: List[KillEvent] = field(default_factory=list)
+    publishes: List[PublishEvent] = field(default_factory=list)
 
     def fault_plan(self) -> List[Dict[str, Any]]:
         """The ``faults.py`` spec list — feed to ``configure_faults`` (or
@@ -85,6 +100,9 @@ class ChaosPlan:
     def kill_events(self) -> List[KillEvent]:
         return sorted(self.kills, key=lambda k: k.at_s)
 
+    def publish_events(self) -> List[PublishEvent]:
+        return sorted(self.publishes, key=lambda p: p.at_s)
+
     def to_doc(self) -> Dict[str, Any]:
         """JSON-ready canonical form (bench artifacts, determinism pin)."""
         return {
@@ -93,6 +111,8 @@ class ChaosPlan:
             "faults": [dict(f) for f in self.faults],
             "kills": [{"at_s": k.at_s, "pick": k.pick}
                       for k in self.kill_events()],
+            "publishes": [{"at_s": p.at_s}
+                          for p in self.publish_events()],
         }
 
 
@@ -100,7 +120,8 @@ def build_chaos_plan(seed: int, *, duration_s: float = 10.0,
                      kills: int = 1, hangs: int = 1, delays: int = 2,
                      exceptions: int = 1, hang_seconds: float = 2.0,
                      delay_ms: float = 20.0,
-                     expected_ticks: int = 400) -> ChaosPlan:
+                     expected_ticks: int = 400,
+                     publishes: int = 0) -> ChaosPlan:
     """Draw a deterministic chaos schedule from ``seed``.
 
     ``expected_ticks`` scales the fault hit positions: fault-layer hit
@@ -108,7 +129,10 @@ def build_chaos_plan(seed: int, *, duration_s: float = 10.0,
     are drawn from ``[2, expected_ticks)`` to land mid-storm rather than
     stacking on the first tick. Kills are drawn from the middle 15–70% of
     ``duration_s`` so the fleet is busy when they land and has storm left
-    to recover in. Same seed -> identical plan, field for field.
+    to recover in; ``publishes`` schedules mid-storm weight publications
+    in the same window, drawn AFTER every other event so the faults and
+    kills an existing seed pins stay bit-identical when publish coverage
+    is added. Same seed -> identical plan, field for field.
     """
     if duration_s <= 0:
         raise ValueError("duration_s must be > 0")
@@ -140,8 +164,15 @@ def build_chaos_plan(seed: int, *, duration_s: float = 10.0,
                   pick=rng.randrange(0, 8))
         for _ in range(max(0, kills))
     ]
+    # publishes draw LAST: a seed's faults/kills stay bit-identical
+    # whether or not the caller asks for publish coverage
+    publish_events = [
+        PublishEvent(at_s=round(rng.uniform(0.15, 0.70) * duration_s, 3))
+        for _ in range(max(0, publishes))
+    ]
     return ChaosPlan(seed=int(seed), duration_s=float(duration_s),
-                     faults=faults, kills=kill_events)
+                     faults=faults, kills=kill_events,
+                     publishes=publish_events)
 
 
 def run_chaos_soak(*, router_factory: Callable[[], Any],
@@ -149,6 +180,7 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
                    plan: Optional[ChaosPlan] = None,
                    probe_request_fn: Optional[Callable[[int], List[Any]]]
                    = None,
+                   publish_fn: Optional[Callable[[Any, int], str]] = None,
                    restore: bool = True,
                    restore_timeout_s: float = 30.0) -> Dict[str, Any]:
     """Drive one open-loop storm through a fresh router while ``plan``'s
@@ -163,16 +195,35 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
     (default: clones of ``requests[0]``'s prompt) — bursts sized to push
     every live replica past the spill threshold, so probation replicas
     receive the spill traffic they need to pass.
+
+    ``publish_fn(router, idx)`` fires at each of the plan's publish
+    events: it must call ``router.publish_weights`` (with whatever
+    payload the caller stages) and return the version tag. The soak then
+    times the rolling swap to convergence (``publish_wall_s``) and adds
+    a **version convergence** invariant: after restore, every serving
+    replica reports ONE weights version and no publish is still in
+    progress. A plan that schedules publishes without a ``publish_fn``
+    is an error — silently skipping scheduled chaos would report
+    coverage that never ran.
     """
     from veomni_tpu.resilience.faults import configure_faults, disarm_faults
     from veomni_tpu.serving.api import Request, SamplingParams
 
+    if plan is not None and plan.publishes and publish_fn is None:
+        raise ValueError(
+            "chaos plan schedules publish events but no publish_fn was "
+            "given: the publish coverage would silently not run"
+        )
     router = router_factory()
     n_cfg = router.config.replicas
     kills = plan.kill_events() if plan is not None else []
+    publishes = plan.publish_events() if plan is not None else []
     if plan is not None:
         configure_faults(plan.fault_plan())
     ids: List[str] = []
+    published: List[str] = []
+    publish_walls: List[float] = []
+    pub_t0: Optional[float] = None
     stalled = False
     t0 = time.perf_counter()
     try:
@@ -188,6 +239,12 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
                                    victim.rid, t)
                     router.kill_replica(
                         victim.rid, reason=f"chaos kill @{ev.at_s:.2f}s")
+            while publishes and t >= publishes[0].at_s:
+                ev = publishes.pop(0)
+                logger.warning("chaos: publishing weights mid-storm "
+                               "(t=%.2fs)", t)
+                published.append(str(publish_fn(router, len(published))))
+                pub_t0 = time.perf_counter()
             while i < len(requests) and arrivals[i] <= t:
                 ids.append(router.submit(requests[i]))
                 i += 1
@@ -202,6 +259,9 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
                     break
             elif i < len(requests):
                 time.sleep(min(max(arrivals[i] - t, 0.0), 0.01))
+            if pub_t0 is not None and not router.publish_in_progress:
+                publish_walls.append(time.perf_counter() - pub_t0)
+                pub_t0 = None
         duration_s = time.perf_counter() - t0
     finally:
         if plan is not None:
@@ -210,6 +270,14 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
     # fault-free from here on: land pending respawns and graduate
     # probation replicas so the fleet returns to its configured size
     probes: List[str] = []
+    if publishes and not stalled:
+        # the storm drained before a scheduled publish time arrived: fire
+        # the remaining events now rather than silently skipping chaos
+        # coverage the plan promised
+        for _ in list(publishes):
+            publishes.pop(0)
+            published.append(str(publish_fn(router, len(published))))
+            pub_t0 = time.perf_counter()
     if restore and not stalled:
         if probe_request_fn is None and requests:
             base = list(requests[0].prompt_ids)
@@ -235,6 +303,10 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
                 except RuntimeError:
                     stalled = True
                     break
+                if (pub_t0 is not None
+                        and not router.publish_in_progress):
+                    publish_walls.append(time.perf_counter() - pub_t0)
+                    pub_t0 = None
                 continue
             if probe_request_fn is None:
                 break
@@ -254,6 +326,9 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
             for req in probe_request_fn(burst):
                 probes.append(router.submit(req))
     # ----------------------------------------------------------- invariants
+    if pub_t0 is not None and not router.publish_in_progress:
+        publish_walls.append(time.perf_counter() - pub_t0)
+        pub_t0 = None
     outs = {rid: router._outputs[rid]
             for rid in ids if rid in router._outputs}
     lost = sorted(set(ids) - set(outs))
@@ -271,6 +346,19 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
         and not getattr(o, "deadline_missed", False)
     )
     live_count = len(router.live_replicas())
+    # version convergence: after a mid-storm publish every serving
+    # replica must report ONE weights version (the latest) with no
+    # publish still rolling — the mixed-version window must CLOSE
+    serving_versions = sorted({
+        h.weights_version for h in router.replicas.values()
+        if h.state in ("live", "probation", "publishing")
+    })
+    version_converged = (
+        not published
+        or (len(serving_versions) <= 1
+            and not router.publish_in_progress
+            and not stalled)
+    )
     report = {
         "seed": plan.seed if plan is not None else None,
         "submitted": len(ids),
@@ -290,10 +378,15 @@ def run_chaos_soak(*, router_factory: Callable[[], Any],
         "goodput_tok": goodput_tok,
         "duration_s": duration_s,
         "goodput_tok_s": goodput_tok / max(duration_s, 1e-9),
+        "publishes": len(published),
+        "published_versions": published,
+        "serving_versions": serving_versions,
+        "version_converged": version_converged,
+        "publish_wall_s": round(sum(publish_walls), 6),
     }
     report["invariants_ok"] = bool(
         not report["duplicated"] and not lost and not leaked
-        and report["restored"] and not stalled
+        and report["restored"] and not stalled and version_converged
     )
     report["outputs"] = outs
     report["router"] = router

@@ -56,6 +56,14 @@ Fault points wired through the stack:
                 (``exception`` burns a ``max_respawns`` budget attempt and
                 reschedules the backoff; hitting it repeatedly drives the
                 lineage into permanent retirement)
+``serve.publish`` per replica weight hot-swap (context: the rid), on the
+                router thread, after the replica drained but BEFORE its
+                engine buffers are touched — the kill-mid-publish drill: an
+                ``exception`` kills the replica mid-publish (normal failure
+                triage; its respawn attaches at the LATEST version),
+                ``delay`` widens the mixed-version window. Runs on the
+                router thread like ``serve.admit``, so ``hang`` would stall
+                the whole front door — use exception/delay here
 ==============  ==============================================================
 
 Plan grammar (``VEOMNI_FAULT_PLAN`` holds the JSON text, or ``@/path/to.json``):
@@ -117,7 +125,7 @@ ENV_PLAN = "VEOMNI_FAULT_PLAN"
 KNOWN_POINTS = ("ckpt.save", "ckpt.restore", "ckpt.manifest", "ckpt.reshard",
                 "data.fetch", "data.record", "step.loss", "step.delay",
                 "step.params", "serve.admit", "serve.prefill",
-                "serve.decode_tick", "serve.spawn")
+                "serve.decode_tick", "serve.spawn", "serve.publish")
 
 _MODES = ("exception", "nan", "hang", "delay", "corrupt")
 

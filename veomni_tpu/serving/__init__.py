@@ -43,6 +43,11 @@ from veomni_tpu.serving.scheduler import (
     SequenceState,
     parse_classes,
 )
+from veomni_tpu.serving.weights import (
+    WeightRecord,
+    WeightStore,
+    load_published_params,
+)
 
 __all__ = [
     "DEFAULT_CLASSES",
@@ -64,4 +69,7 @@ __all__ = [
     "SequenceState",
     "SharedPrograms",
     "StreamEvent",
+    "WeightRecord",
+    "WeightStore",
+    "load_published_params",
 ]

@@ -269,6 +269,10 @@ class InferenceEngine:
         self.prefix_cache = (
             PrefixCache(self.blocks) if ec.prefix_cache else None
         )
+        # weight-publication epoch: bumped by swap_weights() even when the
+        # prefix cache is disabled, so "which weights produced this
+        # engine's KV" is always observable
+        self.cache_epoch = 0
         # observability registry view: with a metrics_label every serve.*
         # instrument this engine (and its tracer) creates carries the
         # instance label — N router replicas stop clobbering each other's
@@ -595,6 +599,65 @@ class InferenceEngine:
             return request.request_id
         self._m_queue.set(self.scheduler.queue_depth)
         return request.request_id
+
+    # ---------------------------------------------------------- weight swap
+    def swap_weights(self, params) -> Dict[str, int]:
+        """Hot-swap the engine's weights in place, invalidating all cached
+        KV. ``params`` is the UNQUANTIZED pytree; the engine re-applies
+        its own ``weight_quant`` storage transform exactly as at
+        construction, so a quantized tier swaps quantized buffers.
+
+        Contract (docs/serving.md "Versioned weight publication"):
+
+        * the engine must be drained — no waiting or running sequences
+          (the router's PUBLISHING state guarantees this; a direct caller
+          gets a hard error, never a mid-stream weight change);
+        * the payload must be shape/dtype-congruent with the current
+          weights — a mismatched payload is a different model, refused
+          before any state changes (it would also silently retrace every
+          jitted program);
+        * the prefix cache is flushed under a bumped ``cache_epoch``
+          (stale KV from the old weights becomes unreachable) and the
+          block-manager no-leak identity is conserved across the flush;
+        * ZERO new traces: the jitted steps take params as per-call
+          arguments, so congruent buffers reuse every compiled program.
+
+        Returns ``{"flushed_blocks": n, "cache_epoch": e}``.
+        """
+        if self.scheduler.has_work:
+            raise RuntimeError(
+                "swap_weights on a busy engine: drain waiting/running "
+                "sequences first (the router's PUBLISHING state does this)"
+            )
+        new_params = (
+            quantize_decode_params(params)
+            if self.config.weight_quant == "int8" else params
+        )
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_params)
+        if old_def != new_def:
+            raise ValueError(
+                "swap_weights payload tree structure differs from the "
+                "serving weights: a publish must carry the same model"
+            )
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            o_sig = (getattr(o, "shape", None), getattr(o, "dtype", None))
+            n_sig = (getattr(n, "shape", None), getattr(n, "dtype", None))
+            if o_sig != n_sig:
+                raise ValueError(
+                    f"swap_weights payload leaf {i} is {n_sig}, serving "
+                    f"weights have {o_sig}: shape/dtype-incongruent "
+                    "payloads are refused (they would retrace)"
+                )
+        self.params = new_params
+        flushed = (
+            self.prefix_cache.flush() if self.prefix_cache is not None
+            else 0
+        )
+        self.cache_epoch += 1
+        self._registry.counter("serve.weights_swaps").inc()
+        self._registry.counter("serve.weights_flushed_blocks").inc(flushed)
+        return {"flushed_blocks": flushed, "cache_epoch": self.cache_epoch}
 
     # ------------------------------------------------------------------ drive
     @property

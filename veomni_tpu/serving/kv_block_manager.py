@@ -151,6 +151,18 @@ class KVBlockManager:
             else:
                 self._free.append(block)
 
+    def reclaim_cached(self, block: int) -> None:
+        """Return a refcount-0 block the prefix cache is dropping to the
+        free list. Only the cache's flush/invalidation paths call this —
+        normal eviction hands the block straight to the claimant via
+        ``_pop_block`` and never lands it back on the free list."""
+        if self._ref[block] != 0:
+            raise RuntimeError(
+                f"reclaim_cached(block={block}) with refcount "
+                f"{self._ref[block]}: cache dropped a referenced block"
+            )
+        self._free.append(block)
+
     # ------------------------------------------------------------ transitions
     def allocate(self, seq_id: str, n_blocks: int) -> List[int]:
         table, _ = self.allocate_shared(seq_id, [], n_blocks)

@@ -59,6 +59,11 @@ class PrefixCache:
         # O(cached-blocks) scan there would cost O(slots x blocks) python
         # per generated token batch
         self._evictable = 0
+        # weight-publication epoch: bumped by flush(). Cached KV is only
+        # valid for the weights that produced it, so a weight swap flushes
+        # the whole tree and advances the epoch — a cheap observable for
+        # tests/metrics that stale entries cannot have survived
+        self.epoch = 0
         manager.attach_cache(self)
 
     # ---------------------------------------------------------------- queries
@@ -139,6 +144,36 @@ class PrefixCache:
             child.last_access = t
             node = child
         return added
+
+    def flush(self) -> int:
+        """Drop EVERY cached block and return them to the manager's free
+        list, bumping the cache epoch. This is the weight-publication
+        invalidation: KV computed under the old weights must become
+        unreachable before the first request runs on the new ones.
+
+        The caller must have drained the engine first — a cached block
+        still referenced by a live sequence cannot be invalidated without
+        corrupting that sequence, so a referenced block is a hard error,
+        not a skip. Blocks return to the free list in sorted order so the
+        post-flush allocation sequence is deterministic. Returns the
+        number of blocks flushed; the no-leak identity is conserved:
+        ``num_cached`` drops to 0 and ``num_free_uncached`` grows by
+        exactly the flushed count."""
+        rc = self.manager.refcount
+        held = sorted(b for b in self._by_block if rc(b) != 0)
+        if held:
+            raise RuntimeError(
+                f"prefix cache flush with {len(held)} referenced cached "
+                f"blocks (e.g. block {held[0]}): engine not drained"
+            )
+        flushed = sorted(self._by_block)
+        for b in flushed:
+            self.manager.reclaim_cached(b)
+        self._root = _Node((), KVBlockManager.NULL_BLOCK, None)
+        self._by_block = {}
+        self._evictable = 0
+        self.epoch += 1
+        return len(flushed)
 
     def evict_lru(self) -> Optional[int]:
         """Remove and return the least-recently-used refcount-0 **leaf**

@@ -8,7 +8,11 @@ needs to know about a replica that the engine itself does not track:
 * **lifecycle state** — ``live`` (in the dispatch rotation), ``probation``
   (a respawned replica serving only spill traffic until it proves itself),
   ``draining`` (finishing in-flight work before a clean detach; receives
-  no new requests), ``wedged`` (its pump thread blew the
+  no new requests), ``publishing`` (receives no new requests while it
+  drains in-flight work on the OLD weights version, then the router
+  hot-swaps its buffers in place and returns it to its prior rotation
+  state at the new version — docs/serving.md "Versioned weight
+  publication"), ``wedged`` (its pump thread blew the
   ``replica_stall_s`` deadline and was abandoned behind the generation
   fence) or ``dead`` (pump raised / killed; its stranded requests were
   re-dispatched or surfaced terminal by the router).
@@ -56,6 +60,7 @@ STATE_DEAD = "dead"
 STATE_DETACHED = "detached"  # drained clean and out of the replica set
 STATE_WEDGED = "wedged"  # pump blew replica_stall_s; thread abandoned
 STATE_PROBATION = "probation"  # respawned; spill-only until proven
+STATE_PUBLISHING = "publishing"  # draining toward a weight hot-swap
 
 
 @dataclass
@@ -82,6 +87,13 @@ class ReplicaHandle:
     lineage: str = ""
     # clean completions served while on probation (router-counted)
     probation_done: int = 0
+    # rolling weight publication (state == "publishing"): the version tag
+    # this replica is draining toward, and the rotation state to restore
+    # after the swap (live replicas return to live, probation replicas
+    # resume probation — a publish must not launder a replica past its
+    # probation sentence)
+    publish_to: str = ""
+    publish_from_state: str = ""
     # consecutive router ticks this handle's pump exceeded replica_stall_s
     stall_ticks: int = 0
     # outstanding pump ticket (router._PumpTicket) — None when the engine
@@ -107,8 +119,11 @@ class ReplicaHandle:
 
     @property
     def pumpable(self) -> bool:
-        """Still stepped by the router (wedged/dead replicas never are)."""
-        return self.state in (STATE_LIVE, STATE_DRAINING, STATE_PROBATION)
+        """Still stepped by the router (wedged/dead replicas never are).
+        A PUBLISHING replica stays pumpable: it must finish its in-flight
+        work on the old weights before the swap can happen."""
+        return self.state in (STATE_LIVE, STATE_DRAINING, STATE_PROBATION,
+                              STATE_PUBLISHING)
 
     @property
     def engine_quiescent(self) -> bool:
@@ -162,6 +177,8 @@ class ReplicaHandle:
         }
         if self.state == STATE_PROBATION:
             doc["probation_done"] = self.probation_done
+        if self.state == STATE_PUBLISHING:
+            doc["publish_to"] = self.publish_to
         if self.stall_ticks:
             doc["stall_ticks"] = self.stall_ticks
         if self.fail_reason:

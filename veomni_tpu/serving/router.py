@@ -43,9 +43,23 @@ Three pillars:
    compiles) and the latest ``publish_weights(params, version)`` payload;
    ``remove_replica()`` drains (no new dispatches, in-flight work
    finishes, outputs captured) then detaches — no lost or duplicated
-   request ids. Old replicas finish on their weights version while new
-   ones serve the new tag: the same interface the trainer hot-swap loop
-   (ROADMAP item 4) publishes into.
+   request ids. ``publish_weights`` itself performs a ROLLING in-place
+   hot-swap of the running fleet (docs/serving.md "Versioned weight
+   publication"): one replica at a time enters PUBLISHING — out of the
+   dispatch rotation, draining its in-flight work on the OLD version
+   (requests never see a mid-stream weight change) — then its engine's
+   buffers are swapped in place (zero new traces: the jitted steps take
+   params per call), its prefix cache flushed under a bumped cache
+   epoch (stale KV from the old weights becomes unreachable, the
+   no-leak block identity conserved), and it returns to rotation at the
+   new version. The roll never drops the LIVE count below ``min_live``
+   while a pending respawn could restore headroom; per-replica
+   ``weights_version`` gauges track the mixed-version window. A replica
+   that dies or wedges MID-publish is triaged by the normal failure
+   path and its respawn attaches at the LATEST published version — the
+   same interface the trainer hot-swap loop (ROADMAP item 4) publishes
+   into, with ``publish_from_checkpoint`` refusing a corrupt generation
+   behind the PR 5 integrity gate before any buffer is touched.
 
 4. **Self-healing fleet** (docs/serving.md "Self-healing fleet"). A
    replica that *raises* dies and sheds; a replica that *hangs* — the
@@ -82,6 +96,7 @@ already-thread-safe metrics registry and flight recorder.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import zlib
@@ -106,10 +121,16 @@ from veomni_tpu.serving.replica import (
     STATE_DRAINING,
     STATE_LIVE,
     STATE_PROBATION,
+    STATE_PUBLISHING,
     STATE_WEDGED,
     ReplicaHandle,
 )
 from veomni_tpu.serving.scheduler import QoSPicker, parse_classes
+from veomni_tpu.serving.weights import (
+    WeightRecord,
+    WeightStore,
+    load_published_params,
+)
 from veomni_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -124,6 +145,7 @@ STATE_CODES = {
     STATE_WEDGED: 3,
     STATE_DEAD: 4,
     STATE_DETACHED: 5,
+    STATE_PUBLISHING: 6,  # transient: returns to live/probation post-swap
 }
 
 
@@ -285,10 +307,11 @@ class Router:
             rc.tenant_max_inflight if rc.tenant_max_inflight is not None
             else ec.tenant_max_inflight
         )
-        # versioned weights: replicas added later serve the latest publish
-        self._params = params
+        # versioned weights: every spawn (boot, add_replica, respawn)
+        # reads the store's LATEST record, so a replica resurrected after
+        # a publish attaches at the new version, never the boot payload
+        self._weights = WeightStore(params, "v0")
         self._cfg = cfg
-        self._weights_version = "v0"
         self.replicas: Dict[str, ReplicaHandle] = {}
         self.retired: List[ReplicaHandle] = []
         self._next_rid = 0
@@ -310,6 +333,7 @@ class Router:
         self._wedged_total = 0
         self._respawn_total = 0
         self._probation_total = 0
+        self._publish_total = 0
         # self-healing scheduler state: pending respawns (due-dated by the
         # deterministic backoff), the per-lineage budget ledger, and the
         # lineages that exhausted it (permanently retired)
@@ -332,6 +356,9 @@ class Router:
         self._m_wedged = self._reg.counter("serve.router.wedged")
         self._m_respawns = self._reg.counter("serve.router.respawns")
         self._m_probation = self._reg.counter("serve.router.probation")
+        self._m_publishes = self._reg.counter("serve.router.publishes")
+        self._m_publish_gauge = self._reg.gauge(
+            "serve.router.publish_in_progress")
         self._m_live = self._reg.gauge("serve.router.replicas_live")
         self._m_queue = self._reg.gauge("serve.router.queue_depth")
         self._m_hit_rate = self._reg.gauge("serve.router.prefix_hit_rate")
@@ -478,22 +505,171 @@ class Router:
         terminal, never hung."""
         self._on_replica_failure(self.replicas[rid], RuntimeError(reason))
 
+    # ------------------------------------------------------ weight publish
     def publish_weights(self, params, version: str) -> str:
-        """Publish a new weights payload under a version tag. Replicas
-        added from now on serve it; existing replicas finish on the
-        version they were built with (in-flight requests never see a
-        mid-stream weight change). A full in-place hot-swap of live
-        replicas plugs in here later (ROADMAP item 4) — the version tag
-        is the interface both sides already agree on."""
-        self._params = params
-        self._weights_version = str(version)
-        _flight_record("router.weights_published", cid=self._weights_version)
-        self._refresh_debug()
-        return self._weights_version
+        """Publish a new weights payload under a version tag and roll it
+        into the RUNNING fleet (docs/serving.md "Versioned weight
+        publication"). The payload lands in the :class:`WeightStore`
+        immediately — replicas spawned from now on (``add_replica``,
+        respawns) serve it — and ``step()`` then rolls the existing
+        fleet one replica at a time: PUBLISHING (out of rotation) ->
+        drain in-flight work on the old version -> in-place buffer swap
+        + prefix-cache flush under a bumped cache epoch -> back to
+        rotation at the new version. Zero new traces across the whole
+        drain->swap->rotation window; the LIVE count never drops below
+        ``min_live`` while waiting could restore headroom. An idle fleet
+        converges on the caller's next ``step()``/``run()`` drive
+        (``has_work`` stays True until every serving replica is on the
+        latest version). Duplicate version tags are refused — tags are
+        immutable once published."""
+        rec = self._weights.put(str(version), params)
+        self._publish_total += 1
+        self._m_publishes.inc()
+        _flight_record("router.weights_published", cid=rec.version,
+                       seq=rec.seq)
+        logger.info(
+            "router: weights %s published (seq %d); rolling %d serving "
+            "replica(s)", rec.version, rec.seq,
+            sum(1 for h in self.replicas.values()
+                if h.state in (STATE_LIVE, STATE_PROBATION)))
+        self._publish_gauges()
+        return rec.version
+
+    def publish_from_checkpoint(self, step_dir: str, loader,
+                                *, version: Optional[str] = None,
+                                verify_mode: str = "size") -> str:
+        """``publish_weights`` from a checkpoint generation, behind the
+        PR 5 integrity gate: an uncommitted directory or a manifest that
+        fails verification raises ``CheckpointCorruptError`` BEFORE
+        ``loader`` materializes a single byte — no replica buffer is
+        ever touched by a corrupt generation. ``version`` defaults to
+        the generation's directory name (e.g. ``step_000400``)."""
+        params = load_published_params(step_dir, loader,
+                                       verify_mode=verify_mode)
+        if version is None:
+            version = os.path.basename(os.path.normpath(step_dir))
+        return self.publish_weights(params, version)
+
+    @property
+    def _params(self):
+        """Latest published params — what every new spawn attaches to."""
+        return self._weights.latest.params
+
+    @property
+    def _weights_version(self) -> str:
+        return self._weights.latest.version
 
     @property
     def weights_version(self) -> str:
         return self._weights_version
+
+    @property
+    def publish_in_progress(self) -> bool:
+        """True while any SERVING replica (live/probation/publishing) is
+        not yet on the latest published version — the mixed-version
+        window. Draining replicas finish on their version and detach;
+        they never hold a publish open."""
+        latest = self._weights.latest.version
+        return any(
+            h.state == STATE_PUBLISHING or h.weights_version != latest
+            for h in self.replicas.values()
+            if h.state in (STATE_LIVE, STATE_PROBATION, STATE_PUBLISHING)
+        )
+
+    def _advance_publish(self) -> None:
+        """One rolling-publish step, run at the top of every ``step()``:
+        complete any PUBLISHING replica that has drained (swap + flush +
+        return to rotation), then move at most ONE stale serving replica
+        into PUBLISHING — one at a time keeps the out-of-rotation window
+        minimal and the live floor honest."""
+        latest = self._weights.latest
+        publishing = [h for h in self.replicas.values()
+                      if h.state == STATE_PUBLISHING]
+        for h in publishing:
+            if (h.pump is None and not h.engine.has_work
+                    and not h.assigned):
+                self._swap_replica(h, latest)
+        if any(h.state == STATE_PUBLISHING
+               for h in self.replicas.values()):
+            return  # one replica out of rotation at a time
+        stale = [h for h in self.replicas.values()
+                 if h.state in (STATE_LIVE, STATE_PROBATION)
+                 and h.weights_version != latest.version]
+        if not stale:
+            return
+        # probation replicas first (they are outside the live rotation —
+        # no floor impact), then the least-loaded live replica (shortest
+        # drain); rid tiebreak keeps the roll deterministic
+        stale.sort(key=lambda h: (h.state != STATE_PROBATION,
+                                  len(h.assigned) + h.queue_depth(),
+                                  h.rid))
+        h = stale[0]
+        if h.state == STATE_LIVE:
+            n_live = sum(1 for o in self.replicas.values()
+                         if o.state == STATE_LIVE)
+            if (n_live - 1 < self.config.min_live
+                    and self._pending_respawns):
+                # taking this replica would breach min_live and a pending
+                # respawn could still restore headroom: wait for it. With
+                # nothing pending, waiting cannot help — the roll
+                # proceeds (briefly under the floor) because holding the
+                # fleet on stale weights forever is the worse failure.
+                return
+        h.publish_from_state = h.state
+        h.publish_to = latest.version
+        h.state = STATE_PUBLISHING
+        _flight_record("router.publish_replica", cid=h.rid,
+                       prev=h.weights_version, to=latest.version,
+                       assigned=len(h.assigned))
+        logger.info(
+            "router: replica %s PUBLISHING %s -> %s (%d in-flight to "
+            "drain)", h.rid, h.weights_version, latest.version,
+            len(h.assigned))
+        # already drained (idle replica): swap within the same tick — the
+        # out-of-rotation window closes before dispatch even runs
+        if h.pump is None and not h.engine.has_work and not h.assigned:
+            self._swap_replica(h, latest)
+
+    def _swap_replica(self, h: ReplicaHandle, rec: WeightRecord) -> None:
+        """In-place hot-swap of a drained PUBLISHING replica's engine:
+        the ``serve.publish`` fault point fires first (the deterministic
+        kill-mid-publish drill), then the engine swaps buffers and
+        flushes its prefix cache under a bumped cache epoch. A swap that
+        raises is a replica failure — the normal triage runs and the
+        respawn attaches at the LATEST version, so the fleet still
+        converges to exactly one version."""
+        t0 = time.perf_counter()
+        try:
+            fault_point("serve.publish", context=h.rid)
+            info = h.engine.swap_weights(rec.params)
+        except Exception as e:  # noqa: BLE001 — a publish casualty is a
+            # replica casualty: triaged, respawned at the new version
+            logger.warning(
+                "router: replica %s died mid-publish (%s); its respawn "
+                "attaches at %s", h.rid, e, rec.version)
+            self._on_replica_failure(h, e)
+            return
+        prev = h.weights_version
+        h.weights_version = rec.version
+        h.state = h.publish_from_state or STATE_LIVE
+        h.publish_from_state = ""
+        h.publish_to = ""
+        self._reg.gauge(f"serve.router.{h.rid}.weights_version").set(
+            self._weights.seq(rec.version))
+        _flight_record("router.publish_swapped", cid=h.rid,
+                       prev=prev, to=rec.version,
+                       flushed_blocks=info["flushed_blocks"],
+                       cache_epoch=info["cache_epoch"],
+                       wall_s=round(time.perf_counter() - t0, 6))
+        logger.info(
+            "router: replica %s swapped %s -> %s (%d cached blocks "
+            "flushed, cache epoch %d); back in rotation", h.rid, prev,
+            rec.version, info["flushed_blocks"], info["cache_epoch"])
+        if not self.publish_in_progress:
+            _flight_record("router.publish_done", cid=rec.version,
+                           replicas=len(self.replicas))
+            logger.info("router: fleet converged on weights %s",
+                        rec.version)
 
     def live_replicas(self) -> List[ReplicaHandle]:
         return [h for h in self.replicas.values() if h.state == STATE_LIVE]
@@ -617,7 +793,10 @@ class Router:
     # ---------------------------------------------------------------- pump
     @property
     def has_work(self) -> bool:
-        return bool(self._queue) or any(
+        # an unconverged publish IS work: generate()/run() keep stepping
+        # until every serving replica swapped to the latest version, so a
+        # publish into an idle fleet still completes on the next drive
+        return bool(self._queue) or self.publish_in_progress or any(
             (h.pump is not None or h.engine.has_work or h.assigned)
             for h in self.replicas.values() if h.pumpable
         )
@@ -631,6 +810,7 @@ class Router:
         finished outputs, detach drained replicas, and refresh gauges +
         the /debug/router snapshot."""
         self._maybe_respawn()
+        self._advance_publish()
         self._expire_deadlines()
         self._dispatch()
         events: List[StreamEvent] = []
@@ -1088,6 +1268,7 @@ class Router:
         live = [h for h in self.replicas.values() if h.state == STATE_LIVE]
         self._m_live.set(len(live))
         self._m_queue.set(len(getattr(self, "_queue", ())))
+        self._m_publish_gauge.set(1 if self.publish_in_progress else 0)
         cached = prompts = 0
         for h in self.replicas.values():
             if not h.pumpable:
@@ -1098,6 +1279,11 @@ class Router:
             self._reg.gauge(
                 f"serve.router.{h.rid}.state"
             ).set(STATE_CODES.get(h.state, -1))
+            # mixed-version window: each replica reports the monotonic
+            # seq of the version it serves (tags are opaque strings)
+            self._reg.gauge(
+                f"serve.router.{h.rid}.weights_version"
+            ).set(self._weights.seq(h.weights_version))
             if not h.engine_quiescent:
                 continue  # engine belongs to its outstanding pump worker
             # lifetime totals; pump-thread-private engine fields are safe
@@ -1125,6 +1311,9 @@ class Router:
             "wedged": self._wedged_total,
             "respawns": self._respawn_total,
             "probation_passed": self._probation_total,
+            # versioned weight publication (docs/serving.md)
+            "publishes": self._publish_total,
+            "publish_in_progress": self.publish_in_progress,
             "pending_respawns": [
                 {"rid": p["rid"], "attempt": p["attempt"],
                  "delay_s": p["delay_s"],
@@ -1169,6 +1358,13 @@ class Router:
             "respawns": doc.get("respawns", 0),
             "pending_respawns": len(doc.get("pending_respawns", ())),
             "retired_lineages": doc.get("retired_lineages", []),
+            # versioned weight publication: the latest tag, whether the
+            # mixed-version window is still open, and each replica's
+            # served version (probes watch convergence here)
+            "weights_version": doc.get("weights_version", ""),
+            "publish_in_progress": doc.get("publish_in_progress", False),
+            "replica_weights": {r.get("rid"): r.get("weights_version")
+                                for r in rows},
         }
 
     def metrics(self, reset_window: bool = True) -> Dict[str, Any]:
@@ -1217,6 +1413,7 @@ class Router:
             "wedged": float(self._wedged_total),
             "respawns": float(self._respawn_total),
             "probation_passed": float(self._probation_total),
+            "publishes": float(self._publish_total),
             "per_replica": per,
         }
         return agg

@@ -1,0 +1,150 @@
+"""Versioned weight payloads for live publication into a serving fleet.
+
+The router's rolling publish (docs/serving.md "Versioned weight
+publication") needs three things from the weight layer, and this module
+is all three:
+
+* ``WeightStore`` — an append-only map of version tag -> params payload.
+  Tags are opaque operator-chosen strings ("v1", "step-4000", a ckpt
+  path); the store assigns each a monotonic ``seq`` so gauges and the
+  mixed-version window can be reasoned about numerically even though
+  tags are not ordered. A tag is immutable once published: re-publishing
+  under the same tag is refused, because a replica that already swapped
+  to "v1" must never disagree with a replica that swaps to "v1" later.
+* ``WeightRecord`` — one (version, seq, params) entry. ``params`` is the
+  UNQUANTIZED pytree; each engine re-applies its own ``weight_quant``
+  storage transform at swap time, exactly as it does at construction.
+* ``load_published_params`` — the checkpoint-to-publish gate.
+  ``Router.publish_from_checkpoint`` must refuse a corrupt or
+  uncommitted generation BEFORE any replica buffer is touched, so the
+  PR 5 integrity manifest is verified here, ahead of the loader call
+  that would materialize bytes.
+
+The store keeps every published payload alive (host references, not
+device copies — engines hold their own, possibly quantized, buffers).
+Publishes are operator-rate events; retention is deliberately simple.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List
+
+from veomni_tpu.resilience.integrity import (
+    CheckpointCorruptError,
+    is_committed_dir,
+    verify_manifest,
+)
+
+__all__ = [
+    "WeightRecord",
+    "WeightStore",
+    "load_published_params",
+]
+
+
+@dataclass(frozen=True)
+class WeightRecord:
+    """One published weight payload: opaque tag, monotonic seq, params."""
+
+    version: str
+    seq: int
+    params: Any
+
+
+class WeightStore:
+    """Append-only, version-tagged weight payloads with monotonic seqs.
+
+    Not thread-safe by itself — the router publishes and reads under its
+    own single-writer discipline (``publish_weights`` and ``step()`` run
+    on the caller's thread; pump workers never touch the store).
+    """
+
+    def __init__(self, params: Any, version: str = "v0"):
+        self._by_version: Dict[str, WeightRecord] = {}
+        self._order: List[str] = []
+        self.put(version, params)
+
+    # ------------------------------------------------------------- write
+    def put(self, version: str, params: Any) -> WeightRecord:
+        """Publish ``params`` under ``version``. Tags are immutable: a
+        duplicate tag is refused (ValueError) rather than silently
+        retagged — two replicas reporting the same version MUST hold the
+        same weights."""
+        version = str(version)
+        if not version:
+            raise ValueError("weights version tag must be non-empty")
+        if version in self._by_version:
+            raise ValueError(
+                f"weights version {version!r} already published; version "
+                f"tags are immutable (pick a new tag)"
+            )
+        rec = WeightRecord(version=version, seq=len(self._order),
+                           params=params)
+        self._by_version[version] = rec
+        self._order.append(version)
+        return rec
+
+    # -------------------------------------------------------------- read
+    @property
+    def latest(self) -> WeightRecord:
+        return self._by_version[self._order[-1]]
+
+    def get(self, version: str) -> WeightRecord:
+        return self._by_version[str(version)]
+
+    def seq(self, version: str) -> int:
+        """Monotonic sequence number for ``version`` (-1 if unknown —
+        a replica tagged by an older store generation)."""
+        rec = self._by_version.get(str(version))
+        return rec.seq if rec is not None else -1
+
+    def versions(self) -> List[str]:
+        return list(self._order)
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def __contains__(self, version: object) -> bool:
+        return str(version) in self._by_version
+
+
+def load_published_params(
+    step_dir: str,
+    loader: Callable[[str], Any],
+    *,
+    verify_mode: str = "size",
+) -> Any:
+    """Integrity-gate a checkpoint generation, then load params from it.
+
+    The gate runs BEFORE ``loader`` so a corrupt generation is refused
+    without materializing a single byte into host or device memory:
+
+    * an uncommitted directory (no ``train_state/`` — a crashed save's
+      temp dir, or a typo) raises ``CheckpointCorruptError``;
+    * a manifest that fails ``verify_manifest(mode=verify_mode)``
+      (truncated array file, flipped bytes under "full") raises
+      ``CheckpointCorruptError`` with the report summary;
+    * a generation without a manifest (pre-manifest checkpoints) is
+      unverifiable — it loads, matching the restore path's behavior.
+
+    ``verify_mode="off"`` skips manifest verification but still refuses
+    uncommitted directories. ``loader`` receives ``step_dir`` and
+    returns the params pytree (the caller owns the Orbax/file-format
+    specifics — this module owns only the refuse-before-read contract).
+    """
+    step_dir = os.fspath(step_dir)
+    if not is_committed_dir(step_dir):
+        raise CheckpointCorruptError(
+            f"refusing to publish from {step_dir!r}: not a committed "
+            f"checkpoint generation (no train_state/ subtree)"
+        )
+    if verify_mode != "off":
+        report = verify_manifest(step_dir, mode=verify_mode)
+        if report is not None and not report.passed:
+            raise CheckpointCorruptError(
+                f"refusing to publish from {step_dir!r}: integrity "
+                f"verification failed — {report.summary()}"
+            )
+    return loader(step_dir)
